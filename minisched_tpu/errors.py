@@ -1,0 +1,13 @@
+"""Sentinel errors (reference errors/errors.go:5)."""
+
+
+class NotFoundError(KeyError):
+    """Object not found in the cluster store (reference errors.ErrNotFound)."""
+
+
+class ConflictError(RuntimeError):
+    """Optimistic-concurrency conflict: stale resource_version on update."""
+
+
+class AlreadyExistsError(RuntimeError):
+    """Create of an object whose key already exists."""

@@ -1,0 +1,117 @@
+"""Cluster events for requeue gating + the event broadcaster.
+
+ClusterEvent mirrors the k8s framework's {Resource, ActionType} pair that
+plugins register interest in via EventsToRegister (reference
+minisched/initialize.go:140-157 builds the ClusterEvent→pluginNames map;
+nodenumber registers {Node, Add} at
+minisched/plugins/score/nodenumber/nodenumber.go:66-70).
+
+EventBroadcaster is the analog of the k8s events recorder the reference
+starts at scheduler/scheduler.go:55-59 — scheduler decisions are recorded as
+Event objects in the store.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Optional
+
+from . import objects as obj
+from .store import ClusterStore, EventType, WatchEvent
+
+
+class GVK:
+    """Resource kinds plugins can register event interest in (the reference's
+    framework.GVK; only Node is actively wired there, eventhandler.go:60-76 —
+    here all store kinds emit)."""
+
+    POD = "Pod"
+    NODE = "Node"
+    PERSISTENT_VOLUME = "PersistentVolume"
+    PERSISTENT_VOLUME_CLAIM = "PersistentVolumeClaim"
+    WILDCARD = "*"
+
+
+class ActionType:
+    """Bitmask action types (k8s framework.ActionType)."""
+
+    ADD = 1
+    DELETE = 1 << 1
+    UPDATE_NODE_ALLOCATABLE = 1 << 2
+    UPDATE_NODE_LABEL = 1 << 3
+    UPDATE_NODE_TAINT = 1 << 4
+    UPDATE_NODE_CONDITION = 1 << 5
+    UPDATE = (UPDATE_NODE_ALLOCATABLE | UPDATE_NODE_LABEL |
+              UPDATE_NODE_TAINT | UPDATE_NODE_CONDITION)
+    ALL = ADD | DELETE | UPDATE
+
+
+@dataclass(frozen=True)
+class ClusterEvent:
+    resource: str  # GVK
+    action_type: int  # ActionType bitmask
+
+    def matches(self, other: "ClusterEvent") -> bool:
+        """Does a registered interest (self) cover an occurred event (other)?
+        (reference queue/queue.go:180-190 podMatchesEvent's evt.Match)"""
+        return (self.resource in (GVK.WILDCARD, other.resource)
+                and bool(self.action_type & other.action_type))
+
+
+def watch_to_cluster_event(ev: WatchEvent) -> ClusterEvent:
+    """Map a store WatchEvent to the ClusterEvent requeue-gating key,
+    computing the fine-grained node-update action types the way upstream
+    does (diffing old vs new object)."""
+    if ev.type == EventType.ADDED:
+        return ClusterEvent(ev.kind, ActionType.ADD)
+    if ev.type == EventType.DELETED:
+        return ClusterEvent(ev.kind, ActionType.DELETE)
+    action = 0
+    if ev.kind == GVK.NODE and ev.old_object is not None:
+        new, old = ev.object, ev.old_object
+        if new.status.allocatable != old.status.allocatable:
+            action |= ActionType.UPDATE_NODE_ALLOCATABLE
+        if new.metadata.labels != old.metadata.labels:
+            action |= ActionType.UPDATE_NODE_LABEL
+        if (new.spec.taints != old.spec.taints
+                or new.spec.unschedulable != old.spec.unschedulable):
+            action |= ActionType.UPDATE_NODE_TAINT
+        if not action:
+            action = ActionType.UPDATE
+    else:
+        action = ActionType.UPDATE
+    return ClusterEvent(ev.kind, action)
+
+
+class EventBroadcaster:
+    """Records scheduler lifecycle events into the store's Event collection
+    (reference scheduler/scheduler.go:55-59 events.NewBroadcaster →
+    StartRecordingToSink)."""
+
+    def __init__(self, store: ClusterStore, source: str = "minisched-tpu"):
+        self._store = store
+        self._source = source
+        self._seq = itertools.count(1)
+
+    def record(self, *, involved: str, reason: str, message: str,
+               type_: str = "Normal", namespace: str = "default") -> None:
+        ev = obj.Event(
+            metadata=obj.ObjectMeta(
+                name=f"evt-{next(self._seq)}-{reason.lower()}",
+                namespace=namespace),
+            type=type_, reason=reason, message=message,
+            involved_object=involved, source=self._source)
+        try:
+            self._store.create(ev)
+        except Exception:  # events are best-effort, like upstream
+            pass
+
+    def scheduled(self, pod: obj.Pod, node_name: str) -> None:
+        self.record(involved=f"Pod:{pod.key}", reason="Scheduled",
+                    message=f"Successfully assigned {pod.key} to {node_name}",
+                    namespace=pod.metadata.namespace)
+
+    def failed_scheduling(self, pod: obj.Pod, message: str) -> None:
+        self.record(involved=f"Pod:{pod.key}", reason="FailedScheduling",
+                    message=message, type_="Warning",
+                    namespace=pod.metadata.namespace)

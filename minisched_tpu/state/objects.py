@@ -1,0 +1,372 @@
+"""Typed cluster objects.
+
+The reference reuses the full upstream Kubernetes API types via client-go and
+a 53,911-line generated OpenAPI schema (reference k8sapiserver/openapi/
+zz_generated.openapi.go) solely so it can run a real in-process apiserver.
+The rebuild keeps the *scheduling-relevant* surface of those types as plain
+dataclasses: everything the filter/score plugins, the queue, and the binder
+inspect — resources, labels, taints/tolerations, node/pod affinity, topology
+spread, ports, volumes — and nothing else.
+
+Conventions:
+  * cpu is measured in millicores (int), memory/ephemeral-storage in bytes.
+  * a "key" is "namespace/name" for namespaced objects (pods, pvcs), "name"
+    for cluster-scoped ones (nodes, pvs) — matching the reference's
+    resultstore keys (reference scheduler/plugin/resultstore/store.go:52-58).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# Resource dimensions tracked in dense feature vectors, in this order.
+# (cpu millicores, memory bytes, max pods, ephemeral storage bytes,
+#  generic accelerator count — the TPU-world stand-in for nvidia.com/gpu.)
+RESOURCES: Tuple[str, ...] = ("cpu", "memory", "pods", "ephemeral-storage", "accelerator")
+RESOURCE_INDEX: Dict[str, int] = {r: i for i, r in enumerate(RESOURCES)}
+
+ResourceList = Dict[str, float]
+
+_uid_counter = itertools.count(1)
+
+
+def _next_uid() -> str:
+    return f"uid-{next(_uid_counter)}"
+
+
+def bump_uid_counter(past: int) -> None:
+    """Advance the uid counter beyond ``past`` (used after snapshot restore so
+    new objects never reuse a restored object's uid)."""
+    global _uid_counter
+    current = next(_uid_counter)
+    _uid_counter = itertools.count(max(current, past + 1))
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = field(default_factory=_next_uid)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    resource_version: int = 0
+    creation_timestamp: float = 0.0
+
+
+@dataclass
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclass
+class Toleration:
+    key: str = ""  # empty key + Exists tolerates everything
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # empty matches all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        """Upstream v1.Toleration.ToleratesTaint semantics."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.operator == "Exists":
+            return True
+        return self.value == taint.value
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str
+    operator: str  # In | NotIn | Exists | DoesNotExist | Gt | Lt
+    values: List[str] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        has = self.key in labels
+        val = labels.get(self.key)
+        if self.operator == "In":
+            return has and val in self.values
+        if self.operator == "NotIn":
+            return not has or val not in self.values
+        if self.operator == "Exists":
+            return has
+        if self.operator == "DoesNotExist":
+            return not has
+        if self.operator == "Gt":
+            return has and _is_int(val) and int(val) > int(self.values[0])
+        if self.operator == "Lt":
+            return has and _is_int(val) and int(val) < int(self.values[0])
+        raise ValueError(f"unknown operator {self.operator!r}")
+
+
+def _is_int(v: Optional[str]) -> bool:
+    try:
+        int(v)  # type: ignore[arg-type]
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+@dataclass
+class NodeSelectorTerm:
+    match_expressions: List[NodeSelectorRequirement] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        return all(r.matches(labels) for r in self.match_expressions)
+
+
+@dataclass
+class NodeSelector:
+    """ORed terms, each term ANDs its expressions (upstream v1.NodeSelector)."""
+
+    node_selector_terms: List[NodeSelectorTerm] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        return any(t.matches(labels) for t in self.node_selector_terms)
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int
+    preference: NodeSelectorTerm
+
+
+@dataclass
+class NodeAffinity:
+    required: Optional[NodeSelector] = None
+    preferred: List[PreferredSchedulingTerm] = field(default_factory=list)
+
+
+@dataclass
+class LabelSelector:
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    match_expressions: List[NodeSelectorRequirement] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        for k, v in self.match_labels.items():
+            if labels.get(k) != v:
+                return False
+        return all(r.matches(labels) for r in self.match_expressions)
+
+    def empty(self) -> bool:
+        return not self.match_labels and not self.match_expressions
+
+
+@dataclass
+class PodAffinityTerm:
+    label_selector: LabelSelector = field(default_factory=LabelSelector)
+    topology_key: str = "kubernetes.io/hostname"
+    namespaces: List[str] = field(default_factory=list)  # empty = pod's own ns
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int
+    term: PodAffinityTerm
+
+
+@dataclass
+class PodAffinity:
+    required: List[PodAffinityTerm] = field(default_factory=list)
+    preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class PodAntiAffinity:
+    required: List[PodAffinityTerm] = field(default_factory=list)
+    preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
+
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int = 1
+    topology_key: str = "topology.kubernetes.io/zone"
+    when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
+    label_selector: LabelSelector = field(default_factory=LabelSelector)
+
+
+@dataclass
+class ContainerPort:
+    host_port: int = 0
+    container_port: int = 0
+    protocol: str = "TCP"
+    host_ip: str = ""
+
+
+@dataclass
+class VolumeClaim:
+    """A pod's reference to a PVC by name (pod.spec.volumes[*].pvc)."""
+
+    claim_name: str
+
+
+class PodPhase:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclass
+class PodSpec:
+    node_name: str = ""
+    scheduler_name: str = "default-scheduler"
+    priority: int = 0
+    requests: ResourceList = field(default_factory=dict)  # aggregated container requests
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: List[Toleration] = field(default_factory=list)
+    topology_spread_constraints: List[TopologySpreadConstraint] = field(default_factory=list)
+    ports: List[ContainerPort] = field(default_factory=list)
+    volumes: List[VolumeClaim] = field(default_factory=list)
+    images: List[str] = field(default_factory=list)
+    # Gang scheduling: pods sharing a non-empty group must be assigned
+    # all-or-nothing (coscheduling; no reference analog — BASELINE config 5).
+    pod_group: str = ""
+    pod_group_min: int = 0
+
+
+@dataclass
+class PodStatus:
+    phase: str = PodPhase.PENDING
+    # Names of plugins that rejected the pod in its last scheduling attempt;
+    # drives event-filtered requeue (reference framework's
+    # QueuedPodInfo.UnschedulablePlugins, used at queue/queue.go:167-190).
+    unschedulable_plugins: List[str] = field(default_factory=list)
+    message: str = ""
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @property
+    def bound(self) -> bool:
+        return bool(self.spec.node_name)
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: List[Taint] = field(default_factory=list)
+
+
+@dataclass
+class NodeStatus:
+    capacity: ResourceList = field(default_factory=dict)
+    allocatable: ResourceList = field(default_factory=dict)
+    images: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    @property
+    def key(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    capacity: ResourceList = field(default_factory=dict)
+    claim_ref: str = ""  # bound PVC key, "" if available
+    storage_class: str = ""
+    phase: str = "Available"  # Available | Bound
+
+    @property
+    def key(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    request: ResourceList = field(default_factory=dict)
+    storage_class: str = ""
+    volume_name: str = ""  # bound PV name, "" if pending
+    phase: str = "Pending"  # Pending | Bound
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+@dataclass
+class Event:
+    """Cluster event record (analog of the k8s Events API the reference's
+    broadcaster writes to, reference scheduler/scheduler.go:55-59)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    type: str = "Normal"  # Normal | Warning
+    reason: str = ""
+    message: str = ""
+    involved_object: str = ""  # "kind:key"
+    source: str = "minisched-tpu"
+    count: int = 1
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+KIND_OF = {
+    Pod: "Pod",
+    Node: "Node",
+    PersistentVolume: "PersistentVolume",
+    PersistentVolumeClaim: "PersistentVolumeClaim",
+    Event: "Event",
+}
+
+NAMESPACED = {"Pod": True, "Node": False, "PersistentVolume": False,
+              "PersistentVolumeClaim": True, "Event": True}
+
+
+def kind_of(obj: Any) -> str:
+    try:
+        return KIND_OF[type(obj)]
+    except KeyError:
+        raise TypeError(f"unregistered object type {type(obj)!r}")
+
+
+def object_key(obj: Any) -> str:
+    return obj.key
+
+
+def deepcopy_obj(obj):
+    """Cheap structural deep copy via dataclasses (objects are pure data)."""
+    import copy
+
+    return copy.deepcopy(obj)
+
+
+def to_dict(obj: Any) -> Dict[str, Any]:
+    return dataclasses.asdict(obj)
+
+
+def pod_requests(pod: Pod) -> ResourceList:
+    """Effective resource requests incl. the implicit one-pod slot."""
+    req = dict(pod.spec.requests)
+    req.setdefault("pods", 1)
+    return req

@@ -1,0 +1,48 @@
+"""Dataclass (de)serialization for snapshot/restore."""
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict, get_args, get_origin
+
+from . import objects as obj
+
+_KIND_TYPES = {
+    "Pod": obj.Pod,
+    "Node": obj.Node,
+    "PersistentVolume": obj.PersistentVolume,
+    "PersistentVolumeClaim": obj.PersistentVolumeClaim,
+    "Event": obj.Event,
+}
+
+_HINT_CACHE: Dict[type, Dict[str, Any]] = {}
+
+
+def _from(tp: Any, value: Any) -> Any:
+    if value is None:
+        return None
+    origin = get_origin(tp)
+    if origin is typing.Union:  # Optional[X]
+        args = [a for a in get_args(tp) if a is not type(None)]
+        return _from(args[0], value)
+    if origin in (list, typing.List):
+        (elt,) = get_args(tp)
+        return [_from(elt, v) for v in value]
+    if origin in (dict, typing.Dict):
+        _, vt = get_args(tp)
+        return {k: _from(vt, v) for k, v in value.items()}
+    if dataclasses.is_dataclass(tp):
+        if tp not in _HINT_CACHE:
+            _HINT_CACHE[tp] = typing.get_type_hints(tp)
+        hints = _HINT_CACHE[tp]
+        kwargs = {
+            f.name: _from(hints[f.name], value[f.name])
+            for f in dataclasses.fields(tp)
+            if f.name in value
+        }
+        return tp(**kwargs)
+    return value
+
+
+def from_dict(kind: str, d: Dict[str, Any]) -> Any:
+    return _from(_KIND_TYPES[kind], d)

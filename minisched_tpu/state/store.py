@@ -1,0 +1,267 @@
+"""Event-sourced in-process cluster store.
+
+Replaces the reference's control plane — a real kube-apiserver backed by etcd
+(reference k8sapiserver/k8sapiserver.go:43-105) — with a typed, versioned,
+watchable state store. The architectural essence preserved (SURVEY §1): the
+scheduler and the scenario never call each other; both mutate/observe shared
+cluster state here, coupled only by watch events.
+
+Capabilities mirrored:
+  * CRUD with optimistic concurrency (resource_version) — the apiserver/etcd
+    compare-and-swap contract.
+  * Versioned watch streams: every mutation is appended to a global event log
+    with a monotonically increasing resource version; watchers can replay
+    from any version (etcd watch semantics).
+  * Durable snapshot/restore (the etcd-persistence capability: reference
+    docker-compose.yml mounts an etcd volume; restart against the same etcd
+    and state survives).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from ..errors import AlreadyExistsError, ConflictError, NotFoundError
+from . import objects as obj
+from .objects import deepcopy_obj, kind_of
+
+
+class EventType:
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: str  # EventType
+    kind: str  # "Pod" | "Node" | ...
+    object: Any  # snapshot of the object after (or, for DELETED, at) mutation
+    old_object: Any = None  # snapshot before mutation (MODIFIED/DELETED)
+    resource_version: int = 0
+
+
+class Watcher:
+    """A watch stream. Iterate or ``next_event(timeout)``; ``stop()`` ends it."""
+
+    def __init__(self, store: "ClusterStore", kinds: Optional[List[str]], start_rv: int):
+        self._store = store
+        self._kinds = set(kinds) if kinds else None
+        self._cursor = start_rv
+        self._stopped = threading.Event()
+
+    def wants(self, ev: WatchEvent) -> bool:
+        return self._kinds is None or ev.kind in self._kinds
+
+    def next_event(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        """Next matching event after the cursor, or None on timeout/stop."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._store._cond:
+            while not self._stopped.is_set():
+                ev = self._store._next_after(self._cursor, self._kinds)
+                if ev is not None:
+                    self._cursor = ev.resource_version
+                    return ev
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._store._cond.wait(remaining)
+                else:
+                    self._store._cond.wait(1.0)
+        return None
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        while not self._stopped.is_set():
+            ev = self.next_event(timeout=0.1)
+            if ev is not None:
+                yield ev
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._store._cond:
+            self._store._cond.notify_all()
+
+
+class ClusterStore:
+    """Thread-safe typed object store with versioned watch log."""
+
+    KINDS = ("Pod", "Node", "PersistentVolume", "PersistentVolumeClaim", "Event")
+
+    def __init__(self, max_log: int = 100_000):
+        self._cond = threading.Condition()
+        self._rv = 0
+        self._objects: Dict[str, Dict[str, Any]] = {k: {} for k in self.KINDS}
+        self._log: List[WatchEvent] = []
+        self._max_log = max_log
+        self._log_base = 0  # rv of the oldest retained log entry - 1
+
+    # ---- CRUD -----------------------------------------------------------
+
+    def create(self, o: Any) -> Any:
+        kind = kind_of(o)
+        with self._cond:
+            key = o.key
+            if key in self._objects[kind]:
+                raise AlreadyExistsError(f"{kind} {key!r} already exists")
+            self._rv += 1
+            o.metadata.resource_version = self._rv
+            if not o.metadata.creation_timestamp:
+                o.metadata.creation_timestamp = time.time()
+            stored = deepcopy_obj(o)
+            self._objects[kind][key] = stored
+            self._append(WatchEvent(EventType.ADDED, kind, deepcopy_obj(stored),
+                                    None, self._rv))
+            return deepcopy_obj(stored)
+
+    def get(self, kind: str, key: str) -> Any:
+        with self._cond:
+            try:
+                return deepcopy_obj(self._objects[kind][key])
+            except KeyError:
+                raise NotFoundError(f"{kind} {key!r} not found")
+
+    def list(self, kind: str) -> List[Any]:
+        with self._cond:
+            return [deepcopy_obj(o) for o in self._objects[kind].values()]
+
+    def count(self, kind: str) -> int:
+        with self._cond:
+            return len(self._objects[kind])
+
+    def update(self, o: Any, *, check_version: bool = False) -> Any:
+        kind = kind_of(o)
+        with self._cond:
+            key = o.key
+            old = self._objects[kind].get(key)
+            if old is None:
+                raise NotFoundError(f"{kind} {key!r} not found")
+            if check_version and o.metadata.resource_version != old.metadata.resource_version:
+                raise ConflictError(
+                    f"{kind} {key!r}: stale resource_version "
+                    f"{o.metadata.resource_version} != {old.metadata.resource_version}")
+            self._rv += 1
+            o.metadata.resource_version = self._rv
+            stored = deepcopy_obj(o)
+            self._objects[kind][key] = stored
+            self._append(WatchEvent(EventType.MODIFIED, kind, deepcopy_obj(stored),
+                                    deepcopy_obj(old), self._rv))
+            return deepcopy_obj(stored)
+
+    def delete(self, kind: str, key: str) -> None:
+        with self._cond:
+            old = self._objects[kind].pop(key, None)
+            if old is None:
+                raise NotFoundError(f"{kind} {key!r} not found")
+            self._rv += 1
+            self._append(WatchEvent(EventType.DELETED, kind, deepcopy_obj(old),
+                                    deepcopy_obj(old), self._rv))
+
+    # ---- Typed conveniences --------------------------------------------
+
+    def bind_pod(self, pod_key: str, node_name: str) -> Any:
+        """Commit a binding (reference minisched/minisched.go:266-277 POSTs a
+        v1.Binding; here the binding subresource is a store-level CAS that
+        fails if the pod is already bound or the node is gone)."""
+        with self._cond:
+            pod = self._objects["Pod"].get(pod_key)
+            if pod is None:
+                raise NotFoundError(f"Pod {pod_key!r} not found")
+            if pod.spec.node_name:
+                raise ConflictError(
+                    f"Pod {pod_key!r} already bound to {pod.spec.node_name!r}")
+            if node_name not in self._objects["Node"]:
+                raise NotFoundError(f"Node {node_name!r} not found")
+            updated = deepcopy_obj(pod)
+            updated.spec.node_name = node_name
+            updated.status.phase = obj.PodPhase.RUNNING
+            updated.status.unschedulable_plugins = []
+            updated.status.message = ""
+            return self.update(updated)
+
+    # ---- Watch ----------------------------------------------------------
+
+    def watch(self, kinds: Optional[List[str]] = None,
+              from_version: Optional[int] = None) -> Watcher:
+        with self._cond:
+            start = self._rv if from_version is None else from_version
+            if start < self._log_base:
+                raise ValueError(
+                    f"watch from_version={start} is older than retained log "
+                    f"(base {self._log_base}); re-list and restart the watch")
+            return Watcher(self, kinds, start)
+
+    def list_and_watch(self, kinds: Optional[List[str]] = None):
+        """Atomic LIST + WATCH: the watcher's cursor is the exact version the
+        lists were taken at, so no event is missed or delivered twice
+        (client-go reflector's list-then-watch-from-listRV contract)."""
+        with self._cond:
+            lists = {k: [deepcopy_obj(o) for o in self._objects[k].values()]
+                     for k in (kinds or self.KINDS)}
+            return lists, Watcher(self, kinds, self._rv)
+
+    def resource_version(self) -> int:
+        with self._cond:
+            return self._rv
+
+    def _append(self, ev: WatchEvent) -> None:
+        self._log.append(ev)
+        if len(self._log) > self._max_log:
+            drop = len(self._log) - self._max_log
+            self._log_base = self._log[drop - 1].resource_version
+            del self._log[:drop]
+        self._cond.notify_all()
+
+    def _next_after(self, rv: int, kinds: Optional[set]) -> Optional[WatchEvent]:
+        # Every mutation appends exactly one event with rv = previous + 1, so
+        # the log is rv-contiguous: _log[i].resource_version == _log_base+1+i.
+        if rv < self._log_base:
+            raise ValueError(
+                f"watch cursor {rv} fell behind retained log (base "
+                f"{self._log_base}); re-list and restart the watch")
+        for ev in self._log[rv - self._log_base:]:
+            if kinds is None or ev.kind in kinds:
+                return ev
+        return None
+
+    # ---- Snapshot / restore (etcd durability analog) -------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._cond:
+            return {
+                "resource_version": self._rv,
+                "objects": {
+                    kind: {k: obj.to_dict(o) for k, o in col.items()}
+                    for kind, col in self._objects.items()
+                },
+            }
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.snapshot(), f)
+
+    @classmethod
+    def restore(cls, snap: Dict[str, Any]) -> "ClusterStore":
+        from . import serde
+
+        store = cls()
+        store._rv = snap["resource_version"]
+        store._log_base = store._rv
+        max_uid = 0
+        for kind, col in snap["objects"].items():
+            for key, d in col.items():
+                o = serde.from_dict(kind, d)
+                uid = o.metadata.uid
+                if uid.startswith("uid-") and uid[4:].isdigit():
+                    max_uid = max(max_uid, int(uid[4:]))
+                store._objects[kind][key] = o
+        obj.bump_uid_counter(max_uid)
+        return store
+
+    @classmethod
+    def load(cls, path: str) -> "ClusterStore":
+        with open(path) as f:
+            return cls.restore(json.load(f))

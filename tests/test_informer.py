@@ -1,0 +1,82 @@
+"""Informer dispatch tests (reference minisched/eventhandler.go contract:
+initial list sync, add/update/delete fan-out, filtering handlers)."""
+import threading
+import time
+
+from minisched_tpu.state import ClusterStore, InformerFactory, ResourceEventHandlers
+from tests.test_store import make_node, make_pod
+
+
+def wait_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def test_initial_sync_then_live_events():
+    s = ClusterStore()
+    s.create(make_node("pre-existing"))
+    seen, lock = [], threading.Lock()
+
+    f = InformerFactory(s)
+    f.add_handlers("Node", ResourceEventHandlers(
+        on_add=lambda o: seen.append(("add", o.metadata.name)),
+        on_update=lambda old, new: seen.append(("upd", new.metadata.name)),
+        on_delete=lambda o: seen.append(("del", o.metadata.name)),
+    ))
+    f.start()
+    assert f.wait_for_cache_sync()
+    assert ("add", "pre-existing") in seen
+
+    s.create(make_node("live"))
+    n = s.get("Node", "live")
+    n.spec.unschedulable = True
+    s.update(n)
+    s.delete("Node", "live")
+    assert wait_until(lambda: ("del", "live") in seen)
+    assert seen.index(("add", "live")) < seen.index(("upd", "live")) < seen.index(("del", "live"))
+    f.shutdown()
+
+
+def test_filtering_handler_splits_scheduled_pods():
+    # Mirrors the reference's unscheduled-pod filter (eventhandler.go:20-35).
+    s = ClusterStore()
+    unscheduled = []
+    f = InformerFactory(s)
+    f.add_handlers("Pod", ResourceEventHandlers(
+        filter=lambda p: not p.spec.node_name,
+        on_add=lambda p: unscheduled.append(p.key),
+    ))
+    f.start()
+    f.wait_for_cache_sync()
+
+    s.create(make_node("n1"))
+    s.create(make_pod("pending"))
+    bound = make_pod("bound")
+    bound.spec.node_name = "n1"
+    s.create(bound)
+    assert wait_until(lambda: "default/pending" in unscheduled)
+    time.sleep(0.05)
+    assert "default/bound" not in unscheduled
+    f.shutdown()
+
+
+def test_handler_exception_does_not_kill_pump():
+    s = ClusterStore()
+    seen = []
+    f = InformerFactory(s)
+
+    def explode(o):
+        seen.append(o.metadata.name)
+        raise RuntimeError("boom")
+
+    f.add_handlers("Node", ResourceEventHandlers(on_add=explode))
+    f.start()
+    f.wait_for_cache_sync()
+    s.create(make_node("a"))
+    s.create(make_node("b"))
+    assert wait_until(lambda: seen == ["a", "b"])
+    f.shutdown()

@@ -1,0 +1,158 @@
+"""Cluster-store unit tests: CRUD, optimistic concurrency, watch streams,
+binding CAS, snapshot/restore (reference capability: apiserver+etcd,
+k8sapiserver/k8sapiserver.go:43-105)."""
+import threading
+
+import pytest
+
+from minisched_tpu.errors import AlreadyExistsError, ConflictError, NotFoundError
+from minisched_tpu.state import (
+    ClusterStore,
+    EventType,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+
+
+def make_node(name, unschedulable=False, cpu=4000):
+    return Node(
+        metadata=ObjectMeta(name=name),
+        spec=NodeSpec(unschedulable=unschedulable),
+        status=NodeStatus(allocatable={"cpu": cpu, "memory": 16 << 30, "pods": 110}),
+    )
+
+
+def make_pod(name, ns="default", cpu=100):
+    return Pod(metadata=ObjectMeta(name=name, namespace=ns),
+               spec=PodSpec(requests={"cpu": cpu}))
+
+
+def test_crud_roundtrip():
+    s = ClusterStore()
+    s.create(make_node("node1"))
+    got = s.get("Node", "node1")
+    assert got.metadata.name == "node1"
+    assert got.metadata.resource_version == 1
+
+    got.spec.unschedulable = True
+    s.update(got)
+    assert s.get("Node", "node1").spec.unschedulable is True
+    assert s.get("Node", "node1").metadata.resource_version == 2
+
+    s.delete("Node", "node1")
+    with pytest.raises(NotFoundError):
+        s.get("Node", "node1")
+
+
+def test_create_duplicate_and_update_missing():
+    s = ClusterStore()
+    s.create(make_pod("p"))
+    with pytest.raises(AlreadyExistsError):
+        s.create(make_pod("p"))
+    with pytest.raises(NotFoundError):
+        s.update(make_pod("ghost"))
+
+
+def test_returned_objects_are_copies():
+    s = ClusterStore()
+    s.create(make_node("n"))
+    a = s.get("Node", "n")
+    a.spec.unschedulable = True  # mutating the copy must not leak into store
+    assert s.get("Node", "n").spec.unschedulable is False
+
+
+def test_optimistic_concurrency():
+    s = ClusterStore()
+    s.create(make_pod("p"))
+    a = s.get("Pod", "default/p")
+    b = s.get("Pod", "default/p")
+    a.spec.priority = 1
+    s.update(a, check_version=True)
+    b.spec.priority = 2
+    with pytest.raises(ConflictError):
+        s.update(b, check_version=True)
+
+
+def test_bind_pod_cas():
+    s = ClusterStore()
+    s.create(make_node("n1"))
+    s.create(make_pod("p"))
+    s.bind_pod("default/p", "n1")
+    pod = s.get("Pod", "default/p")
+    assert pod.spec.node_name == "n1"
+    assert pod.status.phase == "Running"
+    with pytest.raises(ConflictError):
+        s.bind_pod("default/p", "n1")  # already bound
+    s.create(make_pod("q"))
+    with pytest.raises(NotFoundError):
+        s.bind_pod("default/q", "ghost-node")
+
+
+def test_watch_sees_ordered_events():
+    s = ClusterStore()
+    w = s.watch(kinds=["Node"])
+    s.create(make_node("n1"))
+    s.create(make_pod("p1"))  # filtered out by kind
+    n = s.get("Node", "n1")
+    n.spec.unschedulable = True
+    s.update(n)
+    s.delete("Node", "n1")
+
+    evs = [w.next_event(timeout=1) for _ in range(3)]
+    assert [e.type for e in evs] == [EventType.ADDED, EventType.MODIFIED,
+                                     EventType.DELETED]
+    assert all(e.kind == "Node" for e in evs)
+    assert evs[1].old_object.spec.unschedulable is False
+    assert evs[1].object.spec.unschedulable is True
+    assert w.next_event(timeout=0.05) is None
+
+
+def test_watch_replay_from_version():
+    s = ClusterStore()
+    s.create(make_node("n1"))
+    rv = s.resource_version()
+    s.create(make_node("n2"))
+    w = s.watch(kinds=["Node"], from_version=rv)
+    ev = w.next_event(timeout=1)
+    assert ev.object.metadata.name == "n2"
+
+
+def test_watch_blocks_then_wakes():
+    s = ClusterStore()
+    w = s.watch()
+    got = []
+
+    def consume():
+        got.append(w.next_event(timeout=5))
+
+    t = threading.Thread(target=consume)
+    t.start()
+    s.create(make_node("late"))
+    t.join(timeout=5)
+    assert got and got[0].object.metadata.name == "late"
+
+
+def test_snapshot_restore_roundtrip(tmp_path):
+    s = ClusterStore()
+    s.create(make_node("n1", unschedulable=True))
+    p = make_pod("p1", cpu=250)
+    p.spec.tolerations = []
+    s.create(p)
+    s.bind_pod("default/p1", "n1")
+
+    path = str(tmp_path / "snap.json")
+    s.save(path)
+    s2 = ClusterStore.load(path)
+
+    assert s2.get("Node", "n1").spec.unschedulable is True
+    pod = s2.get("Pod", "default/p1")
+    assert pod.spec.node_name == "n1"
+    assert pod.spec.requests == {"cpu": 250}
+    assert s2.resource_version() == s.resource_version()
+    # restored store keeps working
+    s2.create(make_node("n2"))
+    assert s2.count("Node") == 2
