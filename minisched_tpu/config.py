@@ -1,0 +1,53 @@
+"""Configuration.
+
+Two tiers, mirroring the reference (SURVEY §5 "Config / flag system"):
+  1. Process-level env config (reference config/config.go:14-75 — PORT,
+     ETCD_URL, FRONTEND_URL, all mandatory with typed errors). The rebuild
+     needs no network endpoints; the env tier carries the TPU-path toggles
+     BASELINE.json assigns to config (backend selection, explain mode).
+  2. Scheduler profiles (KubeSchedulerConfiguration analog) live in
+     minisched_tpu/service/defaultconfig.py.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+class EmptyEnvError(ValueError):
+    """reference config.ErrEmptyEnv (config/config.go:18)."""
+
+
+@dataclass
+class SchedulerConfig:
+    """Engine tuning knobs."""
+
+    max_batch_size: int = 1024       # pods per scheduling step
+    pod_bucket_min: int = 16         # bucket ladder minimum (pad P)
+    node_bucket_min: int = 16        # bucket ladder minimum (pad N)
+    backoff_initial_s: float = 1.0   # reference queue.go:218-221
+    backoff_max_s: float = 10.0
+    explain: bool = False            # return full per-plugin matrices
+    seed: int = 0                    # PRNG seed for tie-breaking parity
+    bind_workers: int = 16           # async binding-cycle pool size
+    platform: str = ""               # "" = whatever jax picks; or cpu/tpu
+
+
+def config_from_env() -> SchedulerConfig:
+    """Build SchedulerConfig from MINISCHED_* env vars (the reference reads
+    all config from env, config/config.go:22-44)."""
+
+    def _req(name: str, default: str) -> str:
+        v = os.environ.get(name, default)
+        if v == "":
+            raise EmptyEnvError(f"env {name} is empty")
+        return v
+
+    return SchedulerConfig(
+        max_batch_size=int(_req("MINISCHED_MAX_BATCH", "1024")),
+        explain=_req("MINISCHED_EXPLAIN", "0") == "1",
+        seed=int(_req("MINISCHED_SEED", "0")),
+        backoff_initial_s=float(_req("MINISCHED_BACKOFF_INITIAL", "1.0")),
+        backoff_max_s=float(_req("MINISCHED_BACKOFF_MAX", "10.0")),
+        platform=os.environ.get("MINISCHED_PLATFORM", ""),
+    )

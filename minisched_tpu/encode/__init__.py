@@ -1,0 +1,10 @@
+from .features import (  # noqa: F401
+    EncodingConfig,
+    NodeFeatures,
+    PodFeatures,
+    encode_pods,
+    name_suffix_digit,
+    pair_hash,
+    key_hash,
+)
+from .cache import NodeFeatureCache  # noqa: F401

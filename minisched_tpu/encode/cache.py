@@ -1,0 +1,170 @@
+"""Incremental node-feature cache.
+
+Fixes the reference's per-pod full node List (reference
+minisched/minisched.go:40 — an O(nodes) RPC per scheduling cycle): node
+features are encoded once on add/update and patched in place as watch events
+arrive; pod bind/unbind adjusts per-node free-resource and used-port columns
+incrementally. A snapshot padded to a bucketed shape is handed to the XLA
+step (bucketing avoids per-batch recompilation — SURVEY §7 "dynamic shapes").
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..state.objects import Node, Pod, pod_requests
+from . import features as F
+from .features import EncodingConfig, NodeFeatures, DEFAULT_ENCODING
+
+
+def bucket_for(n: int, minimum: int = 16) -> int:
+    """Smallest power-of-two bucket ≥ n (≥ minimum)."""
+    b = minimum
+    while b < n:
+        b <<= 1
+    return b
+
+
+class NodeFeatureCache:
+    """Thread-safe incrementally-maintained node feature arrays."""
+
+    def __init__(self, cfg: EncodingConfig = DEFAULT_ENCODING, capacity: int = 64):
+        self.cfg = cfg
+        self._lock = threading.Lock()
+        self._feats = F.empty_node_features(capacity, cfg)
+        self._capacity = capacity
+        self._index: Dict[str, int] = {}  # node name → row
+        self._names: List[Optional[str]] = [None] * capacity
+        self._free_rows: List[int] = list(range(capacity - 1, -1, -1))
+        # pod key → (node row, requests vector, host ports) for incremental
+        # free-resource accounting; only bound pods appear here.
+        self._bound: Dict[str, Tuple[int, np.ndarray, List[int]]] = {}
+        self.overflow: List[str] = []  # encoding-slot overflow reports
+        self.version = 0  # bumped on every mutation (cheap staleness check)
+
+    # ---- node lifecycle -------------------------------------------------
+
+    def upsert_node(self, node: Node) -> None:
+        with self._lock:
+            i = self._index.get(node.metadata.name)
+            if i is None:
+                i = self._alloc_row()
+                self._index[node.metadata.name] = i
+                self._names[i] = node.metadata.name
+            # Re-encoding resets static columns; free is derived below.
+            F.encode_node_into(self._feats, i, node, self.overflow)
+            self._recompute_free_row(i)
+            self.version += 1
+
+    def remove_node(self, name: str) -> None:
+        with self._lock:
+            i = self._index.pop(name, None)
+            if i is None:
+                return
+            F.clear_node_row(self._feats, i)
+            self._names[i] = None
+            self._free_rows.append(i)
+            # Bound-pod accounting rows pointing at this node are dropped;
+            # their pods will be rescheduled by higher layers.
+            self._bound = {k: v for k, v in self._bound.items() if v[0] != i}
+            self.version += 1
+
+    # ---- pod accounting -------------------------------------------------
+
+    def account_bind(self, pod: Pod) -> None:
+        """Pod became bound: subtract its requests from the node's free row."""
+        with self._lock:
+            i = self._index.get(pod.spec.node_name)
+            if i is None or pod.key in self._bound:
+                return
+            req = F.resources_vector(pod_requests(pod))
+            ports = [p.host_port for p in pod.spec.ports if p.host_port]
+            self._bound[pod.key] = (i, req, ports)
+            self._feats.free[i] -= req
+            self._add_ports(i, ports)
+            self.version += 1
+
+    def account_unbind(self, pod_key: str) -> None:
+        """Bound pod deleted/unbound: return its requests to the node."""
+        with self._lock:
+            entry = self._bound.pop(pod_key, None)
+            if entry is None:
+                return
+            i, req, ports = entry
+            if self._names[i] is not None:
+                self._feats.free[i] += req
+                self._remove_ports(i, ports)
+            self.version += 1
+
+    # ---- snapshot -------------------------------------------------------
+
+    def snapshot(self, pad: Optional[int] = None) -> Tuple[NodeFeatures, List[Optional[str]]]:
+        """Copy of the feature arrays padded to ``pad`` (default: bucketed
+        capacity), plus the row→name mapping (None = empty row)."""
+        with self._lock:
+            n = self._capacity
+            target = pad if pad is not None else bucket_for(n)
+            if target < n:
+                raise ValueError(f"pad {target} < live capacity {n}")
+            f = self._feats
+            if target == n:
+                feats = NodeFeatures(*(a.copy() for a in f))
+            else:
+                empty = F.empty_node_features(target, self.cfg)
+                for a, e in zip(f, empty):
+                    e[:n] = a
+                feats = empty
+            return feats, list(self._names) + [None] * (target - n)
+
+    def node_count(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    def row_of(self, name: str) -> Optional[int]:
+        with self._lock:
+            return self._index.get(name)
+
+    # ---- internals ------------------------------------------------------
+
+    def _alloc_row(self) -> int:
+        if not self._free_rows:
+            new_cap = self._capacity * 2
+            grown = F.empty_node_features(new_cap, self.cfg)
+            for a, g in zip(self._feats, grown):
+                g[: self._capacity] = a
+            self._feats = grown
+            self._names += [None] * (new_cap - self._capacity)
+            self._free_rows = list(range(new_cap - 1, self._capacity - 1, -1))
+            self._capacity = new_cap
+        return self._free_rows.pop()
+
+    def _recompute_free_row(self, i: int) -> None:
+        free = self._feats.allocatable[i].copy()
+        ports: List[int] = []
+        for key, (row, req, p) in self._bound.items():
+            if row == i:
+                free -= req
+                ports += p
+        self._feats.free[i] = free
+        self._feats.used_ports[i] = 0
+        self._add_ports(i, ports)
+
+    def _add_ports(self, i: int, ports: List[int]) -> None:
+        row = self._feats.used_ports[i]
+        for p in ports:
+            for j in range(row.shape[0]):
+                if row[j] == 0:
+                    row[j] = p
+                    break
+            else:
+                self.overflow.append(f"node row {i}: used host ports overflow")
+
+    def _remove_ports(self, i: int, ports: List[int]) -> None:
+        row = self._feats.used_ports[i]
+        for p in ports:
+            for j in range(row.shape[0]):
+                if row[j] == p:
+                    row[j] = 0
+                    break

@@ -1,0 +1,325 @@
+"""Feature encoding: cluster objects → dense matrices for the XLA step.
+
+The reference evaluates plugins over Go structs one (pod, node) pair at a
+time (reference minisched/minisched.go:124-137,167-185). Here pods and nodes
+are encoded once into fixed-width numeric arrays so every plugin becomes a
+vectorized (P × N) computation:
+
+  * resources → f32 vectors over the RESOURCES axis (cpu milli, mem bytes, …)
+  * label selectors / affinity / taints / tolerations → 32-bit string hashes
+    (crc32) compared as ints; 0 is the empty-slot sentinel.  SURVEY §7 "hard
+    parts" flags collision risk at 50k-node scale: crc32 over the typically
+    small label vocabulary makes false matches vanishingly rare, and the
+    encoding keeps per-expression slots so semantics stay exact otherwise.
+  * arbitrary-length lists (labels, taints, ports, …) → fixed slot counts
+    from EncodingConfig, padded with the sentinel; overflow is reported so
+    callers can widen the config rather than silently mis-schedule.
+
+All arrays are plain numpy on the host; the scheduler pads them to bucketed
+shapes before shipping to the device (avoids per-batch recompilation —
+SURVEY §7 "dynamic shapes").
+"""
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..state import objects as obj
+from ..state.objects import RESOURCES, Node, Pod
+
+NUM_RESOURCES = len(RESOURCES)
+
+# Taint-effect codes.
+EFFECT_NONE = 0
+EFFECT_NO_SCHEDULE = 1
+EFFECT_PREFER_NO_SCHEDULE = 2
+EFFECT_NO_EXECUTE = 3
+_EFFECT_CODE = {"NoSchedule": EFFECT_NO_SCHEDULE,
+                "PreferNoSchedule": EFFECT_PREFER_NO_SCHEDULE,
+                "NoExecute": EFFECT_NO_EXECUTE}
+
+# Node-selector-requirement operator codes.
+OP_NONE = 0
+OP_IN = 1
+OP_NOT_IN = 2
+OP_EXISTS = 3
+OP_DOES_NOT_EXIST = 4
+_OP_CODE = {"In": OP_IN, "NotIn": OP_NOT_IN, "Exists": OP_EXISTS,
+            "DoesNotExist": OP_DOES_NOT_EXIST}
+
+# Toleration operator codes.
+TOL_NONE = 0
+TOL_EQUAL = 1
+TOL_EXISTS = 2
+
+
+@dataclass(frozen=True)
+class EncodingConfig:
+    """Slot widths for variable-length fields. Widen for exotic clusters."""
+
+    max_labels: int = 8         # label (key,value) pairs per node
+    max_taints: int = 4         # taints per node
+    max_tolerations: int = 4    # tolerations per pod
+    max_selector_pairs: int = 4  # pod.spec.node_selector entries
+    max_affinity_terms: int = 2  # ORed NodeSelectorTerms (required affinity)
+    max_exprs_per_term: int = 4  # ANDed expressions per term
+    max_values_per_expr: int = 4  # values per In/NotIn expression
+    max_preferred_terms: int = 2  # preferred node-affinity terms
+    max_ports: int = 8          # host ports in use per node
+    max_pod_ports: int = 4      # host ports requested per pod
+    max_images: int = 4         # images per node / per pod
+
+
+DEFAULT_ENCODING = EncodingConfig()
+
+
+def _h(s: str) -> int:
+    """Deterministic 32-bit string hash, never the 0 sentinel."""
+    v = zlib.crc32(s.encode()) & 0xFFFFFFFF
+    v = v if v != 0 else 1
+    # map to int32 range
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
+def pair_hash(key: str, value: str) -> int:
+    return _h(f"{key}={value}")
+
+
+def key_hash(key: str) -> int:
+    return _h(key)
+
+
+def name_suffix_digit(name: str) -> int:
+    """Trailing decimal suffix of a name, -1 if none (reference
+    minisched/plugins/score/nodenumber/nodenumber.go:50-64 uses the LAST
+    character only; we keep that exact semantic: last char digit or -1)."""
+    if name and name[-1].isdigit():
+        return int(name[-1])
+    return -1
+
+
+def resources_vector(rl: obj.ResourceList) -> np.ndarray:
+    v = np.zeros(NUM_RESOURCES, dtype=np.float32)
+    for name, qty in rl.items():
+        idx = obj.RESOURCE_INDEX.get(name)
+        if idx is not None:
+            v[idx] = float(qty)
+    return v
+
+
+class NodeFeatures(NamedTuple):
+    """Dense per-node features, shape leading dim N (padded)."""
+
+    valid: np.ndarray          # (N,) bool — padding / tombstone mask
+    unschedulable: np.ndarray  # (N,) bool
+    allocatable: np.ndarray    # (N,R) f32
+    free: np.ndarray           # (N,R) f32 — allocatable minus bound requests
+    name_suffix: np.ndarray    # (N,) i32
+    label_pairs: np.ndarray    # (N,L) i32 hash(key=value)
+    label_keys: np.ndarray     # (N,L) i32 hash(key)
+    taint_pairs: np.ndarray    # (N,T) i32
+    taint_keys: np.ndarray     # (N,T) i32
+    taint_effects: np.ndarray  # (N,T) i32
+    used_ports: np.ndarray     # (N,PORT) i32
+    images: np.ndarray         # (N,IM) i32
+
+
+class PodFeatures(NamedTuple):
+    """Dense per-pod features, shape leading dim P (padded)."""
+
+    valid: np.ndarray        # (P,) bool
+    requests: np.ndarray     # (P,R) f32 (includes the implicit pods:1 slot)
+    name_suffix: np.ndarray  # (P,) i32
+    priority: np.ndarray     # (P,) i32
+    sel_pairs: np.ndarray    # (P,Q) i32 — node_selector, ANDed pair hashes
+    aff_op: np.ndarray       # (P,T,E) i32 — required node affinity
+    aff_key: np.ndarray      # (P,T,E) i32
+    aff_vals: np.ndarray     # (P,T,E,V) i32
+    aff_has: np.ndarray      # (P,) bool — pod has required affinity terms
+    pref_weight: np.ndarray  # (P,T2) f32 — preferred node affinity
+    pref_op: np.ndarray      # (P,T2,E) i32
+    pref_key: np.ndarray     # (P,T2,E) i32
+    pref_vals: np.ndarray    # (P,T2,E,V) i32
+    tol_pairs: np.ndarray    # (P,K) i32
+    tol_keys: np.ndarray     # (P,K) i32
+    tol_ops: np.ndarray      # (P,K) i32
+    tol_effects: np.ndarray  # (P,K) i32
+    ports: np.ndarray        # (P,PP) i32 host ports requested
+    images: np.ndarray       # (P,IM) i32
+
+
+def empty_node_features(n: int, cfg: EncodingConfig = DEFAULT_ENCODING) -> NodeFeatures:
+    return NodeFeatures(
+        valid=np.zeros(n, dtype=bool),
+        unschedulable=np.zeros(n, dtype=bool),
+        allocatable=np.zeros((n, NUM_RESOURCES), dtype=np.float32),
+        free=np.zeros((n, NUM_RESOURCES), dtype=np.float32),
+        name_suffix=np.full(n, -1, dtype=np.int32),
+        label_pairs=np.zeros((n, cfg.max_labels), dtype=np.int32),
+        label_keys=np.zeros((n, cfg.max_labels), dtype=np.int32),
+        taint_pairs=np.zeros((n, cfg.max_taints), dtype=np.int32),
+        taint_keys=np.zeros((n, cfg.max_taints), dtype=np.int32),
+        taint_effects=np.zeros((n, cfg.max_taints), dtype=np.int32),
+        used_ports=np.zeros((n, cfg.max_ports), dtype=np.int32),
+        images=np.zeros((n, cfg.max_images), dtype=np.int32),
+    )
+
+
+def _fill_slots(dst: np.ndarray, values: List[int], what: str,
+                overflow: Optional[List[str]] = None) -> None:
+    k = min(len(values), dst.shape[0])
+    if len(values) > dst.shape[0] and overflow is not None:
+        overflow.append(f"{what}: {len(values)} > {dst.shape[0]} slots")
+    dst[:k] = values[:k]
+
+
+def encode_node_into(feats: NodeFeatures, i: int, node: Node,
+                     overflow: Optional[List[str]] = None) -> None:
+    """Write node's features into row ``i`` of pre-allocated arrays."""
+    cfg_labels = feats.label_pairs.shape[1]
+    feats.valid[i] = True
+    feats.unschedulable[i] = node.spec.unschedulable
+    feats.allocatable[i] = resources_vector(node.status.allocatable)
+    feats.name_suffix[i] = name_suffix_digit(node.metadata.name)
+
+    labels = list(node.metadata.labels.items())
+    if len(labels) > cfg_labels and overflow is not None:
+        overflow.append(f"node {node.key} labels: {len(labels)} > {cfg_labels}")
+    feats.label_pairs[i] = 0
+    feats.label_keys[i] = 0
+    for j, (k, v) in enumerate(labels[:cfg_labels]):
+        feats.label_pairs[i, j] = pair_hash(k, v)
+        feats.label_keys[i, j] = key_hash(k)
+
+    feats.taint_pairs[i] = 0
+    feats.taint_keys[i] = 0
+    feats.taint_effects[i] = EFFECT_NONE
+    taints = node.spec.taints
+    if len(taints) > feats.taint_pairs.shape[1] and overflow is not None:
+        overflow.append(f"node {node.key} taints overflow")
+    for j, t in enumerate(taints[:feats.taint_pairs.shape[1]]):
+        feats.taint_pairs[i, j] = pair_hash(t.key, t.value)
+        feats.taint_keys[i, j] = key_hash(t.key)
+        feats.taint_effects[i, j] = _EFFECT_CODE.get(t.effect, EFFECT_NO_SCHEDULE)
+
+    feats.images[i] = 0
+    _fill_slots(feats.images[i], [_h(im) for im in node.status.images],
+                f"node {node.key} images", overflow)
+
+
+def clear_node_row(feats: NodeFeatures, i: int) -> None:
+    feats.valid[i] = False
+    feats.unschedulable[i] = False
+    feats.allocatable[i] = 0
+    feats.free[i] = 0
+    feats.name_suffix[i] = -1
+    feats.label_pairs[i] = 0
+    feats.label_keys[i] = 0
+    feats.taint_pairs[i] = 0
+    feats.taint_keys[i] = 0
+    feats.taint_effects[i] = EFFECT_NONE
+    feats.used_ports[i] = 0
+    feats.images[i] = 0
+
+
+def _encode_term_exprs(op_row, key_row, val_row, exprs, overflow, what):
+    """Encode ANDed NodeSelectorRequirements into one term's slots."""
+    e_max, v_max = val_row.shape
+    if len(exprs) > e_max and overflow is not None:
+        overflow.append(f"{what}: {len(exprs)} exprs > {e_max} slots")
+    for e, req in enumerate(exprs[:e_max]):
+        code = _OP_CODE.get(req.operator)
+        if code is None:
+            # Gt/Lt not representable densely; treat as unsupported and
+            # record so the caller can fall back (SURVEY hard-parts note).
+            if overflow is not None:
+                overflow.append(f"{what}: unsupported operator {req.operator}")
+            continue
+        op_row[e] = code
+        key_row[e] = key_hash(req.key)
+        vals = [pair_hash(req.key, v) for v in req.values]
+        if len(vals) > v_max and overflow is not None:
+            overflow.append(f"{what}: {len(vals)} values > {v_max} slots")
+        val_row[e, :min(len(vals), v_max)] = vals[:v_max]
+
+
+def encode_pods(pods: List[Pod], p_pad: int,
+                cfg: EncodingConfig = DEFAULT_ENCODING,
+                overflow: Optional[List[str]] = None) -> PodFeatures:
+    """Encode a batch of pending pods, padded to ``p_pad`` rows."""
+    P = p_pad
+    f = PodFeatures(
+        valid=np.zeros(P, dtype=bool),
+        requests=np.zeros((P, NUM_RESOURCES), dtype=np.float32),
+        name_suffix=np.full(P, -1, dtype=np.int32),
+        priority=np.zeros(P, dtype=np.int32),
+        sel_pairs=np.zeros((P, cfg.max_selector_pairs), dtype=np.int32),
+        aff_op=np.zeros((P, cfg.max_affinity_terms, cfg.max_exprs_per_term), dtype=np.int32),
+        aff_key=np.zeros((P, cfg.max_affinity_terms, cfg.max_exprs_per_term), dtype=np.int32),
+        aff_vals=np.zeros((P, cfg.max_affinity_terms, cfg.max_exprs_per_term,
+                           cfg.max_values_per_expr), dtype=np.int32),
+        aff_has=np.zeros(P, dtype=bool),
+        pref_weight=np.zeros((P, cfg.max_preferred_terms), dtype=np.float32),
+        pref_op=np.zeros((P, cfg.max_preferred_terms, cfg.max_exprs_per_term), dtype=np.int32),
+        pref_key=np.zeros((P, cfg.max_preferred_terms, cfg.max_exprs_per_term), dtype=np.int32),
+        pref_vals=np.zeros((P, cfg.max_preferred_terms, cfg.max_exprs_per_term,
+                            cfg.max_values_per_expr), dtype=np.int32),
+        tol_pairs=np.zeros((P, cfg.max_tolerations), dtype=np.int32),
+        tol_keys=np.zeros((P, cfg.max_tolerations), dtype=np.int32),
+        tol_ops=np.zeros((P, cfg.max_tolerations), dtype=np.int32),
+        tol_effects=np.zeros((P, cfg.max_tolerations), dtype=np.int32),
+        ports=np.zeros((P, cfg.max_pod_ports), dtype=np.int32),
+        images=np.zeros((P, cfg.max_images), dtype=np.int32),
+    )
+    for i, pod in enumerate(pods):
+        if i >= P:
+            raise ValueError(f"{len(pods)} pods > pad {P}")
+        f.valid[i] = True
+        f.requests[i] = resources_vector(obj.pod_requests(pod))
+        f.name_suffix[i] = name_suffix_digit(pod.metadata.name)
+        f.priority[i] = pod.spec.priority
+
+        sel = list(pod.spec.node_selector.items())
+        if len(sel) > cfg.max_selector_pairs and overflow is not None:
+            overflow.append(f"pod {pod.key} node_selector overflow")
+        for j, (k, v) in enumerate(sel[:cfg.max_selector_pairs]):
+            f.sel_pairs[i, j] = pair_hash(k, v)
+
+        aff = pod.spec.affinity
+        na = aff.node_affinity if aff else None
+        if na and na.required and na.required.node_selector_terms:
+            terms = na.required.node_selector_terms
+            if len(terms) > cfg.max_affinity_terms and overflow is not None:
+                overflow.append(f"pod {pod.key} affinity terms overflow")
+            f.aff_has[i] = True
+            for t, term in enumerate(terms[:cfg.max_affinity_terms]):
+                _encode_term_exprs(f.aff_op[i, t], f.aff_key[i, t],
+                                   f.aff_vals[i, t], term.match_expressions,
+                                   overflow, f"pod {pod.key} affinity term {t}")
+        if na and na.preferred:
+            prefs = na.preferred
+            if len(prefs) > cfg.max_preferred_terms and overflow is not None:
+                overflow.append(f"pod {pod.key} preferred affinity overflow")
+            for t, pt in enumerate(prefs[:cfg.max_preferred_terms]):
+                f.pref_weight[i, t] = float(pt.weight)
+                _encode_term_exprs(f.pref_op[i, t], f.pref_key[i, t],
+                                   f.pref_vals[i, t], pt.preference.match_expressions,
+                                   overflow, f"pod {pod.key} preferred term {t}")
+
+        tols = pod.spec.tolerations
+        if len(tols) > cfg.max_tolerations and overflow is not None:
+            overflow.append(f"pod {pod.key} tolerations overflow")
+        for j, tol in enumerate(tols[:cfg.max_tolerations]):
+            f.tol_ops[i, j] = TOL_EXISTS if tol.operator == "Exists" else TOL_EQUAL
+            f.tol_keys[i, j] = key_hash(tol.key) if tol.key else 0
+            f.tol_pairs[i, j] = pair_hash(tol.key, tol.value) if tol.operator != "Exists" else 0
+            f.tol_effects[i, j] = _EFFECT_CODE.get(tol.effect, EFFECT_NONE) if tol.effect else EFFECT_NONE
+
+        host_ports = [p.host_port for p in pod.spec.ports if p.host_port]
+        _fill_slots(f.ports[i], host_ports, f"pod {pod.key} host ports", overflow)
+        _fill_slots(f.images[i], [_h(im) for im in pod.spec.images],
+                    f"pod {pod.key} images", overflow)
+    return f

@@ -1,0 +1,3 @@
+from .queue import SchedulingQueue, QueuedPodInfo  # noqa: F401
+from .waitingpod import WaitingPod  # noqa: F401
+from .scheduler import Scheduler  # noqa: F401

@@ -1,0 +1,85 @@
+"""Informer wiring for the scheduler engine.
+
+Rebuild of reference minisched/eventhandler.go:14-90:
+  * unscheduled-pod add → queue.add (eventhandler.go:20-35)
+  * assigned-pod add/update → feature-cache accounting + requeue signal for
+    pod-affinity-style plugins (the reference wires assignedPod handlers to
+    panic-stubs; implemented here)
+  * per-GVK add/update/delete → queue.move_all_to_active_or_backoff(event)
+    (eventhandler.go:37-58) — the reference only wires Node (others are
+    commented out, eventhandler.go:60-76); here all store kinds are wired.
+  * node events additionally maintain the incremental feature cache
+    (SURVEY §2 "events invalidate cached TPU-side node feature matrix").
+"""
+from __future__ import annotations
+
+from ..state.events import ActionType, ClusterEvent, GVK, watch_to_cluster_event
+from ..state.informer import InformerFactory, ResourceEventHandlers
+from ..state.store import EventType, WatchEvent
+
+
+def add_all_event_handlers(sched, factory: InformerFactory) -> None:
+    """sched: engine.Scheduler (duck-typed: .queue, .cache)."""
+
+    # --- pods: unscheduled → queue; assigned → cache accounting ---------
+    def pod_add(pod):
+        if not pod.spec.node_name:
+            sched.queue.add(pod)
+        else:
+            sched.cache.account_bind(pod)
+            sched.queue.move_all_to_active_or_backoff(
+                ClusterEvent(GVK.POD, ActionType.ADD))
+
+    def pod_update(old, new):
+        if not new.spec.node_name:
+            sched.queue.update(old, new)
+        elif not old.spec.node_name:
+            # became bound: idempotent accounting (the scheduler assumes
+            # the pod at selection time; this is the confirm path)
+            sched.cache.account_bind(new)
+        else:
+            sched.queue.move_all_to_active_or_backoff(
+                ClusterEvent(GVK.POD, ActionType.UPDATE))
+
+    def pod_delete(pod):
+        if pod.spec.node_name:
+            sched.cache.account_unbind(pod.key)
+            # freed capacity may make parked pods schedulable
+            sched.queue.move_all_to_active_or_backoff(
+                ClusterEvent(GVK.POD, ActionType.DELETE))
+        else:
+            sched.queue.delete(pod)
+
+    factory.add_handlers("Pod", ResourceEventHandlers(
+        on_add=pod_add, on_update=pod_update, on_delete=pod_delete))
+
+    # --- nodes: feature cache + requeue gating --------------------------
+    def node_add(node):
+        sched.cache.upsert_node(node)
+        sched.queue.move_all_to_active_or_backoff(
+            ClusterEvent(GVK.NODE, ActionType.ADD))
+
+    def node_update(old, new):
+        sched.cache.upsert_node(new)
+        ev = watch_to_cluster_event(
+            WatchEvent(EventType.MODIFIED, GVK.NODE, new, old))
+        sched.queue.move_all_to_active_or_backoff(ev)
+
+    def node_delete(node):
+        sched.cache.remove_node(node.metadata.name)
+        sched.queue.move_all_to_active_or_backoff(
+            ClusterEvent(GVK.NODE, ActionType.DELETE))
+
+    factory.add_handlers("Node", ResourceEventHandlers(
+        on_add=node_add, on_update=node_update, on_delete=node_delete))
+
+    # --- volumes: requeue gating only -----------------------------------
+    for kind in (GVK.PERSISTENT_VOLUME, GVK.PERSISTENT_VOLUME_CLAIM):
+        factory.add_handlers(kind, ResourceEventHandlers(
+            on_add=lambda o, k=kind: sched.queue.move_all_to_active_or_backoff(
+                ClusterEvent(k, ActionType.ADD)),
+            on_update=lambda old, new, k=kind: sched.queue.move_all_to_active_or_backoff(
+                ClusterEvent(k, ActionType.UPDATE)),
+            on_delete=lambda o, k=kind: sched.queue.move_all_to_active_or_backoff(
+                ClusterEvent(k, ActionType.DELETE)),
+        ))

@@ -1,0 +1,259 @@
+"""Core scheduling engine: batched scheduling cycles over the XLA step.
+
+Rebuild of reference minisched/minisched.go + initialize.go. One cycle
+(reference scheduleOne, minisched.go:32-112) becomes one *batch* cycle:
+
+  pop batch (queue) → encode pods → snapshot node features (cache) →
+  jitted step: filters ∧ → scores → normalize → weigh → sum → greedy
+  capacity-aware assignment → per-pod: permit plugins (host) →
+  async binding cycle (thread pool) → bind CAS into the store.
+
+The scheduler "assumes" a pod onto its node at selection time (cache
+accounting) and unassumes on any later failure — upstream kube-scheduler's
+assume/forget model, which the reference skips (its sequential loop re-Lists
+nodes every pod, minisched.go:40, so stale capacity only costs retries).
+
+Failure path mirrors ErrorFunc (minisched.go:283-298): record the rejecting
+plugins on the pod status, emit a FailedScheduling event, park the pod in
+unschedulableQ keyed by those plugins for event-driven revival.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Set
+
+import jax
+import numpy as np
+
+from ..config import SchedulerConfig
+from ..encode import NodeFeatureCache, encode_pods
+from ..encode.cache import bucket_for
+from ..errors import ConflictError, NotFoundError
+from ..ops.pipeline import Decision, build_step
+from ..plugins.base import PluginSet
+from ..state.events import ActionType, ClusterEvent, EventBroadcaster, GVK
+from ..state.informer import InformerFactory
+from ..state.objects import Pod, deepcopy_obj
+from . import eventhandlers
+from .queue import BATCH_CAPACITY, QueuedPodInfo, SchedulingQueue
+from .waitingpod import WaitingPod
+
+log = logging.getLogger(__name__)
+
+
+class Scheduler:
+    def __init__(self, store, plugin_set: PluginSet,
+                 config: Optional[SchedulerConfig] = None,
+                 recorder=None):
+        self.store = store
+        self.plugin_set = plugin_set
+        self.config = config or SchedulerConfig()
+        self.recorder = recorder  # explainability hook (explain/resultstore)
+        self.cache = NodeFeatureCache()
+        self.broadcaster = EventBroadcaster(store)
+
+        event_map = plugin_set.cluster_event_map()
+        # In-batch capacity losses and bind conflicts are revivable by any
+        # node add/update or assigned-pod delete (capacity freed).
+        cap_interest = {
+            ClusterEvent(GVK.NODE, ActionType.ADD | ActionType.UPDATE),
+            ClusterEvent(GVK.POD, ActionType.DELETE),
+        }
+        for ev in cap_interest:
+            event_map.setdefault(ev, set()).add(BATCH_CAPACITY)
+
+        self.queue = SchedulingQueue(
+            event_map,
+            backoff_initial=self.config.backoff_initial_s,
+            backoff_max=self.config.backoff_max_s)
+
+        self.informer_factory = InformerFactory(store)
+        eventhandlers.add_all_event_handlers(self, self.informer_factory)
+
+        self._step = build_step(plugin_set, explain=self.config.explain)
+        self._key = jax.random.PRNGKey(self.config.seed)
+        self._step_counter = 0
+        self.waiting_pods: Dict[str, WaitingPod] = {}
+        self._waiting_lock = threading.Lock()
+        self._binder = ThreadPoolExecutor(
+            max_workers=self.config.bind_workers, thread_name_prefix="binder")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.filter_names = [p.name for p in plugin_set.filter_plugins]
+
+    # ---- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        """Start informers + the scheduling loop (reference
+        scheduler/scheduler.go:72-75: factory.Start, WaitForCacheSync,
+        go sched.Run)."""
+        self.informer_factory.start()
+        self.informer_factory.wait_for_cache_sync()
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name="scheduling-loop")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.queue.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.informer_factory.shutdown()
+        self._binder.shutdown(wait=False)
+
+    def run(self) -> None:
+        """The scheduling loop (reference minisched.go:28-30
+        wait.UntilWithContext(ctx, scheduleOne, 0)) — here each iteration
+        schedules a whole batch."""
+        while not self._stop.is_set():
+            batch = self.queue.pop_batch(self.config.max_batch_size, timeout=0.2)
+            if batch:
+                try:
+                    self.schedule_batch(batch)
+                except Exception:
+                    log.exception("schedule_batch failed; requeueing batch")
+                    for qpi in batch:
+                        self.queue.requeue_backoff(qpi)
+
+    # ---- one batched scheduling cycle ----------------------------------
+
+    def schedule_batch(self, batch: List[QueuedPodInfo]) -> Decision:
+        cfg = self.config
+        batch = sorted(batch, key=lambda q: -q.pod.spec.priority)
+        pods = [q.pod for q in batch]
+
+        nf, names = self.cache.snapshot()
+        pf = encode_pods(pods, bucket_for(len(pods), cfg.pod_bucket_min))
+
+        self._step_counter += 1
+        key = jax.random.fold_in(self._key, self._step_counter)
+        decision: Decision = self._step(pf, nf, key)
+
+        chosen = np.asarray(decision.chosen)
+        assigned = np.asarray(decision.assigned)
+        feasible = np.asarray(decision.feasible_counts)
+        rejects = np.asarray(decision.reject_counts)
+
+        if self.recorder is not None:
+            self.recorder.record_batch(pods, names, decision, self.plugin_set)
+
+        for i, qpi in enumerate(batch):
+            if assigned[i]:
+                node_name = names[int(chosen[i])]
+                self._start_binding_cycle(qpi, node_name)
+            elif feasible[i] > 0:
+                # Nodes were feasible but earlier pods in the batch took the
+                # capacity — retryable, not unschedulable (SURVEY §7
+                # "batch-internal causality").
+                self._handle_failure(
+                    qpi, {BATCH_CAPACITY},
+                    "ran out of capacity within scheduling batch",
+                    retryable=True)
+            else:
+                plugins = {self.filter_names[f] for f in range(rejects.shape[0])
+                           if rejects[f, i] > 0} or {BATCH_CAPACITY}
+                self._handle_failure(
+                    qpi, plugins,
+                    f"0/{self.cache.node_count()} nodes are available: "
+                    f"rejected by {sorted(plugins)}",
+                    retryable=False)
+        return decision
+
+    # ---- permit + binding cycle ----------------------------------------
+
+    def _start_binding_cycle(self, qpi: QueuedPodInfo, node_name: str) -> None:
+        pod = qpi.pod
+        # Assume the pod onto the node immediately so the next batch's
+        # snapshot sees the capacity taken (upstream assume/forget model).
+        assumed = deepcopy_obj(pod)
+        assumed.spec.node_name = node_name
+        self.cache.account_bind(assumed)
+
+        waits = []
+        for plugin in self.plugin_set.permit_plugins:
+            try:
+                status, delay, timeout = plugin.permit(pod, node_name)
+            except Exception:
+                log.exception("permit plugin %s failed", plugin.name)
+                status, delay, timeout = "reject", 0.0, 0.0
+            if status == "reject":
+                self._unassume(qpi)
+                self._handle_failure(
+                    qpi, {plugin.name},
+                    f"pod rejected by permit plugin {plugin.name}",
+                    retryable=False)
+                return
+            if status == "wait":
+                waits.append((plugin.name, delay, timeout))
+
+        if waits:
+            # Park the pod (reference RunPermitPlugins Wait status →
+            # WaitingPod + timers, minisched.go:228-234), then bind async.
+            wp = WaitingPod(pod, node_name, waits)
+            with self._waiting_lock:
+                self.waiting_pods[pod.key] = wp
+            max_timeout = max(t for _, _, t in waits)
+            self._binder.submit(self._wait_and_bind, qpi, wp, max_timeout)
+        else:
+            # Binding still runs async (reference forks a goroutine per pod,
+            # minisched.go:96-112).
+            self._binder.submit(self._bind, qpi, node_name)
+
+    def _wait_and_bind(self, qpi: QueuedPodInfo, wp: WaitingPod,
+                       max_timeout: float) -> None:
+        sig = wp.get_signal(timeout=max_timeout + 1.0)
+        with self._waiting_lock:
+            self.waiting_pods.pop(qpi.pod.key, None)
+        if sig is None or not sig.allowed:
+            reason = sig.reason if sig else "permit wait timed out"
+            self._unassume(qpi)
+            self._handle_failure(
+                qpi, {name for name, _, _ in wp.waits},
+                f"WaitOnPermit failed: {reason}", retryable=False)
+            return
+        self._bind(qpi, wp.node_name)
+
+    def _bind(self, qpi: QueuedPodInfo, node_name: str) -> None:
+        pod = qpi.pod
+        try:
+            bound = self.store.bind_pod(pod.key, node_name)
+        except (ConflictError, NotFoundError) as e:
+            self._unassume(qpi)
+            try:
+                self.store.get("Pod", pod.key)
+            except NotFoundError:
+                self.queue.forget(pod.key)  # pod is gone; drop it
+                return
+            log.warning("bind of %s to %s failed: %s", pod.key, node_name, e)
+            self.queue.requeue_backoff(qpi)
+            return
+        self.queue.forget(pod.key)
+        self.broadcaster.scheduled(bound, node_name)
+        log.info("bound %s to %s", pod.key, node_name)
+
+    def _unassume(self, qpi: QueuedPodInfo) -> None:
+        self.cache.account_unbind(qpi.pod.key)
+
+    # ---- failure path (reference ErrorFunc minisched.go:283-298) --------
+
+    def _handle_failure(self, qpi: QueuedPodInfo, plugins: Set[str],
+                        message: str, *, retryable: bool) -> None:
+        pod = qpi.pod
+        self.broadcaster.failed_scheduling(pod, message)
+        try:
+            fresh = self.store.get("Pod", pod.key)
+            if not fresh.spec.node_name:
+                fresh.status.unschedulable_plugins = sorted(plugins)
+                fresh.status.message = message
+                self.store.update(fresh)
+                qpi.pod = fresh
+        except NotFoundError:
+            self.queue.forget(pod.key)
+            return
+        if retryable:
+            self.queue.requeue_backoff(qpi)
+        else:
+            self.queue.add_unschedulable(qpi, plugins)

@@ -1,0 +1,87 @@
+"""Permit-wait machinery: pods parked between selection and binding.
+
+Faithful host-side rebuild of reference minisched/waitingpod/waitingpod.go:
+a WaitingPod holds one pending entry per permit plugin that returned "wait";
+per-plugin timers auto-Reject at the plugin's timeout
+(waitingpod.go:42-49); Allow succeeds (signals the binding cycle) only when
+the LAST pending plugin allows (waitingpod.go:80-91); the signal channel is
+buffered size 1 with non-blocking send (waitingpod.go:93-98,109-114) — here
+a queue.Queue(maxsize=1) with put_nowait.
+
+Plugins that returned ("wait", delay, timeout) additionally get an
+auto-Allow timer after `delay` (the reference's NodeNumber schedules its own
+time.AfterFunc → Allow, nodenumber.go:112-115; we run that timer here so
+plugins stay pure).
+"""
+from __future__ import annotations
+
+import queue as pyqueue
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..state.objects import Pod
+
+
+class Signal:
+    def __init__(self, allowed: bool, reason: str = ""):
+        self.allowed = allowed
+        self.reason = reason
+
+
+class WaitingPod:
+    def __init__(self, pod: Pod, node_name: str,
+                 waits: List[Tuple[str, float, float]]):
+        """waits: [(plugin_name, auto_allow_delay_s, timeout_s)]"""
+        self.pod = pod
+        self.node_name = node_name
+        self.waits = list(waits)
+        self._lock = threading.Lock()
+        self._pending: Dict[str, bool] = {name: True for name, _, _ in waits}
+        self._signal: pyqueue.Queue = pyqueue.Queue(maxsize=1)
+        self._timers: List[threading.Timer] = []
+        for name, delay, timeout in waits:
+            if timeout > 0:
+                t = threading.Timer(
+                    timeout, self.reject, args=(name, f"{name} timeout"))
+                t.daemon = True
+                self._timers.append(t)
+            if 0 < delay < (timeout if timeout > 0 else float("inf")):
+                t = threading.Timer(delay, self.allow, args=(name,))
+                t.daemon = True
+                self._timers.append(t)
+        for t in self._timers:
+            t.start()
+
+    def allow(self, plugin_name: str) -> None:
+        """Mark one plugin allowed; when none remain pending, signal success
+        (reference waitingpod.go:80-98)."""
+        with self._lock:
+            self._pending.pop(plugin_name, None)
+            if self._pending:
+                return
+            self._cancel_timers()
+            self._send(Signal(True))
+
+    def reject(self, plugin_name: str, reason: str = "") -> None:
+        """Any rejection fails the pod immediately (waitingpod.go:102-114)."""
+        with self._lock:
+            self._cancel_timers()
+            self._send(Signal(False, reason or f"rejected by {plugin_name}"))
+
+    def get_signal(self, timeout: Optional[float] = None) -> Optional[Signal]:
+        """Block until Allow-complete or Reject (reference GetSignal chan
+        recv at minisched.go:240-264 WaitOnPermit)."""
+        try:
+            return self._signal.get(timeout=timeout)
+        except pyqueue.Empty:
+            return None
+
+    def _send(self, sig: Signal) -> None:
+        try:
+            self._signal.put_nowait(sig)
+        except pyqueue.Full:  # non-blocking send: first signal wins
+            pass
+
+    def _cancel_timers(self) -> None:
+        for t in self._timers:
+            t.cancel()

@@ -1,0 +1,121 @@
+"""Per-decision explainability store.
+
+Rebuild of the reference's result-recording capability (reference
+scheduler/plugin/resultstore/store.go): for every scheduling attempt, the
+per-node, per-plugin filter verdicts and raw/weighted-normalized scores are
+published as JSON pod annotations (keys in annotation.py, identical to
+reference annotation/annotation.go:5-9), retried with exponential backoff
+(reference store.go:120-131 → util/retry.go:18), then evicted from memory
+(store.go:134,236-238).
+
+In the batched world this is nearly free (SURVEY §7 step 6): the per-plugin
+(P × N) mask/score matrices already exist as the explain-mode outputs of the
+XLA step; recording slices rows out of them.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..errors import ConflictError, NotFoundError
+from ..utils.retry import retry_with_exponential_backoff
+
+log = logging.getLogger(__name__)
+
+PASSED = "passed"
+
+
+class ResultStore:
+    """Records batched-step results and flushes them as pod annotations."""
+
+    def __init__(self, store, *, flush: bool = True,
+                 retry_initial_s: float = 0.05, retry_steps: int = 6):
+        self._cluster = store
+        self._flush = flush
+        self._lock = threading.Lock()
+        # pod key → {"filter": {node: {plugin: str}},
+        #            "score": {node: {plugin: float}},
+        #            "finalscore": {node: {plugin: float}}}
+        self._results: Dict[str, Dict[str, Dict[str, Dict[str, object]]]] = {}
+        self._retry_initial = retry_initial_s
+        self._retry_steps = retry_steps
+
+    # ---- recording (called by the engine after each step) ---------------
+
+    def record_batch(self, pods, names, decision, plugin_set) -> None:
+        filter_masks = np.asarray(decision.filter_masks)   # (F,P,N)
+        raw = np.asarray(decision.raw_scores)              # (S,P,N)
+        norm = np.asarray(decision.norm_scores)            # (S,P,N)
+        if filter_masks.shape[0] == 0 and raw.shape[0] == 0:
+            return  # engine compiled with explain=False
+        fnames = [p.name for p in plugin_set.filter_plugins]
+        snames = [p.name for p in plugin_set.score_plugins]
+        weights = [plugin_set.weight_of(p) for p in plugin_set.score_plugins]
+        node_idx = [(j, n) for j, n in enumerate(names) if n is not None]
+
+        with self._lock:
+            for i, pod in enumerate(pods):
+                fr = {n: {fnames[f]: (PASSED if filter_masks[f, i, j]
+                                      else "node(s) didn't pass the filter")
+                          for f in range(len(fnames))}
+                      for j, n in node_idx}
+                sr = {n: {snames[s]: float(raw[s, i, j])
+                          for s in range(len(snames))}
+                      for j, n in node_idx}
+                fs = {n: {snames[s]: float(norm[s, i, j] * weights[s])
+                          for s in range(len(snames))}
+                      for j, n in node_idx}
+                self._results[pod.key] = {"filter": fr, "score": sr,
+                                          "finalscore": fs}
+        if self._flush:
+            for pod in pods:
+                self.flush_pod(pod.key)
+
+    # ---- flushing (reference addSchedulingResultToPod store.go:90-135) --
+
+    def flush_pod(self, key: str) -> bool:
+        from .annotation import (FILTER_RESULT_KEY, FINAL_SCORE_RESULT_KEY,
+                                 SCORE_RESULT_KEY)
+
+        with self._lock:
+            data = self._results.get(key)
+        if data is None:
+            return True
+
+        def attempt() -> bool:
+            try:
+                pod = self._cluster.get("Pod", key)
+            except NotFoundError:
+                return True  # pod gone; nothing to annotate
+            pod.metadata.annotations[FILTER_RESULT_KEY] = json.dumps(
+                data["filter"], sort_keys=True)
+            pod.metadata.annotations[SCORE_RESULT_KEY] = json.dumps(
+                data["score"], sort_keys=True)
+            pod.metadata.annotations[FINAL_SCORE_RESULT_KEY] = json.dumps(
+                data["finalscore"], sort_keys=True)
+            try:
+                self._cluster.update(pod)
+                return True
+            except (ConflictError, NotFoundError):
+                return False
+
+        ok = retry_with_exponential_backoff(
+            attempt, initial_duration=self._retry_initial,
+            steps=self._retry_steps)
+        if ok:
+            self.delete_data(key)  # evict on success (store.go:134)
+        else:
+            log.warning("failed to flush scheduling results for %s", key)
+        return ok
+
+    def delete_data(self, key: str) -> None:
+        with self._lock:
+            self._results.pop(key, None)
+
+    def pending_keys(self) -> List[str]:
+        with self._lock:
+            return list(self._results)

@@ -1,0 +1,2 @@
+from .pipeline import Decision, build_step  # noqa: F401
+from .select import greedy_assign  # noqa: F401

@@ -1,0 +1,105 @@
+"""Reusable dense matchers over hashed label/taint features.
+
+Each matcher is a pure jnp function over (P, …) pod features × (N, …) node
+features returning a (P, N) matrix — the batched counterpart of the per-pair
+Go predicates the reference's plugins evaluate one node at a time (reference
+minisched/minisched.go:124-137). 0 is the empty-slot sentinel everywhere.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..encode import features as F
+
+
+def pairs_subset(query: jnp.ndarray, node_pairs: jnp.ndarray) -> jnp.ndarray:
+    """All non-empty query pair hashes present in node label pairs.
+
+    query: (P, Q) i32, node_pairs: (N, L) i32 → (P, N) bool.
+    The dense form of pod.spec.node_selector matching (ANDed key=value).
+    """
+    # (P, Q, N, L) equality reduced over L then ANDed over Q.
+    present = (query[:, :, None, None] == node_pairs[None, None, :, :]).any(-1)
+    return jnp.where(query[:, :, None] != 0, present, True).all(axis=1)
+
+
+def term_matches(op: jnp.ndarray, key: jnp.ndarray, vals: jnp.ndarray,
+                 node_pairs: jnp.ndarray, node_keys: jnp.ndarray) -> jnp.ndarray:
+    """Evaluate ORed NodeSelectorTerms of ANDed expressions.
+
+    op/key: (P, T, E) i32, vals: (P, T, E, V) i32,
+    node_pairs/node_keys: (N, L) i32 → (P, N) bool (any term, all exprs).
+    Operators: In / NotIn / Exists / DoesNotExist (feature encoding codes).
+    A term with no expressions (all op == 0) does not match (upstream
+    semantics: empty term list ⇒ no restriction is handled by the caller).
+    """
+    # value membership: any encoded value-pair present on the node
+    # (P,T,E,V,N,L) is never materialized — XLA fuses the reductions.
+    val_in = (vals[..., None, None] == node_pairs[None, None, None, None, :, :]).any(-1).any(-2)
+    # key presence on node: (P,T,E,N)
+    key_in = (key[..., None, None] == node_keys[None, None, None, :, :]).any(-1)
+
+    expr_ok = _select_expr(op, val_in, key_in)
+
+    empty = op == F.OP_NONE  # (P,T,E)
+    all_exprs = jnp.where(empty[..., None], True, expr_ok).all(axis=2)  # (P,T,N)
+    term_nonempty = (~empty).any(axis=2)  # (P,T)
+    return (all_exprs & term_nonempty[..., None]).any(axis=1)  # (P,N)
+
+
+def _select_expr(op, val_in, key_in):
+    op = op[..., None]  # broadcast over N
+    out = jnp.where(op == F.OP_IN, val_in, False)
+    out = jnp.where(op == F.OP_NOT_IN, ~val_in, out)
+    out = jnp.where(op == F.OP_EXISTS, key_in, out)
+    out = jnp.where(op == F.OP_DOES_NOT_EXIST, ~key_in, out)
+    return out
+
+
+def tolerations_cover(pf, taint_pairs: jnp.ndarray, taint_keys: jnp.ndarray,
+                      taint_effects: jnp.ndarray,
+                      effects_requiring_toleration: tuple) -> jnp.ndarray:
+    """(P, N) bool: every node taint with an effect in
+    ``effects_requiring_toleration`` is tolerated by the pod.
+
+    pf tol_* arrays: (P, K); node taint arrays: (N, T).
+    Upstream v1.Toleration.ToleratesTaint semantics (see objects.Toleration).
+    """
+    K = pf.tol_ops.shape[1]
+    # per (P, K, N, T): does toleration k cover taint t?
+    tk = pf.tol_keys[:, :, None, None]
+    tp = pf.tol_pairs[:, :, None, None]
+    to = pf.tol_ops[:, :, None, None]
+    te = pf.tol_effects[:, :, None, None]
+    nk = taint_keys[None, None, :, :]
+    np_ = taint_pairs[None, None, :, :]
+    ne = taint_effects[None, None, :, :]
+
+    key_ok = (tk == 0) | (tk == nk)  # empty toleration key matches any taint
+    effect_ok = (te == F.EFFECT_NONE) | (te == ne)
+    value_ok = jnp.where(to == F.TOL_EXISTS, True, tp == np_)
+    active = to != F.TOL_NONE
+    covers = active & key_ok & effect_ok & value_ok  # (P,K,N,T)
+    tolerated = covers.any(axis=1)  # (P,N,T)
+
+    needs = jnp.zeros_like(taint_effects, dtype=bool)
+    for e in effects_requiring_toleration:
+        needs |= taint_effects == e
+    return jnp.where(needs[None, :, :], tolerated, True).all(axis=2)
+
+
+def untolerated_count(pf, taint_pairs, taint_keys, taint_effects,
+                      effect: int) -> jnp.ndarray:
+    """(P, N) f32: number of node taints with ``effect`` the pod does not
+    tolerate (drives TaintToleration scoring)."""
+    tk = pf.tol_keys[:, :, None, None]
+    tp = pf.tol_pairs[:, :, None, None]
+    to = pf.tol_ops[:, :, None, None]
+    te = pf.tol_effects[:, :, None, None]
+    key_ok = (tk == 0) | (tk == taint_keys[None, None, :, :])
+    effect_ok = (te == F.EFFECT_NONE) | (te == taint_effects[None, None, :, :])
+    value_ok = jnp.where(to == F.TOL_EXISTS, True, tp == taint_pairs[None, None, :, :])
+    covers = (to != F.TOL_NONE) & key_ok & effect_ok & value_ok
+    tolerated = covers.any(axis=1)  # (P,N,T)
+    is_effect = (taint_effects == effect)[None, :, :]
+    return (is_effect & ~tolerated).sum(axis=2).astype(jnp.float32)

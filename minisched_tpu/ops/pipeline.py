@@ -1,0 +1,137 @@
+"""The batched scheduling step: one XLA program per profile.
+
+Replaces THE hot loop of the reference — scheduleOne's nested
+pods × nodes × plugins iteration plus per-pod argmax (reference
+minisched/minisched.go:32-112, SURVEY §3.3) — with a single jitted function:
+
+    filter masks (AND over plugins) → per-plugin scores → normalize →
+    weighted sum → capacity-aware greedy assignment (select.py).
+
+Per-plugin attribution survives batching (SURVEY §7 hard part "event
+semantics under batching"): the step returns per-plugin reject counts per
+pod — enough to reconstruct UnschedulablePlugins for requeue gating — and,
+in explain mode, the full per-plugin mask/score stacks for the
+explainability store (reference scheduler/plugin/resultstore capability).
+
+Weights are applied after normalization, fixing the reference's TODO at
+minisched/minisched.go:187; NormalizeScore runs once per plugin over the
+full matrix, fixing the in-loop quirk at minisched.go:178-183.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..plugins.base import PluginSet
+from .select import NEG, AssignResult, greedy_assign
+
+
+class Decision(NamedTuple):
+    """Output of one batched scheduling step (arrays padded to P/N buckets)."""
+
+    chosen: jnp.ndarray           # (P,) i32 node row, -1 unassigned
+    assigned: jnp.ndarray         # (P,) bool
+    feasible_counts: jnp.ndarray  # (P,) i32 nodes passing all filters
+    reject_counts: jnp.ndarray    # (F,P) i32 nodes rejected per filter plugin
+    total_scores: jnp.ndarray     # (P,N) f32 weighted sum (NEG on infeasible)
+    free_after: jnp.ndarray       # (N,R) f32
+    # explain mode only (else zero-size placeholders):
+    filter_masks: jnp.ndarray     # (F,P,N) bool per-plugin pass mask
+    raw_scores: jnp.ndarray       # (S,P,N) f32 pre-normalize
+    norm_scores: jnp.ndarray      # (S,P,N) f32 post-normalize, pre-weight
+
+
+_STEP_CACHE: dict = {}
+
+
+def build_step(plugin_set: PluginSet, *, explain: bool = False,
+               donate_free: bool = True):
+    """Compile the scheduling step for a plugin profile.
+
+    Returns jitted ``step(pf, nf, key) -> Decision``. pf/nf are
+    PodFeatures/NodeFeatures pytrees (numpy or jnp); shapes must be bucketed
+    by the caller — each distinct (P, N) bucket compiles once. Steps are
+    memoized on the profile's traced behavior (plugin trace keys + weights +
+    explain) so scheduler restarts and equivalent profiles reuse compiles.
+    """
+    cache_key = (
+        tuple(p.trace_key() for p in plugin_set.filter_plugins),
+        tuple((p.trace_key(), plugin_set.weight_of(p))
+              for p in plugin_set.score_plugins),
+        explain,
+    )
+    cached = _STEP_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
+    filters = plugin_set.filter_plugins
+    scorers = plugin_set.score_plugins
+    weights = [plugin_set.weight_of(p) for p in scorers]
+
+    def step(pf, nf, key) -> Decision:
+        P = pf.valid.shape[0]
+        N = nf.valid.shape[0]
+        valid_pair = pf.valid[:, None] & nf.valid[None, :]
+
+        masks = [p.filter(pf, nf) for p in filters]
+        feasible = valid_pair
+        for m in masks:
+            feasible = feasible & m
+        feasible_counts = feasible.sum(axis=1).astype(jnp.int32)
+        if masks:
+            reject_counts = jnp.stack(
+                [(valid_pair & ~m).sum(axis=1).astype(jnp.int32) for m in masks])
+        else:
+            reject_counts = jnp.zeros((0, P), dtype=jnp.int32)
+
+        total = jnp.zeros((P, N), dtype=jnp.float32)
+        raws, norms = [], []
+        for p, w in zip(scorers, weights):
+            raw = p.score(pf, nf).astype(jnp.float32)
+            norm = p.normalize(raw, feasible).astype(jnp.float32)
+            total = total + w * norm
+            if explain:
+                raws.append(raw)
+                norms.append(norm)
+
+        masked_total = jnp.where(feasible, total, NEG)
+        assign: AssignResult = greedy_assign(masked_total, pf.requests, nf.free, key)
+
+        if explain:
+            filter_stack = (jnp.stack(masks) if masks
+                            else jnp.zeros((0, P, N), dtype=bool))
+            raw_stack = (jnp.stack(raws) if raws
+                         else jnp.zeros((0, P, N), dtype=jnp.float32))
+            norm_stack = (jnp.stack(norms) if norms
+                          else jnp.zeros((0, P, N), dtype=jnp.float32))
+        else:
+            filter_stack = jnp.zeros((0, P, N), dtype=bool)
+            raw_stack = jnp.zeros((0, P, N), dtype=jnp.float32)
+            norm_stack = jnp.zeros((0, P, N), dtype=jnp.float32)
+
+        return Decision(
+            chosen=assign.chosen,
+            assigned=assign.assigned,
+            feasible_counts=feasible_counts,
+            reject_counts=reject_counts,
+            total_scores=masked_total,
+            free_after=assign.free_after,
+            filter_masks=filter_stack,
+            raw_scores=raw_stack,
+            norm_scores=norm_stack,
+        )
+
+    jitted = jax.jit(step)
+    _STEP_CACHE[cache_key] = jitted
+    return jitted
+
+
+def max_normalize_100(scores: jnp.ndarray, feasible: jnp.ndarray) -> jnp.ndarray:
+    """Standard k8s NormalizeScore: scale so the best feasible node gets 100.
+    Rows with all-zero max pass through unchanged (upstream behavior)."""
+    masked = jnp.where(feasible, scores, 0.0)
+    row_max = masked.max(axis=1, keepdims=True)
+    return jnp.where(row_max > 0, masked * (100.0 / jnp.maximum(row_max, 1e-30)),
+                     masked)

@@ -1,0 +1,57 @@
+"""Host selection: capacity-aware greedy assignment inside one XLA step.
+
+The reference's selectHost is an argmax with a uniform-random tie-break over
+one pod's score list (reference minisched/minisched.go:304-325). Batching
+introduces the problem the sequential loop never had (SURVEY §7 "batch-
+internal causality"): two pods in the same batch may both win the same
+scarce capacity. The fix is a lax.scan over the pod axis — each step is a
+fully vectorized N-wide argmax, and the carried free-resource matrix makes
+every pod see all prior in-batch assignments, exactly like the sequential
+scheduler saw all prior binds.
+
+Tie-breaking is seeded jax PRNG noise among max-score nodes — the
+reproducible equivalent of the reference's rand.Intn reservoir tie-break
+(minisched.go:316-322; SURVEY §7 "tie-breaking parity").
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG = jnp.float32(-3.0e38)  # effectively -inf for masked scores
+
+
+class AssignResult(NamedTuple):
+    chosen: jnp.ndarray      # (P,) i32 node row, -1 if unassigned
+    assigned: jnp.ndarray    # (P,) bool
+    free_after: jnp.ndarray  # (N,R) f32 remaining free resources
+
+
+def greedy_assign(scores: jnp.ndarray, requests: jnp.ndarray,
+                  free0: jnp.ndarray, key: jax.Array) -> AssignResult:
+    """Assign pods to nodes in row order (caller pre-sorts by priority).
+
+    scores:   (P,N) f32 with NEG on infeasible pairs
+    requests: (P,R) f32 per-pod resource requests
+    free0:    (N,R) f32 free resources entering the batch
+    """
+    P, N = scores.shape
+
+    def body(free, inp):
+        i, req, srow = inp
+        fits = jnp.all(free >= req[None, :], axis=1)  # (N,)
+        s = jnp.where(fits, srow, NEG)
+        m = jnp.max(s)
+        ok = m > NEG
+        noise = jax.random.uniform(jax.random.fold_in(key, i), (N,))
+        tie = (s >= m) & fits
+        idx = jnp.argmax(jnp.where(tie, noise, -1.0)).astype(jnp.int32)
+        safe = jnp.where(ok, idx, 0)
+        free = free.at[safe].add(jnp.where(ok, -req, 0.0))
+        return free, (jnp.where(ok, idx, -1), ok)
+
+    free_after, (chosen, assigned) = jax.lax.scan(
+        body, free0, (jnp.arange(P, dtype=jnp.int32), requests, scores))
+    return AssignResult(chosen, assigned, free_after)

@@ -1,0 +1,3 @@
+from .base import BatchedPlugin, PluginSet  # noqa: F401
+from .nodeunschedulable import NodeUnschedulable  # noqa: F401
+from .nodenumber import NodeNumber  # noqa: F401

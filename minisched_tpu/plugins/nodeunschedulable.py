@@ -1,0 +1,37 @@
+"""NodeUnschedulable filter.
+
+Batched counterpart of the upstream plugin the reference instantiates at
+minisched/initialize.go:198: rejects nodes with spec.unschedulable unless
+the pod tolerates the node.kubernetes.io/unschedulable:NoSchedule taint.
+One boolean mask column in the batched filter matrix (SURVEY §2 row
+"NodeUnschedulable filter").
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..encode import features as F
+from ..state.events import ActionType, ClusterEvent, GVK
+from .base import BatchedPlugin
+
+_UNSCHED_KEY_HASH = F.key_hash("node.kubernetes.io/unschedulable")
+
+
+class NodeUnschedulable(BatchedPlugin):
+    name = "NodeUnschedulable"
+
+    def events_to_register(self):
+        # Upstream registers {Node, Add | UpdateNodeTaint}.
+        return [ClusterEvent(GVK.NODE, ActionType.ADD | ActionType.UPDATE_NODE_TAINT)]
+
+    def filter(self, pf, nf) -> jnp.ndarray:
+        # Pod tolerates the implicit unschedulable taint iff it has a
+        # toleration with key node.kubernetes.io/unschedulable (or empty key
+        # Exists) covering the NoSchedule effect.
+        key_ok = (pf.tol_keys == _UNSCHED_KEY_HASH) | (
+            (pf.tol_keys == 0) & (pf.tol_ops == F.TOL_EXISTS))
+        effect_ok = (pf.tol_effects == F.EFFECT_NONE) | (
+            pf.tol_effects == F.EFFECT_NO_SCHEDULE)
+        active = pf.tol_ops != F.TOL_NONE
+        tolerates = (active & key_ok & effect_ok).any(axis=1)  # (P,)
+        return ~nf.unschedulable[None, :] | tolerates[:, None]

@@ -1,0 +1,1 @@
+from .controller import PVController  # noqa: F401

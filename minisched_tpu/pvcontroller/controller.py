@@ -1,0 +1,108 @@
+"""Persistent-volume controller.
+
+Analog of reference pvcontroller/pvcontroller.go:16-44, which runs the real
+upstream PV controller (hostpath/local plugins, 1s sync, dynamic
+provisioning on) beside the scheduler, coordinating only through apiserver
+state. This rebuild keeps that shape: a watch-driven loop over the store
+that binds pending PVCs to matching PVs (capacity + storage class) and
+dynamically provisions a PV when none matches — never talking to the
+scheduler directly (SURVEY §1: hub-and-spoke through shared state).
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+from typing import Optional
+
+from ..errors import ConflictError, NotFoundError
+from ..state import objects as obj
+from ..state.store import ClusterStore
+
+log = logging.getLogger(__name__)
+
+
+class PVController:
+    def __init__(self, store: ClusterStore, *, sync_period_s: float = 0.1,
+                 dynamic_provisioning: bool = True):
+        self._store = store
+        self._sync = sync_period_s  # reference uses 1s (pvcontroller.go:31)
+        self._dynamic = dynamic_provisioning
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._prov_seq = itertools.count(1)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="pv-controller")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # ---- sync loop ------------------------------------------------------
+
+    def _run(self) -> None:
+        watcher = self._store.watch(
+            kinds=["PersistentVolumeClaim", "PersistentVolume"])
+        self._sync_once()
+        while not self._stop.is_set():
+            ev = watcher.next_event(timeout=self._sync)
+            self._sync_once()
+            if ev is None:
+                continue
+        watcher.stop()
+
+    def _sync_once(self) -> None:
+        try:
+            pvcs = self._store.list("PersistentVolumeClaim")
+            pvs = self._store.list("PersistentVolume")
+        except Exception:
+            return
+        available = [pv for pv in pvs if pv.phase == "Available"]
+        for pvc in pvcs:
+            if pvc.phase == "Bound":
+                continue
+            match = self._find_match(pvc, available)
+            if match is None and self._dynamic:
+                match = self._provision(pvc)
+            if match is not None:
+                self._bind(pvc, match)
+                available = [pv for pv in available if pv.key != match.key]
+
+    def _find_match(self, pvc, available):
+        want = pvc.request.get("ephemeral-storage", 0)
+        candidates = [
+            pv for pv in available
+            if pv.storage_class == pvc.storage_class
+            and pv.capacity.get("ephemeral-storage", 0) >= want]
+        # smallest adequate volume, upstream's match heuristic
+        return min(candidates,
+                   key=lambda pv: pv.capacity.get("ephemeral-storage", 0),
+                   default=None)
+
+    def _provision(self, pvc):
+        pv = obj.PersistentVolume(
+            metadata=obj.ObjectMeta(name=f"pv-provisioned-{next(self._prov_seq)}"),
+            capacity=dict(pvc.request),
+            storage_class=pvc.storage_class,
+            phase="Available")
+        try:
+            return self._store.create(pv)
+        except Exception:
+            return None
+
+    def _bind(self, pvc, pv) -> None:
+        try:
+            pv.claim_ref = pvc.key
+            pv.phase = "Bound"
+            self._store.update(pv)
+            pvc.volume_name = pv.metadata.name
+            pvc.phase = "Bound"
+            self._store.update(pvc)
+            log.info("bound PVC %s to PV %s", pvc.key, pv.metadata.name)
+        except (ConflictError, NotFoundError):
+            pass

@@ -1,0 +1,1 @@
+from .runner import Cluster, run_scenario, wait_until  # noqa: F401

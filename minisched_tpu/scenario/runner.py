@@ -1,0 +1,140 @@
+"""Programmable scenario runner — the user-facing API.
+
+Rebuild of reference sched.go: main() boots config → control plane → pv
+controller → scheduler service, then hands a client to a user-editable
+scenario function that drives and asserts scheduler behavior
+(sched.go:30-68, scenario at :70-143). Here the "client" is a Cluster
+facade over the in-process store with the same verbs the reference scenario
+uses via client-go (create nodes/pods, get, list, observe phase) plus
+polling asserts in place of the reference's fixed sleeps (sched.go:109,134).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+from ..config import SchedulerConfig
+from ..pvcontroller.controller import PVController
+from ..service.defaultconfig import Profile
+from ..service.service import SchedulerService
+from ..state import objects as obj
+from ..state.store import ClusterStore
+
+
+def wait_until(pred: Callable[[], bool], timeout: float = 5.0,
+               interval: float = 0.02) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class Cluster:
+    """Scenario-facing cluster client (the reference passes a client-go
+    clientset; the verbs the scenario needs are mirrored 1:1)."""
+
+    def __init__(self, store: Optional[ClusterStore] = None):
+        self.store = store or ClusterStore()
+        self.service = SchedulerService(self.store)
+        self.pv_controller: Optional[PVController] = None
+
+    # ---- boot (reference sched.go:30-68) -------------------------------
+
+    def start(self, profile: Optional[Profile] = None,
+              config: Optional[SchedulerConfig] = None,
+              with_pv_controller: bool = True) -> "Cluster":
+        if with_pv_controller:
+            self.pv_controller = PVController(self.store)
+            self.pv_controller.start()
+        self.service.start_scheduler(profile, config)
+        return self
+
+    def shutdown(self) -> None:
+        self.service.shutdown_scheduler()
+        if self.pv_controller is not None:
+            self.pv_controller.shutdown()
+
+    # ---- object helpers (reference scenario verbs, sched.go:74-143) ----
+
+    def create_node(self, name: str, *, unschedulable: bool = False,
+                    cpu: float = 4000, memory: float = 16 << 30,
+                    pods: float = 110, labels: Optional[dict] = None,
+                    taints: Optional[list] = None,
+                    accelerator: float = 0) -> obj.Node:
+        node = obj.Node(
+            metadata=obj.ObjectMeta(name=name, labels=labels or {}),
+            spec=obj.NodeSpec(unschedulable=unschedulable, taints=taints or []),
+            status=obj.NodeStatus(allocatable={
+                "cpu": cpu, "memory": memory, "pods": pods,
+                "accelerator": accelerator}))
+        return self.store.create(node)
+
+    def create_pod(self, name: str, *, namespace: str = "default",
+                   cpu: float = 100, memory: float = 0,
+                   spec: Optional[obj.PodSpec] = None, **spec_kwargs) -> obj.Pod:
+        if spec is None:
+            requests = {"cpu": cpu}
+            if memory:
+                requests["memory"] = memory
+            spec = obj.PodSpec(requests=requests, **spec_kwargs)
+        pod = obj.Pod(metadata=obj.ObjectMeta(name=name, namespace=namespace),
+                      spec=spec)
+        return self.store.create(pod)
+
+    def get_pod(self, name: str, namespace: str = "default") -> obj.Pod:
+        return self.store.get("Pod", f"{namespace}/{name}")
+
+    def get_node(self, name: str) -> obj.Node:
+        return self.store.get("Node", name)
+
+    def list_pods(self) -> List[obj.Pod]:
+        return self.store.list("Pod")
+
+    def list_nodes(self) -> List[obj.Node]:
+        return self.store.list("Node")
+
+    def delete_pod(self, name: str, namespace: str = "default") -> None:
+        self.store.delete("Pod", f"{namespace}/{name}")
+
+    # ---- assertions ----------------------------------------------------
+
+    def wait_for_pod_bound(self, name: str, namespace: str = "default",
+                           timeout: float = 5.0) -> obj.Pod:
+        """Reference sched.go:134-140: poll until the pod is bound."""
+        ok = wait_until(
+            lambda: bool(self.get_pod(name, namespace).spec.node_name), timeout)
+        pod = self.get_pod(name, namespace)
+        if not ok:
+            raise AssertionError(
+                f"pod {namespace}/{name} not bound within {timeout}s "
+                f"(phase={pod.status.phase}, "
+                f"unschedulable_plugins={pod.status.unschedulable_plugins})")
+        return pod
+
+    def wait_for_pod_pending(self, name: str, namespace: str = "default",
+                             timeout: float = 3.0) -> obj.Pod:
+        """Reference sched.go:109-119: the pod must still be pending (and the
+        scheduler must have *tried* — recorded rejecting plugins)."""
+        wait_until(
+            lambda: bool(self.get_pod(name, namespace).status.unschedulable_plugins),
+            timeout)
+        pod = self.get_pod(name, namespace)
+        if pod.spec.node_name:
+            raise AssertionError(
+                f"pod {namespace}/{name} unexpectedly bound to {pod.spec.node_name}")
+        return pod
+
+
+def run_scenario(scenario: Callable[[Cluster], None],
+                 profile: Optional[Profile] = None,
+                 config: Optional[SchedulerConfig] = None) -> None:
+    """Boot everything, run the scenario, tear down (reference main →
+    start() → scenario(client), teardown deferred in reverse sched.go:40-60)."""
+    cluster = Cluster()
+    cluster.start(profile, config)
+    try:
+        scenario(cluster)
+    finally:
+        cluster.shutdown()

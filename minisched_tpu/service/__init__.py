@@ -1,0 +1,2 @@
+from .service import SchedulerService  # noqa: F401
+from .defaultconfig import default_plugin_set, default_scheduler_profile  # noqa: F401

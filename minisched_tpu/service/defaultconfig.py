@@ -1,0 +1,73 @@
+"""Default scheduler profiles.
+
+Analog of reference scheduler/defaultconfig/defaultconfig.go (defaulted
+KubeSchedulerConfiguration + default filter/score plugin lists) and of the
+hardcoded plugin construction in minisched/initialize.go:80-138 (the
+reference's live profile: NodeUnschedulable filter + NodeNumber
+prescore/score/permit).
+
+Profiles are declarative: {plugin name: enabled/weight/args}, merged over
+the defaults the way ConvertForSimulator + NewPluginConfig merge user config
+over defaults (reference scheduler/plugin/plugins.go:77-202).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..plugins.base import BatchedPlugin, PluginSet
+from ..plugins.nodenumber import NodeNumber
+from ..plugins.nodeunschedulable import NodeUnschedulable
+
+# Registry of plugin factories by name (reference plugin.NewRegistry,
+# scheduler/plugin/plugins.go:24-70; grows as plugins land).
+_REGISTRY: Dict[str, Callable[..., BatchedPlugin]] = {}
+
+
+def register_plugin(name: str, factory: Callable[..., BatchedPlugin]) -> None:
+    _REGISTRY[name] = factory
+
+
+def registered_plugins() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def make_plugin(name: str, **args) -> BatchedPlugin:
+    try:
+        return _REGISTRY[name](**args)
+    except KeyError:
+        raise KeyError(f"unknown plugin {name!r}; registered: {registered_plugins()}")
+
+
+register_plugin("NodeUnschedulable", NodeUnschedulable)
+register_plugin("NodeNumber", NodeNumber)
+
+
+@dataclass
+class Profile:
+    """One scheduling profile: enabled plugins, weights, per-plugin args."""
+
+    name: str = "default-scheduler"
+    plugins: List[str] = field(default_factory=lambda: ["NodeUnschedulable", "NodeNumber"])
+    disabled: List[str] = field(default_factory=list)
+    weights: Dict[str, float] = field(default_factory=dict)
+    plugin_args: Dict[str, dict] = field(default_factory=dict)
+
+    def build(self) -> PluginSet:
+        enabled = [p for p in self.plugins if p not in self.disabled]
+        instances = [make_plugin(n, **self.plugin_args.get(n, {}))
+                     for n in enabled]
+        return PluginSet(instances, self.weights)
+
+
+def default_scheduler_profile() -> Profile:
+    """The reference's live configuration (minisched/initialize.go:185-186):
+    NodeUnschedulable filter + NodeNumber score/permit."""
+    return Profile()
+
+
+def default_plugin_set(**overrides) -> PluginSet:
+    prof = default_scheduler_profile()
+    for k, v in overrides.items():
+        setattr(prof, k, v)
+    return prof.build()

@@ -1,0 +1,65 @@
+"""Scheduler lifecycle service.
+
+Rebuild of reference scheduler/scheduler.go: NewSchedulerService (:36),
+StartScheduler (:50-80: informer factory + event broadcaster + minisched.New
++ start informers + go Run), RestartScheduler (:40-47: shutdown + start with
+the retained config), ShutdownScheduler (:82-87), GetSchedulerConfig (:89).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..config import SchedulerConfig
+from ..engine.scheduler import Scheduler
+from ..explain.resultstore import ResultStore
+from .defaultconfig import Profile, default_scheduler_profile
+
+log = logging.getLogger(__name__)
+
+
+class SchedulerService:
+    def __init__(self, store):
+        self._store = store
+        self._sched: Optional[Scheduler] = None
+        self._profile: Optional[Profile] = None
+        self._config: Optional[SchedulerConfig] = None
+        self.result_store: Optional[ResultStore] = None
+
+    @property
+    def scheduler(self) -> Optional[Scheduler]:
+        return self._sched
+
+    def start_scheduler(self, profile: Optional[Profile] = None,
+                        config: Optional[SchedulerConfig] = None) -> Scheduler:
+        if self._sched is not None:
+            raise RuntimeError("scheduler already running")
+        self._profile = profile or default_scheduler_profile()
+        self._config = config or SchedulerConfig()
+        recorder = None
+        if self._config.explain:
+            self.result_store = recorder = ResultStore(self._store)
+        self._sched = Scheduler(self._store, self._profile.build(),
+                                self._config, recorder=recorder)
+        self._sched.start()
+        log.info("scheduler started (profile=%s)", self._profile.name)
+        return self._sched
+
+    def shutdown_scheduler(self) -> None:
+        if self._sched is not None:
+            self._sched.shutdown()
+            self._sched = None
+            log.info("scheduler shut down")
+
+    def restart_scheduler(self) -> Scheduler:
+        """Shutdown + start with the retained profile/config (reference
+        RestartScheduler scheduler.go:40-47). Queue/cache state is rebuilt
+        from surviving store state, same as the reference."""
+        profile, config = self._profile, self._config
+        self.shutdown_scheduler()
+        self._profile, self._config = None, None
+        return self.start_scheduler(profile, config)
+
+    def get_scheduler_profile(self) -> Optional[Profile]:
+        """reference GetSchedulerConfig (scheduler.go:89-91)."""
+        return self._profile

@@ -12,7 +12,6 @@ Event objects in the store.
 """
 from __future__ import annotations
 
-import itertools
 from dataclasses import dataclass
 from typing import Optional
 
@@ -91,20 +90,23 @@ class EventBroadcaster:
     def __init__(self, store: ClusterStore, source: str = "minisched-tpu"):
         self._store = store
         self._source = source
-        self._seq = itertools.count(1)
 
     def record(self, *, involved: str, reason: str, message: str,
                type_: str = "Normal", namespace: str = "default") -> None:
-        ev = obj.Event(
-            metadata=obj.ObjectMeta(
-                name=f"evt-{next(self._seq)}-{reason.lower()}",
-                namespace=namespace),
-            type=type_, reason=reason, message=message,
-            involved_object=involved, source=self._source)
+        # Name derives from the store-global uid so events never collide
+        # across broadcaster instances or snapshot restores.
+        meta = obj.ObjectMeta(namespace=namespace)
+        meta.name = f"evt-{meta.uid}-{reason.lower()}"
+        ev = obj.Event(metadata=meta, type=type_, reason=reason,
+                       message=message, involved_object=involved,
+                       source=self._source)
         try:
             self._store.create(ev)
         except Exception:  # events are best-effort, like upstream
-            pass
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "dropped event %s for %s", reason, involved, exc_info=True)
 
     def scheduled(self, pod: obj.Pod, node_name: str) -> None:
         self.record(involved=f"Pod:{pod.key}", reason="Scheduled",

@@ -12,12 +12,10 @@ minisched/eventhandler.go:14-76 registers handlers). Semantics preserved:
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 from .store import ClusterStore, EventType, WatchEvent
-
-Handler = Callable[..., None]
 
 
 @dataclass
@@ -86,14 +84,21 @@ class InformerFactory:
                 ev = self._watcher.next_event(timeout=0.2)
             except ValueError:
                 # Cursor fell behind the store's retained log (pathological
-                # backlog). Re-watch from the current version; intermediate
-                # events are lost, which we surface loudly.
+                # backlog). Re-list atomically and redeliver current state as
+                # Adds (at-least-once: handlers must tolerate duplicate adds,
+                # which queue/cache consumers do via keyed dedupe). Deletions
+                # that happened in the gap cannot be synthesized without a
+                # local cache; surface that loudly.
                 import logging
 
                 logging.getLogger(__name__).error(
-                    "informer fell behind watch log; resyncing from head — "
-                    "events were dropped")
-                self._watcher = self.store.watch(kinds=list(self._handlers) or None)
+                    "informer fell behind watch log; re-listing and "
+                    "redelivering adds (deletes in the gap are lost)")
+                initial, self._watcher = self.store.list_and_watch(
+                    kinds=list(self._handlers) or None)
+                for kind, objs in initial.items():
+                    for o in objs:
+                        self._dispatch(WatchEvent(EventType.ADDED, kind, o))
                 continue
             if ev is not None:
                 self._dispatch(ev)

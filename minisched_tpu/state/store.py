@@ -22,7 +22,7 @@ import json
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
 from ..errors import AlreadyExistsError, ConflictError, NotFoundError
 from . import objects as obj
@@ -61,9 +61,11 @@ class Watcher:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._store._cond:
             while not self._stopped.is_set():
-                ev = self._store._next_after(self._cursor, self._kinds)
+                ev, scanned_to = self._store._next_after(self._cursor, self._kinds)
+                # Advance past non-matching events too, so a kind-filtered
+                # watcher neither rescans them nor "falls behind" on them.
+                self._cursor = scanned_to
                 if ev is not None:
-                    self._cursor = ev.resource_version
                     return ev
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
@@ -118,15 +120,19 @@ class ClusterStore:
             return deepcopy_obj(stored)
 
     def get(self, kind: str, key: str) -> Any:
+        # Stored objects are replacement-only (update/bind deep-copy before
+        # storing), so copying can happen outside the lock.
         with self._cond:
             try:
-                return deepcopy_obj(self._objects[kind][key])
+                o = self._objects[kind][key]
             except KeyError:
                 raise NotFoundError(f"{kind} {key!r} not found")
+        return deepcopy_obj(o)
 
     def list(self, kind: str) -> List[Any]:
         with self._cond:
-            return [deepcopy_obj(o) for o in self._objects[kind].values()]
+            refs = list(self._objects[kind].values())
+        return [deepcopy_obj(o) for o in refs]
 
     def count(self, kind: str) -> int:
         with self._cond:
@@ -199,9 +205,11 @@ class ClusterStore:
         lists were taken at, so no event is missed or delivered twice
         (client-go reflector's list-then-watch-from-listRV contract)."""
         with self._cond:
-            lists = {k: [deepcopy_obj(o) for o in self._objects[k].values()]
-                     for k in (kinds or self.KINDS)}
-            return lists, Watcher(self, kinds, self._rv)
+            refs = {k: list(self._objects[k].values())
+                    for k in (kinds or self.KINDS)}
+            watcher = Watcher(self, kinds, self._rv)
+        lists = {k: [deepcopy_obj(o) for o in v] for k, v in refs.items()}
+        return lists, watcher
 
     def resource_version(self) -> int:
         with self._cond:
@@ -215,17 +223,22 @@ class ClusterStore:
             del self._log[:drop]
         self._cond.notify_all()
 
-    def _next_after(self, rv: int, kinds: Optional[set]) -> Optional[WatchEvent]:
-        # Every mutation appends exactly one event with rv = previous + 1, so
-        # the log is rv-contiguous: _log[i].resource_version == _log_base+1+i.
+    def _next_after(self, rv: int, kinds: Optional[set]):
+        """Return (first matching event after rv, cursor to advance to).
+
+        Every mutation appends exactly one event with rv = previous + 1, so
+        the log is rv-contiguous: _log[i].resource_version == _log_base+1+i.
+        When no event matches, the cursor still advances to the end of the
+        log (non-matching events are consumed, not rescanned).
+        """
         if rv < self._log_base:
             raise ValueError(
                 f"watch cursor {rv} fell behind retained log (base "
                 f"{self._log_base}); re-list and restart the watch")
         for ev in self._log[rv - self._log_base:]:
             if kinds is None or ev.kind in kinds:
-                return ev
-        return None
+                return ev, ev.resource_version
+        return None, self._rv
 
     # ---- Snapshot / restore (etcd durability analog) -------------------
 

@@ -1,0 +1,135 @@
+"""Feature-encoding and node-cache tests (SURVEY §7 step 2)."""
+import numpy as np
+
+from minisched_tpu.encode import NodeFeatureCache, encode_pods, name_suffix_digit, pair_hash
+from minisched_tpu.encode.cache import bucket_for
+from minisched_tpu.state.objects import (
+    ContainerPort,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Taint,
+    Toleration,
+)
+
+
+def node(name, cpu=4000, labels=None, taints=None, unsched=False):
+    return Node(metadata=ObjectMeta(name=name, labels=labels or {}),
+                spec=NodeSpec(unschedulable=unsched, taints=taints or []),
+                status=NodeStatus(allocatable={"cpu": cpu, "memory": 16 << 30, "pods": 110}))
+
+
+def pod(name, cpu=100, ns="default", **spec_kw):
+    return Pod(metadata=ObjectMeta(name=name, namespace=ns),
+               spec=PodSpec(requests={"cpu": cpu}, **spec_kw))
+
+
+def test_name_suffix_last_char_semantics():
+    # Reference nodenumber.go:50-64 uses the LAST character only.
+    assert name_suffix_digit("node1") == 1
+    assert name_suffix_digit("node10") == 0
+    assert name_suffix_digit("node") == -1
+    assert name_suffix_digit("") == -1
+
+
+def test_bucket_ladder():
+    assert bucket_for(1) == 16
+    assert bucket_for(16) == 16
+    assert bucket_for(17) == 32
+    assert bucket_for(50_000) == 65536
+
+
+def test_cache_upsert_remove_reuse():
+    c = NodeFeatureCache()
+    c.upsert_node(node("a", cpu=1000))
+    c.upsert_node(node("b", cpu=2000))
+    ia, ib = c.row_of("a"), c.row_of("b")
+    assert ia != ib
+    c.remove_node("a")
+    nf, names = c.snapshot()
+    assert not nf.valid[ia]
+    c.upsert_node(node("c", cpu=3000))
+    assert c.row_of("c") == ia  # slot reuse
+    nf, names = c.snapshot()
+    assert names[ia] == "c"
+    assert nf.allocatable[ia, 0] == 3000
+
+
+def test_cache_growth_preserves_rows():
+    c = NodeFeatureCache(capacity=4)
+    for i in range(20):
+        c.upsert_node(node(f"n{i}", cpu=1000 + i))
+    nf, names = c.snapshot()
+    for i in range(20):
+        row = c.row_of(f"n{i}")
+        assert nf.allocatable[row, 0] == 1000 + i
+
+
+def test_bind_accounting_and_unbind():
+    c = NodeFeatureCache()
+    c.upsert_node(node("n1", cpu=1000))
+    p = pod("p1", cpu=300)
+    p.spec.node_name = "n1"
+    p.spec.ports = [ContainerPort(host_port=8080)]
+    c.account_bind(p)
+    nf, _ = c.snapshot()
+    row = c.row_of("n1")
+    assert nf.free[row, 0] == 700
+    assert nf.free[row, 2] == 109  # implicit pods slot
+    assert 8080 in nf.used_ports[row]
+    # double-account is a no-op
+    c.account_bind(p)
+    nf, _ = c.snapshot()
+    assert nf.free[row, 0] == 700
+    c.account_unbind(p.key)
+    nf, _ = c.snapshot()
+    assert nf.free[row, 0] == 1000
+    assert 8080 not in nf.used_ports[row]
+
+
+def test_node_update_recomputes_free_with_bound_pods():
+    c = NodeFeatureCache()
+    c.upsert_node(node("n1", cpu=1000))
+    p = pod("p1", cpu=400)
+    p.spec.node_name = "n1"
+    c.account_bind(p)
+    # allocatable shrinks; free must reflect bound pod against new allocatable
+    c.upsert_node(node("n1", cpu=800))
+    nf, _ = c.snapshot()
+    assert nf.free[c.row_of("n1"), 0] == 400
+
+
+def test_pod_encoding_fields():
+    p = pod("web3", cpu=250)
+    p.spec.node_selector = {"disk": "ssd"}
+    p.spec.tolerations = [Toleration(key="dedicated", operator="Equal",
+                                     value="ml", effect="NoSchedule")]
+    p.spec.ports = [ContainerPort(host_port=9000)]
+    pf = encode_pods([p], 4)
+    assert pf.valid.tolist() == [True, False, False, False]
+    assert pf.requests[0, 0] == 250
+    assert pf.requests[0, 2] == 1  # implicit pods:1
+    assert pf.name_suffix[0] == 3
+    assert pf.sel_pairs[0, 0] == pair_hash("disk", "ssd")
+    assert pf.ports[0, 0] == 9000
+
+
+def test_overflow_reporting():
+    p = pod("p")
+    p.spec.node_selector = {f"k{i}": "v" for i in range(10)}
+    overflow = []
+    encode_pods([p], 2, overflow=overflow)
+    assert any("node_selector" in o for o in overflow)
+
+
+def test_taint_encoding():
+    overflow = []
+    c = NodeFeatureCache()
+    c.upsert_node(node("n", taints=[Taint(key="a", value="b", effect="NoExecute")]))
+    nf, _ = c.snapshot()
+    row = c.row_of("n")
+    assert nf.taint_pairs[row, 0] == pair_hash("a", "b")
+    assert nf.taint_effects[row, 0] == 3  # NoExecute

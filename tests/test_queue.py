@@ -1,0 +1,179 @@
+"""Scheduling-queue tests: blocking batch pops, event-filtered requeue,
+backoff flushing — the reference's queue semantics with its bugs fixed
+(reference minisched/queue/queue.go; SURVEY §2 queue row quirks)."""
+import threading
+import time
+
+import pytest
+
+from minisched_tpu.engine.queue import QueuedPodInfo, SchedulingQueue
+from minisched_tpu.state.events import ActionType, ClusterEvent, GVK
+from tests.test_encode import pod
+
+
+def make_queue(event_map=None, **kw):
+    if event_map is None:
+        event_map = {ClusterEvent(GVK.NODE, ActionType.ADD): {"NodeNumber"}}
+    kw.setdefault("backoff_initial", 0.05)
+    kw.setdefault("backoff_max", 0.2)
+    return SchedulingQueue(event_map, **kw)
+
+
+def test_pop_blocks_then_wakes():
+    q = make_queue()
+    got = []
+    t = threading.Thread(target=lambda: got.append(q.pop_batch(10, timeout=5)))
+    t.start()
+    time.sleep(0.05)
+    q.add(pod("p1"))
+    t.join(timeout=5)
+    assert [x.key for x in got[0]] == ["default/p1"]
+    q.close()
+
+
+def test_pop_batch_priority_order():
+    q = make_queue()
+    lo, hi, mid = pod("lo"), pod("hi"), pod("mid")
+    lo.spec.priority, hi.spec.priority, mid.spec.priority = 0, 10, 5
+    for p in (lo, hi, mid):
+        q.add(p)
+    batch = q.pop_batch(10, timeout=1)
+    assert [b.pod.metadata.name for b in batch] == ["hi", "mid", "lo"]
+    q.close()
+
+
+def test_pop_batch_respects_max():
+    q = make_queue()
+    for i in range(5):
+        q.add(pod(f"p{i}"))
+    assert len(q.pop_batch(3, timeout=1)) == 3
+    assert len(q.pop_batch(3, timeout=1)) == 2
+    q.close()
+
+
+def test_duplicate_add_ignored_until_forget():
+    q = make_queue()
+    q.add(pod("p"))
+    q.add(pod("p"))
+    assert len(q.pop_batch(10, timeout=1)) == 1
+    # popped but not forgotten: still known, re-add ignored
+    q.add(pod("p"))
+    assert q.pop_batch(2, timeout=0.05) == []
+    q.forget("default/p")
+    q.add(pod("p"))
+    assert len(q.pop_batch(10, timeout=1)) == 1
+    q.close()
+
+
+def test_event_filtered_requeue():
+    # Pod rejected by NodeNumber revives on Node/Add, not on Pod/Add.
+    q = make_queue()
+    q.add(pod("p"))
+    (qpi,) = q.pop_batch(10, timeout=1)
+    q.add_unschedulable(qpi, {"NodeNumber"})
+    assert q.stats()["unschedulable"] == 1
+
+    q.move_all_to_active_or_backoff(ClusterEvent(GVK.POD, ActionType.ADD))
+    assert q.stats()["unschedulable"] == 1  # no interest registered
+
+    q.move_all_to_active_or_backoff(ClusterEvent(GVK.NODE, ActionType.ADD))
+    assert q.stats()["unschedulable"] == 0
+    q.close()
+
+
+def test_unmatched_plugins_stay_parked():
+    q = make_queue()
+    q.add(pod("p"))
+    (qpi,) = q.pop_batch(10, timeout=1)
+    q.add_unschedulable(qpi, {"SomeOtherPlugin"})
+    q.move_all_to_active_or_backoff(ClusterEvent(GVK.NODE, ActionType.ADD))
+    assert q.stats()["unschedulable"] == 1  # interests don't intersect
+    q.close()
+
+
+def test_revived_pod_lands_in_backoff_then_flushes():
+    # Fixes the reference's stranded backoffQ (queue.go:136-139 panics).
+    q = make_queue(backoff_initial=0.15, backoff_max=0.3)
+    q.add(pod("p"))
+    (qpi,) = q.pop_batch(10, timeout=1)
+    q.add_unschedulable(qpi, {"NodeNumber"})
+    q.move_all_to_active_or_backoff(ClusterEvent(GVK.NODE, ActionType.ADD))
+    st = q.stats()
+    assert st["backoff"] == 1 and st["active"] == 0  # still backing off
+    batch = q.pop_batch(10, timeout=2)  # flusher must deliver it
+    assert [b.key for b in batch] == ["default/p"]
+    q.close()
+
+
+def test_requeue_backoff_auto_returns():
+    q = make_queue(backoff_initial=0.05)
+    q.add(pod("p"))
+    (qpi,) = q.pop_batch(10, timeout=1)
+    q.requeue_backoff(qpi)
+    batch = q.pop_batch(10, timeout=2)
+    assert len(batch) == 1 and batch[0].attempts == 1
+    q.close()
+
+
+def test_backoff_doubles_and_caps():
+    q = make_queue(backoff_initial=1.0, backoff_max=10.0)
+    qpi = QueuedPodInfo(pod=pod("p"))
+    durations = []
+    for attempts in range(1, 7):
+        qpi.attempts = attempts
+        durations.append(q._backoff_duration(qpi))
+    assert durations == [1.0, 2.0, 4.0, 8.0, 10.0, 10.0]
+    q.close()
+
+
+def test_update_spec_change_revives_status_change_does_not():
+    q = make_queue()
+    q.add(pod("p"))
+    (qpi,) = q.pop_batch(10, timeout=1)
+    q.add_unschedulable(qpi, {"NodeNumber"})
+
+    old = qpi.pod
+    status_only = pod("p")
+    status_only.spec = old.spec
+    status_only.status.unschedulable_plugins = ["NodeNumber"]
+    q.update(old, status_only)
+    assert q.stats()["unschedulable"] == 1  # not revived
+
+    changed = pod("p", cpu=999)
+    q.update(status_only, changed)
+    assert q.stats()["unschedulable"] == 0
+    assert q.stats()["active"] == 1
+    q.close()
+
+
+def test_delete_removes_everywhere():
+    q = make_queue()
+    q.add(pod("p"))
+    q.delete(pod("p"))
+    assert q.pop_batch(10, timeout=0.05) == []
+    # delete also clears known: re-add works
+    q.add(pod("p"))
+    assert len(q.pop_batch(10, timeout=1)) == 1
+    q.close()
+
+
+def test_move_during_attempt_goes_to_backoff_not_parked():
+    """A move request that fires while a pod is mid-attempt must not let the
+    pod be parked afterwards (upstream moveRequestCycle semantics)."""
+    q = make_queue(backoff_initial=0.05)
+    q.add(pod("p"))
+    (qpi,) = q.pop_batch(10, timeout=1)
+    # event fires while the attempt is in flight: nothing parked yet
+    q.move_all_to_active_or_backoff(ClusterEvent(GVK.NODE, ActionType.ADD))
+    # attempt then fails: pod must go to backoff (retry), not unschedulableQ
+    q.add_unschedulable(qpi, {"NodeNumber"})
+    assert q.stats()["unschedulable"] == 0
+    batch = q.pop_batch(10, timeout=2)  # flusher returns it
+    assert [b.key for b in batch] == ["default/p"]
+    q.close()
+
+
+def test_closed_queue_returns_empty():
+    q = make_queue()
+    q.close()
+    assert q.pop_batch(10, timeout=0.1) == []

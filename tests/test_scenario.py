@@ -1,0 +1,182 @@
+"""End-to-end scenario tests — the reference's README scenario and variants
+(reference sched.go:70-143; SURVEY §7 "minimum end-to-end slice")."""
+import pytest
+
+from minisched_tpu.config import SchedulerConfig
+from minisched_tpu.scenario import Cluster, wait_until
+from minisched_tpu.service.defaultconfig import Profile
+
+
+def fast_config(**kw):
+    kw.setdefault("backoff_initial_s", 0.05)
+    kw.setdefault("backoff_max_s", 0.2)
+    return SchedulerConfig(**kw)
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster()
+    yield c
+    c.shutdown()
+
+
+def test_readme_scenario(cluster):
+    """9 unschedulable nodes + pod1 → pending with NodeUnschedulable
+    recorded; add schedulable node10 → pod revives and binds to node10
+    (reference sched.go:74-143 exactly)."""
+    # NodeNumber's permit would delay binding by the node-digit; node10's
+    # trailing digit is 0 so the delay is 0 (reference semantics kept).
+    cluster.start(config=fast_config())
+    for i in range(9):
+        cluster.create_node(f"node{i}", unschedulable=True)
+    cluster.create_pod("pod1", cpu=100)
+
+    pending = cluster.wait_for_pod_pending("pod1", timeout=10)
+    assert pending.status.unschedulable_plugins == ["NodeUnschedulable"]
+    assert pending.spec.node_name == ""
+
+    cluster.create_node("node10")
+    bound = cluster.wait_for_pod_bound("pod1", timeout=5)
+    assert bound.spec.node_name == "node10"
+    assert bound.status.phase == "Running"
+
+    # Scheduled event recorded (reference broadcaster capability)
+    events = cluster.store.list("Event")
+    assert any(e.reason == "Scheduled" and "node10" in e.message for e in events)
+    assert any(e.reason == "FailedScheduling" for e in events)
+
+
+def test_suffix_scoring_prefers_matching_node(cluster):
+    cluster.start(config=fast_config())
+    cluster.create_node("nodeA7")
+    cluster.create_node("nodeB3")
+    cluster.create_pod("web3")
+    bound = cluster.wait_for_pod_bound("web3")
+    assert bound.spec.node_name == "nodeB3"
+
+
+def test_permit_delay_parks_pod_then_binds(cluster):
+    """NodeNumber permit waits {digit}s before allowing (reference
+    nodenumber.go:102-119): pod on node with suffix 1 binds after ~1s."""
+    cluster.start(config=fast_config())
+    cluster.create_node("node1")
+    cluster.create_pod("app1", cpu=100)
+    sched = cluster.service.scheduler
+    assert wait_until(lambda: "default/app1" in sched.waiting_pods, timeout=3)
+    pod = cluster.get_pod("app1")
+    assert pod.spec.node_name == ""  # parked, not yet bound
+    bound = cluster.wait_for_pod_bound("app1", timeout=5)
+    assert bound.spec.node_name == "node1"
+
+
+def test_many_pods_spread_capacity(cluster):
+    cluster.start(config=fast_config())
+    for i in range(4):
+        cluster.create_node(f"worker-{i}x", cpu=250)  # fits 2 pods of 100
+    for i in range(8):
+        cluster.create_pod(f"job-{i}x", cpu=100)
+    for i in range(8):
+        cluster.wait_for_pod_bound(f"job-{i}x", timeout=10)
+    counts = {}
+    for p in cluster.list_pods():
+        counts[p.spec.node_name] = counts.get(p.spec.node_name, 0) + 1
+    assert all(v == 2 for v in counts.values()), counts
+
+
+def test_capacity_exhausted_then_node_added(cluster):
+    cluster.start(config=fast_config())
+    cluster.create_node("tiny0", cpu=100)
+    cluster.create_pod("a0", cpu=100)
+    cluster.wait_for_pod_bound("a0", timeout=5)
+    cluster.create_pod("b0", cpu=100)
+    # b0 can't fit; NodeResourcesFit isn't in the default profile but the
+    # batch-capacity path must keep retrying via backoff without binding.
+    assert not wait_until(
+        lambda: bool(cluster.get_pod("b0").spec.node_name), timeout=0.6)
+    cluster.create_node("fresh0", cpu=100)
+    bound = cluster.wait_for_pod_bound("b0", timeout=5)
+    assert bound.spec.node_name == "fresh0"
+
+
+def test_pod_deleted_while_pending(cluster):
+    cluster.start(config=fast_config())
+    cluster.create_node("full", unschedulable=True)
+    cluster.create_pod("doomed", cpu=100)
+    cluster.wait_for_pod_pending("doomed", timeout=10)
+    cluster.delete_pod("doomed")
+    # a new pod with the same name must be schedulable after a node appears
+    cluster.create_node("open0")
+    cluster.create_pod("doomed", cpu=100)
+    bound = cluster.wait_for_pod_bound("doomed", timeout=5)
+    assert bound.spec.node_name == "open0"
+
+
+def test_restart_scheduler_resumes(cluster):
+    """reference RestartScheduler (scheduler/scheduler.go:40-47): pending
+    work survives restart via store state."""
+    cluster.start(config=fast_config())
+    cluster.create_node("blocked", unschedulable=True)
+    cluster.create_pod("waiting1", cpu=100)
+    cluster.wait_for_pod_pending("waiting1", timeout=10)
+
+    cluster.service.restart_scheduler()
+    cluster.create_node("rescue1")
+    bound = cluster.wait_for_pod_bound("waiting1", timeout=5)
+    assert bound.spec.node_name == "rescue1"
+
+
+def test_explain_annotations_recorded():
+    """Explainability parity (reference resultstore → pod annotations)."""
+    import json
+
+    from minisched_tpu.explain import (FILTER_RESULT_KEY,
+                                       FINAL_SCORE_RESULT_KEY,
+                                       SCORE_RESULT_KEY)
+
+    c = Cluster()
+    try:
+        c.start(config=fast_config(explain=True))
+        c.create_node("good1")
+        c.create_node("bad2", unschedulable=True)
+        c.create_pod("query1")
+        c.wait_for_pod_bound("query1", timeout=5)
+        assert wait_until(
+            lambda: FILTER_RESULT_KEY in c.get_pod("query1").metadata.annotations,
+            timeout=3)
+        pod = c.get_pod("query1")
+        fr = json.loads(pod.metadata.annotations[FILTER_RESULT_KEY])
+        assert fr["good1"]["NodeUnschedulable"] == "passed"
+        assert fr["bad2"]["NodeUnschedulable"] != "passed"
+        sr = json.loads(pod.metadata.annotations[SCORE_RESULT_KEY])
+        assert sr["good1"]["NodeNumber"] == 10.0  # suffix match
+        fs = json.loads(pod.metadata.annotations[FINAL_SCORE_RESULT_KEY])
+        assert fs["good1"]["NodeNumber"] == 10.0
+    finally:
+        c.shutdown()
+
+
+def test_pv_controller_binds_claims(cluster):
+    cluster.start(config=fast_config())
+    from minisched_tpu.state import objects as obj
+
+    pv = obj.PersistentVolume(
+        metadata=obj.ObjectMeta(name="pv1"),
+        capacity={"ephemeral-storage": 10 << 30}, storage_class="standard")
+    cluster.store.create(pv)
+    pvc = obj.PersistentVolumeClaim(
+        metadata=obj.ObjectMeta(name="claim1", namespace="default"),
+        request={"ephemeral-storage": 5 << 30}, storage_class="standard")
+    cluster.store.create(pvc)
+    assert wait_until(
+        lambda: cluster.store.get("PersistentVolumeClaim", "default/claim1").phase == "Bound",
+        timeout=3)
+    got = cluster.store.get("PersistentVolumeClaim", "default/claim1")
+    assert got.volume_name == "pv1"
+    # dynamic provisioning when nothing matches
+    pvc2 = obj.PersistentVolumeClaim(
+        metadata=obj.ObjectMeta(name="claim2", namespace="default"),
+        request={"ephemeral-storage": 50 << 30}, storage_class="standard")
+    cluster.store.create(pvc2)
+    assert wait_until(
+        lambda: cluster.store.get("PersistentVolumeClaim", "default/claim2").phase == "Bound",
+        timeout=3)

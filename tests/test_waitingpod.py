@@ -1,0 +1,43 @@
+"""Permit-wait machinery tests (reference minisched/waitingpod/waitingpod.go)."""
+import time
+
+from minisched_tpu.engine.waitingpod import WaitingPod
+from tests.test_encode import pod
+
+
+def test_allow_last_pending_signals():
+    wp = WaitingPod(pod("p"), "n1", [("A", 0, 5), ("B", 0, 5)])
+    wp.allow("A")
+    assert wp.get_signal(timeout=0.05) is None  # B still pending
+    wp.allow("B")
+    sig = wp.get_signal(timeout=1)
+    assert sig is not None and sig.allowed
+
+
+def test_reject_wins_immediately():
+    wp = WaitingPod(pod("p"), "n1", [("A", 0, 5), ("B", 0, 5)])
+    wp.reject("A", "nope")
+    sig = wp.get_signal(timeout=1)
+    assert sig is not None and not sig.allowed and "nope" in sig.reason
+
+
+def test_first_signal_wins():
+    wp = WaitingPod(pod("p"), "n1", [("A", 0, 5)])
+    wp.allow("A")
+    wp.reject("A", "late")  # non-blocking send dropped (waitingpod.go:93-98)
+    sig = wp.get_signal(timeout=1)
+    assert sig.allowed
+
+
+def test_auto_allow_after_delay():
+    wp = WaitingPod(pod("p"), "n1", [("A", 0.1, 5)])
+    t0 = time.monotonic()
+    sig = wp.get_signal(timeout=2)
+    assert sig is not None and sig.allowed
+    assert time.monotonic() - t0 >= 0.09
+
+
+def test_timeout_rejects():
+    wp = WaitingPod(pod("p"), "n1", [("A", 0, 0.1)])
+    sig = wp.get_signal(timeout=2)
+    assert sig is not None and not sig.allowed and "timeout" in sig.reason
