@@ -102,13 +102,21 @@ class NodeFeatureCache:
 
     def snapshot(self, pad: Optional[int] = None) -> Tuple[NodeFeatures, List[Optional[str]]]:
         """Copy of the feature arrays padded to ``pad`` (default: bucketed
-        capacity), plus the row→name mapping (None = empty row)."""
+        capacity), plus the row→name mapping (None = empty row).
+
+        ``pad`` may be smaller than capacity when every row beyond it is
+        empty (e.g. capacity doubled to 64k for 50k nodes; a 51200 pad
+        avoids wasting 30% of the matrices on padding)."""
         with self._lock:
             n = self._capacity
             target = pad if pad is not None else bucket_for(n)
-            if target < n:
-                raise ValueError(f"pad {target} < live capacity {n}")
             f = self._feats
+            if target < n:
+                if f.valid[target:].any():
+                    raise ValueError(
+                        f"pad {target} < capacity {n} with live rows beyond it")
+                feats = NodeFeatures(*(a[:target].copy() for a in f))
+                return feats, list(self._names[:target])
             if target == n:
                 feats = NodeFeatures(*(a.copy() for a in f))
             else:

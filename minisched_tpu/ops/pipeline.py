@@ -19,7 +19,6 @@ full matrix, fixing the in-loop quirk at minisched.go:178-183.
 """
 from __future__ import annotations
 
-import functools
 from typing import List, NamedTuple, Optional
 
 import jax
@@ -47,8 +46,7 @@ class Decision(NamedTuple):
 _STEP_CACHE: dict = {}
 
 
-def build_step(plugin_set: PluginSet, *, explain: bool = False,
-               donate_free: bool = True):
+def build_step(plugin_set: PluginSet, *, explain: bool = False):
     """Compile the scheduling step for a plugin profile.
 
     Returns jitted ``step(pf, nf, key) -> Decision``. pf/nf are

@@ -1,0 +1,2 @@
+from .mesh import make_mesh, shard_features  # noqa: F401
+from .sharded import build_sharded_step  # noqa: F401

@@ -1,0 +1,77 @@
+"""Device-mesh construction and feature shardings.
+
+The scaling axis of a scheduler is node count × pending-pod count (SURVEY §5
+"long-context" note): the (P × N) constraint/score matrices take the role
+sequence length plays in an ML model. The sharding layout:
+
+  * mesh axes ("pod", "node") — pod axis is the data-parallel-like axis,
+    node axis the tensor-parallel-like axis.
+  * NodeFeatures arrays shard along their leading N dim over "node";
+    PodFeatures along P over "pod"; (P, N) intermediates over both.
+  * cross-node reductions (row max in normalize, argmax in selection, psum
+    for topology-spread counts) become XLA collectives over ICI inserted by
+    GSPMD from these annotations — the jax.sharding + pjit recipe, replacing
+    the reference's "move state to where compute happens" client-go/etcd
+    hub (SURVEY §2 distributed-communication row).
+
+The reference itself has no DP/TP analog (single goroutine, SURVEY §2);
+this module is the rebuild's scale-out answer (BASELINE config 4: "masked
+psum over node-sharded mesh").
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+POD_AXIS = "pod"
+NODE_AXIS = "node"
+
+
+def make_mesh(devices: Optional[Sequence] = None,
+              pod_axis_size: Optional[int] = None) -> Mesh:
+    """Build a ("pod", "node") mesh over the given (default: all) devices.
+
+    The node axis gets the larger share: at 50k nodes the node dimension
+    dominates memory and bandwidth, so collectives along it should ride the
+    densest ICI dimension.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if pod_axis_size is None:
+        pod_axis_size = 2 if n % 2 == 0 and n >= 4 else 1
+    if n % pod_axis_size:
+        raise ValueError(f"{n} devices not divisible by pod axis {pod_axis_size}")
+    arr = np.array(devs).reshape(pod_axis_size, n // pod_axis_size)
+    return Mesh(arr, (POD_AXIS, NODE_AXIS))
+
+
+def node_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(NODE_AXIS))
+
+
+def pod_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(POD_AXIS))
+
+
+def feature_shardings(mesh: Mesh, pf_template, nf_template) -> Tuple:
+    """Per-leaf NamedShardings: leading dim of every pod-feature leaf over
+    "pod", of every node-feature leaf over "node"; trailing dims replicated."""
+
+    def spec_for(arr, axis_name):
+        extra = (None,) * (arr.ndim - 1)
+        return NamedSharding(mesh, P(axis_name, *extra))
+
+    pf_sh = type(pf_template)(*(spec_for(a, POD_AXIS) for a in pf_template))
+    nf_sh = type(nf_template)(*(spec_for(a, NODE_AXIS) for a in nf_template))
+    return pf_sh, nf_sh
+
+
+def shard_features(mesh: Mesh, pf, nf):
+    """Device-put feature pytrees with their canonical shardings."""
+    pf_sh, nf_sh = feature_shardings(mesh, pf, nf)
+    pf_dev = type(pf)(*(jax.device_put(a, s) for a, s in zip(pf, pf_sh)))
+    nf_dev = type(nf)(*(jax.device_put(a, s) for a, s in zip(nf, nf_sh)))
+    return pf_dev, nf_dev

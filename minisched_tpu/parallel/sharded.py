@@ -1,0 +1,50 @@
+"""Sharded scheduling step: the batched pipeline over a device mesh.
+
+Same computation as ops.pipeline.build_step, annotated with shardings so
+GSPMD partitions the (P × N) plugin matrices over the ("pod", "node") mesh
+and inserts the collectives (all-reduce max/argmax along the node axis for
+normalization and selection, all-gathers where the greedy scan needs global
+state). The greedy scan's carried free-resource matrix stays node-sharded;
+each scan iteration's argmax is a small collective — latency-bound but
+correct; the throughput-critical filter/score math is fully parallel.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops.pipeline import Decision, build_step
+from ..plugins.base import PluginSet
+from .mesh import NODE_AXIS, POD_AXIS, feature_shardings
+
+
+def build_sharded_step(plugin_set: PluginSet, mesh, pf_template, nf_template,
+                       *, explain: bool = False):
+    """Compile the scheduling step with mesh shardings.
+
+    pf_template/nf_template supply leaf ranks for the sharding specs (any
+    correctly-shaped PodFeatures/NodeFeatures, e.g. one batch's arrays).
+    Returns ``step(pf, nf, key) -> Decision`` with inputs auto-partitioned.
+    """
+    pf_sh, nf_sh = feature_shardings(mesh, pf_template, nf_template)
+    key_sh = NamedSharding(mesh, P())  # replicated PRNG key
+
+    # Build the *traced* computation once (unjitted body reused from the
+    # single-chip path), then wrap with sharding-annotated jit.
+    inner = build_step(plugin_set, explain=explain)
+
+    def stepfn(pf, nf, key):
+        return inner(pf, nf, key)
+
+    both = NamedSharding(mesh, P(POD_AXIS, NODE_AXIS))
+    pod_only = NamedSharding(mesh, P(POD_AXIS))
+    node_res = NamedSharding(mesh, P(NODE_AXIS, None))
+    stack_both = NamedSharding(mesh, P(None, POD_AXIS, NODE_AXIS))
+    out_sh = Decision(
+        chosen=pod_only, assigned=pod_only, feasible_counts=pod_only,
+        reject_counts=NamedSharding(mesh, P(None, POD_AXIS)),
+        total_scores=both, free_after=node_res,
+        filter_masks=stack_both, raw_scores=stack_both, norm_scores=stack_both)
+
+    return jax.jit(stepfn, in_shardings=(pf_sh, nf_sh, key_sh),
+                   out_shardings=out_sh)

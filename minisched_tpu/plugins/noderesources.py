@@ -1,0 +1,93 @@
+"""Node-resources plugins: Fit filter + allocation scorers.
+
+Batched counterparts of the upstream plugins the reference wraps for the
+simulator (reference scheduler/plugin/plugins.go:24-70 registry rows
+NodeResourcesFit / NodeResourcesLeastAllocated / NodeResourcesMostAllocated /
+NodeResourcesBalancedAllocation; BASELINE config 3 names Fit+LeastAllocated
+as the dense-matrix benchmark pair).
+
+All operate on the free/allocatable columns of NodeFeatures against pod
+request vectors — pure (P × N) arithmetic on the resource axis.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..state.events import ActionType, ClusterEvent, GVK
+from .base import BatchedPlugin
+
+_EPS = 1e-9
+
+
+class NodeResourcesFit(BatchedPlugin):
+    """Filter: node's free resources cover the pod's requests on every
+    tracked dimension (upstream noderesources.Fit)."""
+
+    name = "NodeResourcesFit"
+
+    def events_to_register(self):
+        # Upstream: {Pod, Delete} (capacity freed) + {Node, Add|Update}.
+        return [ClusterEvent(GVK.POD, ActionType.DELETE),
+                ClusterEvent(GVK.NODE, ActionType.ADD | ActionType.UPDATE)]
+
+    def filter(self, pf, nf) -> jnp.ndarray:
+        # (P,1,R) <= (1,N,R) reduced over R
+        return jnp.all(pf.requests[:, None, :] <= nf.free[None, :, :] + _EPS,
+                       axis=2)
+
+
+class _AllocationScorer(BatchedPlugin):
+    """Shared math: per-resource utilization after placing the pod."""
+
+    def events_to_register(self):
+        return [ClusterEvent(GVK.POD, ActionType.DELETE),
+                ClusterEvent(GVK.NODE, ActionType.ADD | ActionType.UPDATE)]
+
+    def _utilization(self, pf, nf) -> jnp.ndarray:
+        """(P,N,R) requested fraction of allocatable after hypothetical
+        placement: (allocatable - free + request) / allocatable."""
+        alloc = nf.allocatable[None, :, :]
+        used = alloc - nf.free[None, :, :] + pf.requests[:, None, :]
+        return jnp.where(alloc > 0, used / jnp.maximum(alloc, _EPS), 0.0)
+
+
+class NodeResourcesLeastAllocated(_AllocationScorer):
+    """Score 0..100, higher for emptier nodes (upstream leastAllocatedScorer:
+    mean over resources of (capacity - used)/capacity × 100)."""
+
+    name = "NodeResourcesLeastAllocated"
+
+    def score(self, pf, nf) -> jnp.ndarray:
+        util = self._utilization(pf, nf)
+        present = nf.allocatable[None, :, :] > 0
+        frac_free = jnp.where(present, 1.0 - util, 0.0)
+        denom = jnp.maximum(present.sum(axis=2), 1)
+        return 100.0 * frac_free.sum(axis=2) / denom
+
+
+class NodeResourcesMostAllocated(_AllocationScorer):
+    """Score 0..100, higher for fuller nodes (bin-packing preference)."""
+
+    name = "NodeResourcesMostAllocated"
+
+    def score(self, pf, nf) -> jnp.ndarray:
+        util = self._utilization(pf, nf)
+        present = nf.allocatable[None, :, :] > 0
+        denom = jnp.maximum(present.sum(axis=2), 1)
+        return 100.0 * jnp.where(present, jnp.clip(util, 0.0, 1.0), 0.0).sum(axis=2) / denom
+
+
+class NodeResourcesBalancedAllocation(_AllocationScorer):
+    """Score 0..100, higher when per-resource utilizations are mutually
+    close (upstream balanced-allocation: 100 - stddev×100 over fractions)."""
+
+    name = "NodeResourcesBalancedAllocation"
+
+    def score(self, pf, nf) -> jnp.ndarray:
+        util = self._utilization(pf, nf)
+        present = nf.allocatable[None, :, :] > 0
+        count = jnp.maximum(present.sum(axis=2), 1)
+        u = jnp.where(present, jnp.clip(util, 0.0, 1.0), 0.0)
+        mean = u.sum(axis=2) / count
+        var = jnp.where(present, (u - mean[:, :, None]) ** 2, 0.0).sum(axis=2) / count
+        return 100.0 - jnp.sqrt(var) * 100.0

@@ -100,9 +100,19 @@ class PVController:
             pv.claim_ref = pvc.key
             pv.phase = "Bound"
             self._store.update(pv)
+        except (ConflictError, NotFoundError):
+            return
+        try:
             pvc.volume_name = pv.metadata.name
             pvc.phase = "Bound"
             self._store.update(pvc)
             log.info("bound PVC %s to PV %s", pvc.key, pv.metadata.name)
         except (ConflictError, NotFoundError):
-            pass
+            # PVC vanished mid-bind: roll the PV back to Available so its
+            # capacity isn't stranded behind a dangling claim_ref.
+            try:
+                pv.claim_ref = ""
+                pv.phase = "Available"
+                self._store.update(pv)
+            except (ConflictError, NotFoundError):
+                pass

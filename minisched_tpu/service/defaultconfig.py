@@ -42,6 +42,18 @@ def make_plugin(name: str, **args) -> BatchedPlugin:
 register_plugin("NodeUnschedulable", NodeUnschedulable)
 register_plugin("NodeNumber", NodeNumber)
 
+from ..plugins.noderesources import (  # noqa: E402
+    NodeResourcesBalancedAllocation,
+    NodeResourcesFit,
+    NodeResourcesLeastAllocated,
+    NodeResourcesMostAllocated,
+)
+
+register_plugin("NodeResourcesFit", NodeResourcesFit)
+register_plugin("NodeResourcesLeastAllocated", NodeResourcesLeastAllocated)
+register_plugin("NodeResourcesMostAllocated", NodeResourcesMostAllocated)
+register_plugin("NodeResourcesBalancedAllocation", NodeResourcesBalancedAllocation)
+
 
 @dataclass
 class Profile:

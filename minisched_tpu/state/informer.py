@@ -74,9 +74,17 @@ class InformerFactory:
 
     # ---- dispatch -------------------------------------------------------
 
+    # Initial-sync delivery order: nodes and volumes before pods, so
+    # handlers that account pods against node state (feature-cache bind
+    # accounting) see the nodes first on restart/restore.
+    SYNC_ORDER = ("Node", "PersistentVolume", "PersistentVolumeClaim",
+                  "Pod", "Event")
+
     def _run(self, initial: Dict[str, List[Any]]) -> None:
-        for kind, objs in initial.items():
-            for o in objs:
+        ordered = sorted(initial, key=lambda k: (
+            self.SYNC_ORDER.index(k) if k in self.SYNC_ORDER else len(self.SYNC_ORDER)))
+        for kind in ordered:
+            for o in initial[kind]:
                 self._dispatch(WatchEvent(EventType.ADDED, kind, o))
         self._synced.set()
         while not self._stop.is_set():

@@ -188,6 +188,36 @@ class ClusterStore:
             updated.status.message = ""
             return self.update(updated)
 
+    def bind_pods(self, assignments) -> int:
+        """Bulk binding commit: one lock acquisition for a whole batch of
+        (pod_key, node_name) pairs; returns how many bound. Pods already
+        bound/deleted or nodes gone are skipped (callers re-schedule).
+        Uses dataclasses.replace instead of deep copies — stored objects are
+        replacement-only, so structural sharing with superseded versions is
+        safe; watch events carry the same immutable-by-convention snapshots."""
+        import dataclasses as _dc
+
+        bound = 0
+        with self._cond:
+            for pod_key, node_name in assignments:
+                pod = self._objects["Pod"].get(pod_key)
+                if pod is None or pod.spec.node_name:
+                    continue
+                if node_name not in self._objects["Node"]:
+                    continue
+                self._rv += 1
+                new = _dc.replace(
+                    pod,
+                    metadata=_dc.replace(pod.metadata, resource_version=self._rv),
+                    spec=_dc.replace(pod.spec, node_name=node_name),
+                    status=_dc.replace(pod.status, phase=obj.PodPhase.RUNNING,
+                                       unschedulable_plugins=[], message=""))
+                self._objects["Pod"][pod_key] = new
+                self._append(WatchEvent(EventType.MODIFIED, "Pod", new, pod,
+                                        self._rv))
+                bound += 1
+        return bound
+
     # ---- Watch ----------------------------------------------------------
 
     def watch(self, kinds: Optional[List[str]] = None,

@@ -6,10 +6,24 @@ exercised without TPU hardware. Env vars must be set before jax imports.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the ambient environment may pin JAX_PLATFORMS to the TPU
+# tunnel; tests must run on the virtual CPU mesh regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The ambient TPU platform plugin may ignore JAX_PLATFORMS and still present
+# the real chip as the default backend; pin all test computation to the
+# virtual CPU devices.
+import jax  # noqa: E402
+
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+
+def cpu_devices(n: int = 8):
+    devs = jax.devices("cpu")
+    return devs[:n] if len(devs) >= n else None

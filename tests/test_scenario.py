@@ -125,6 +125,60 @@ def test_restart_scheduler_resumes(cluster):
     assert bound.spec.node_name == "rescue1"
 
 
+def test_restart_rebuilds_bind_accounting(cluster):
+    """Bound-pod capacity accounting must survive a restart: the informer's
+    initial sync delivers Nodes before Pods so account_bind lands."""
+    cluster.start(config=fast_config())
+    cluster.create_node("packed", cpu=1000)
+    cluster.create_pod("occupant", cpu=800)
+    cluster.wait_for_pod_bound("occupant", timeout=10)
+
+    cluster.service.restart_scheduler()
+    sched = cluster.service.scheduler
+    assert wait_until(lambda: sched.cache.node_count() == 1, timeout=5)
+    row = sched.cache.row_of("packed")
+    nf, _ = sched.cache.snapshot()
+    assert nf.free[row, 0] == 200  # 1000 - 800 re-accounted after restart
+
+
+def test_cordoned_node_tolerated_by_exists_toleration():
+    """Upstream semantics: a pod tolerating the unschedulable taint may land
+    on a cordoned node; an Equal toleration with a non-empty value must NOT
+    match the implicit taint (its value is empty)."""
+    import jax
+
+    from minisched_tpu.encode import NodeFeatureCache, encode_pods
+    from minisched_tpu.ops import build_step
+    from minisched_tpu.plugins import NodeUnschedulable, PluginSet
+    from minisched_tpu.state.objects import Toleration
+    from tests.test_encode import node, pod
+
+    c = NodeFeatureCache()
+    c.upsert_node(node("cordoned", unsched=True))
+    nf, _ = c.snapshot()
+
+    tolerant = pod("tolerant")
+    tolerant.spec.tolerations = [Toleration(
+        key="node.kubernetes.io/unschedulable", operator="Exists",
+        effect="NoSchedule")]
+    wrong_value = pod("wrongval")
+    wrong_value.spec.tolerations = [Toleration(
+        key="node.kubernetes.io/unschedulable", operator="Equal",
+        value="true", effect="NoSchedule")]
+    plain = pod("plain")
+
+    pf = encode_pods([tolerant, wrong_value, plain], 16)
+    d = build_step(PluginSet([NodeUnschedulable()]), explain=True)(
+        pf, nf, jax.random.PRNGKey(0))
+    import numpy as np
+
+    mask = np.asarray(d.filter_masks[0])
+    row = 0  # single node row 0
+    assert mask[0, row]       # Exists toleration → allowed
+    assert not mask[1, row]   # Equal with wrong value → rejected
+    assert not mask[2, row]   # no toleration → rejected
+
+
 def test_explain_annotations_recorded():
     """Explainability parity (reference resultstore → pod annotations)."""
     import json
