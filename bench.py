@@ -84,15 +84,16 @@ def main() -> None:
     step = build_step(plugin_set, explain=False)
 
     p_pad, n_pad = pad_to(n_pods), pad_to(n_nodes)
-    nf, names = cache.snapshot(pad=n_pad)
     key = jax.random.PRNGKey(0)
 
     t0 = time.perf_counter()
-    pf = encode_pods(pods, p_pad)
+    eb = encode_pods(pods, p_pad, registry=cache.registry)
     encode_s = time.perf_counter() - t0
+    nf, names = cache.snapshot(pad=n_pad)
+    af = cache.snapshot_assigned()
 
     t0 = time.perf_counter()
-    decision = step(pf, nf, key)
+    decision = step(eb, nf, af, key)
     jax.block_until_ready(decision.chosen)
     compile_s = time.perf_counter() - t0
 
@@ -101,9 +102,9 @@ def main() -> None:
     runs = []  # (scheduled, total_s) pairs, kept together per repeat
     for r in range(repeats):
         t_start = time.perf_counter()
-        pf = encode_pods(pods, p_pad)
+        eb = encode_pods(pods, p_pad, registry=cache.registry)
         t_enc = time.perf_counter()
-        d = step(pf, nf, jax.random.fold_in(key, r))
+        d = step(eb, nf, af, jax.random.fold_in(key, r))
         chosen = np.asarray(d.chosen)
         assigned = np.asarray(d.assigned)
         t_dev = time.perf_counter()

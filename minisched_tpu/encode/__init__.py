@@ -1,7 +1,12 @@
 from .features import (  # noqa: F401
+    AssignedPodFeatures,
+    EncodedBatch,
     EncodingConfig,
+    GroupFeatures,
+    NodeAffinityGroups,
     NodeFeatures,
     PodFeatures,
+    TopologyKeyRegistry,
     encode_pods,
     name_suffix_digit,
     pair_hash,

@@ -16,7 +16,8 @@ import numpy as np
 
 from ..state.objects import Node, Pod, pod_requests
 from . import features as F
-from .features import EncodingConfig, NodeFeatures, DEFAULT_ENCODING
+from .features import (AssignedPodFeatures, DEFAULT_ENCODING, EncodingConfig,
+                       NodeFeatures, TopologyKeyRegistry)
 
 
 def bucket_for(n: int, minimum: int = 16) -> int:
@@ -30,7 +31,8 @@ def bucket_for(n: int, minimum: int = 16) -> int:
 class NodeFeatureCache:
     """Thread-safe incrementally-maintained node feature arrays."""
 
-    def __init__(self, cfg: EncodingConfig = DEFAULT_ENCODING, capacity: int = 64):
+    def __init__(self, cfg: EncodingConfig = DEFAULT_ENCODING, capacity: int = 64,
+                 registry: Optional[TopologyKeyRegistry] = None):
         self.cfg = cfg
         self._lock = threading.Lock()
         self._feats = F.empty_node_features(capacity, cfg)
@@ -43,6 +45,16 @@ class NodeFeatureCache:
         self._bound: Dict[str, Tuple[int, np.ndarray, List[int]]] = {}
         self.overflow: List[str] = []  # encoding-slot overflow reports
         self.version = 0  # bumped on every mutation (cheap staleness check)
+        # topology keys shared with pod encoding; new registrations trigger
+        # a domain-table refresh at the next snapshot
+        self.registry = registry or TopologyKeyRegistry(cfg)
+        self._topo_version = self.registry.version
+        # assigned-pod corpus for topology-spread / inter-pod-affinity
+        a_cap = max(64, capacity)
+        self._assigned = F.empty_assigned_features(a_cap, cfg)
+        self._a_capacity = a_cap
+        self._a_free: List[int] = list(range(a_cap - 1, -1, -1))
+        self._a_row: Dict[str, int] = {}  # pod key → assigned row
 
     # ---- node lifecycle -------------------------------------------------
 
@@ -55,6 +67,7 @@ class NodeFeatureCache:
                 self._names[i] = node.metadata.name
             # Re-encoding resets static columns; free is derived below.
             F.encode_node_into(self._feats, i, node, self.overflow)
+            F.compute_topo_domains_row(self._feats, i, self.registry, self.cfg)
             self._recompute_free_row(i)
             self.version += 1
 
@@ -68,13 +81,21 @@ class NodeFeatureCache:
             self._free_rows.append(i)
             # Bound-pod accounting rows pointing at this node are dropped;
             # their pods will be rescheduled by higher layers.
-            self._bound = {k: v for k, v in self._bound.items() if v[0] != i}
+            gone = [k for k, v in self._bound.items() if v[0] == i]
+            for k in gone:
+                del self._bound[k]
+                a = self._a_row.pop(k, None)
+                if a is not None:
+                    self._assigned.valid[a] = False
+                    self._assigned.label_pairs[a] = 0
+                    self._a_free.append(a)
             self.version += 1
 
     # ---- pod accounting -------------------------------------------------
 
     def account_bind(self, pod: Pod) -> None:
-        """Pod became bound: subtract its requests from the node's free row."""
+        """Pod became bound: subtract its requests from the node's free row
+        and add it to the assigned-pod corpus."""
         with self._lock:
             i = self._index.get(pod.spec.node_name)
             if i is None or pod.key in self._bound:
@@ -84,6 +105,21 @@ class NodeFeatureCache:
             self._bound[pod.key] = (i, req, ports)
             self._feats.free[i] -= req
             self._add_ports(i, ports)
+
+            a = self._alloc_assigned_row()
+            self._a_row[pod.key] = a
+            self._assigned.valid[a] = True
+            self._assigned.node_row[a] = i
+            self._assigned.ns_hash[a] = (F._h(pod.metadata.namespace)
+                                         if pod.metadata.namespace else 0)
+            self._assigned.label_pairs[a] = 0
+            labels = list(pod.metadata.labels.items())
+            if len(labels) > self.cfg.max_labels:
+                self.overflow.append(
+                    f"assigned pod {pod.key} labels: {len(labels)} > "
+                    f"{self.cfg.max_labels} slots")
+            for j, (k, v) in enumerate(labels[:self.cfg.max_labels]):
+                self._assigned.label_pairs[a, j] = F.pair_hash(k, v)
             self.version += 1
 
     def account_unbind(self, pod_key: str) -> None:
@@ -96,6 +132,11 @@ class NodeFeatureCache:
             if self._names[i] is not None:
                 self._feats.free[i] += req
                 self._remove_ports(i, ports)
+            a = self._a_row.pop(pod_key, None)
+            if a is not None:
+                self._assigned.valid[a] = False
+                self._assigned.label_pairs[a] = 0
+                self._a_free.append(a)
             self.version += 1
 
     # ---- snapshot -------------------------------------------------------
@@ -108,23 +149,53 @@ class NodeFeatureCache:
         empty (e.g. capacity doubled to 64k for 50k nodes; a 51200 pad
         avoids wasting 30% of the matrices on padding)."""
         with self._lock:
+            self._refresh_topology_locked()
             n = self._capacity
             target = pad if pad is not None else bucket_for(n)
             f = self._feats
+            # topo_domains is (K, N) — its node axis is axis 1.
             if target < n:
                 if f.valid[target:].any():
                     raise ValueError(
                         f"pad {target} < capacity {n} with live rows beyond it")
-                feats = NodeFeatures(*(a[:target].copy() for a in f))
+                feats = NodeFeatures(*(
+                    a[:, :target].copy() if name == "topo_domains"
+                    else a[:target].copy()
+                    for name, a in zip(f._fields, f)))
                 return feats, list(self._names[:target])
             if target == n:
                 feats = NodeFeatures(*(a.copy() for a in f))
             else:
                 empty = F.empty_node_features(target, self.cfg)
-                for a, e in zip(f, empty):
-                    e[:n] = a
+                for name, a, e in zip(f._fields, f, empty):
+                    if name == "topo_domains":
+                        e[:, :n] = a
+                    else:
+                        e[:n] = a
                 feats = empty
             return feats, list(self._names) + [None] * (target - n)
+
+    def snapshot_assigned(self, pad: Optional[int] = None) -> AssignedPodFeatures:
+        """Copy of the assigned-pod corpus padded/truncated like snapshot()."""
+        with self._lock:
+            a = self._a_capacity
+            target = pad if pad is not None else bucket_for(a)
+            f = self._assigned
+            if target < a:
+                if f.valid[target:].any():
+                    raise ValueError(
+                        f"assigned pad {target} < capacity {a} with live rows")
+                return AssignedPodFeatures(*(x[:target].copy() for x in f))
+            if target == a:
+                return AssignedPodFeatures(*(x.copy() for x in f))
+            empty = F.empty_assigned_features(target, self.cfg)
+            for x, e in zip(f, empty):
+                e[:a] = x
+            return empty
+
+    def assigned_count(self) -> int:
+        with self._lock:
+            return len(self._a_row)
 
     def node_count(self) -> int:
         with self._lock:
@@ -140,13 +211,36 @@ class NodeFeatureCache:
         if not self._free_rows:
             new_cap = self._capacity * 2
             grown = F.empty_node_features(new_cap, self.cfg)
-            for a, g in zip(self._feats, grown):
-                g[: self._capacity] = a
+            for name, a, g in zip(self._feats._fields, self._feats, grown):
+                if name == "topo_domains":  # node axis is axis 1
+                    g[:, : self._capacity] = a
+                else:
+                    g[: self._capacity] = a
             self._feats = grown
             self._names += [None] * (new_cap - self._capacity)
             self._free_rows = list(range(new_cap - 1, self._capacity - 1, -1))
             self._capacity = new_cap
         return self._free_rows.pop()
+
+    def _alloc_assigned_row(self) -> int:
+        if not self._a_free:
+            new_cap = self._a_capacity * 2
+            grown = F.empty_assigned_features(new_cap, self.cfg)
+            for x, g in zip(self._assigned, grown):
+                g[: self._a_capacity] = x
+            self._assigned = grown
+            self._a_free = list(range(new_cap - 1, self._a_capacity - 1, -1))
+            self._a_capacity = new_cap
+        return self._a_free.pop()
+
+    def _refresh_topology_locked(self) -> None:
+        """Recompute domain tables if new topology keys registered since the
+        last snapshot (pod encoding may grow the shared registry)."""
+        if self._topo_version == self.registry.version:
+            return
+        for name, i in self._index.items():
+            F.compute_topo_domains_row(self._feats, i, self.registry, self.cfg)
+        self._topo_version = self.registry.version
 
     def _recompute_free_row(self, i: int) -> None:
         free = self._feats.allocatable[i].copy()

@@ -71,6 +71,20 @@ class EncodingConfig:
     max_ports: int = 8          # host ports in use per node
     max_pod_ports: int = 4      # host ports requested per pod
     max_images: int = 4         # images per node / per pod
+    # topology-aware plugins (PodTopologySpread / InterPodAffinity)
+    max_topology_keys: int = 4   # registered topology keys (slot 0=hostname)
+    max_spread_constraints: int = 2  # constraints per pod
+    max_pod_affinity_terms: int = 2  # terms per pod per kind (req/pref × aff/anti)
+    max_term_selector_pairs: int = 4  # match_labels pairs per term selector
+    domain_buckets: int = 4096   # hashed domain space for non-hostname keys
+
+
+# Spread when_unsatisfiable codes.
+SPREAD_NONE = 0
+SPREAD_DO_NOT_SCHEDULE = 1
+SPREAD_SCHEDULE_ANYWAY = 2
+
+HOSTNAME_KEY = "kubernetes.io/hostname"
 
 
 DEFAULT_ENCODING = EncodingConfig()
@@ -110,6 +124,38 @@ def resources_vector(rl: obj.ResourceList) -> np.ndarray:
     return v
 
 
+class TopologyKeyRegistry:
+    """Stable string→index registry for topology keys referenced by spread
+    constraints and pod-affinity terms. Slot 0 is always
+    kubernetes.io/hostname (its domains are node rows). The registry is
+    shared between node and pod encoding so domain tables and constraint
+    indices agree; growing it bumps ``version`` so caches can refresh."""
+
+    def __init__(self, cfg: EncodingConfig = DEFAULT_ENCODING):
+        self.max = cfg.max_topology_keys
+        self._keys = [HOSTNAME_KEY]
+        self._idx = {HOSTNAME_KEY: 0}
+        self.version = 1
+
+    def index_of(self, key: str, overflow: Optional[List[str]] = None) -> int:
+        idx = self._idx.get(key)
+        if idx is not None:
+            return idx
+        if len(self._keys) >= self.max:
+            if overflow is not None:
+                overflow.append(
+                    f"topology key registry full ({self.max}); "
+                    f"cannot register {key!r}")
+            return -1
+        self._idx[key] = len(self._keys)
+        self._keys.append(key)
+        self.version += 1
+        return self._idx[key]
+
+    def keys(self) -> List[str]:
+        return list(self._keys)
+
+
 class NodeFeatures(NamedTuple):
     """Dense per-node features, shape leading dim N (padded)."""
 
@@ -118,6 +164,7 @@ class NodeFeatures(NamedTuple):
     allocatable: np.ndarray    # (N,R) f32
     free: np.ndarray           # (N,R) f32 — allocatable minus bound requests
     name_suffix: np.ndarray    # (N,) i32
+    name_hash: np.ndarray      # (N,) i32 hash(node name)
     label_pairs: np.ndarray    # (N,L) i32 hash(key=value)
     label_keys: np.ndarray     # (N,L) i32 hash(key)
     taint_pairs: np.ndarray    # (N,T) i32
@@ -125,30 +172,102 @@ class NodeFeatures(NamedTuple):
     taint_effects: np.ndarray  # (N,T) i32
     used_ports: np.ndarray     # (N,PORT) i32
     images: np.ndarray         # (N,IM) i32
+    # topology domains: row k = this node's domain id under registered
+    # topology key k (-1 = key absent). Slot 0 is kubernetes.io/hostname,
+    # whose domain id is the node's own row; other keys hash their label
+    # value into EncodingConfig.domain_buckets.
+    topo_domains: np.ndarray   # (K,N) i32
+
+
+class AssignedPodFeatures(NamedTuple):
+    """Dense features of pods already bound to nodes — the corpus that
+    topology-spread / inter-pod-affinity counts are computed against
+    (leading dim A, padded)."""
+
+    valid: np.ndarray        # (A,) bool
+    node_row: np.ndarray     # (A,) i32 row of the node the pod is bound to
+    ns_hash: np.ndarray      # (A,) i32 hash(namespace)
+    label_pairs: np.ndarray  # (A,L) i32 hash(key=value) of the pod's labels
 
 
 class PodFeatures(NamedTuple):
-    """Dense per-pod features, shape leading dim P (padded)."""
+    """Dense per-pod features, shape leading dim P (padded).
+
+    Node-selector / node-affinity constraints live in NodeAffinityGroups
+    (na_group column) and topology constraints in GroupFeatures — per-pod
+    dense matching would cost O(P×N×…) at 50k nodes; pods sharing a
+    deployment share constraint signatures, so matching runs per GROUP."""
 
     valid: np.ndarray        # (P,) bool
     requests: np.ndarray     # (P,R) f32 (includes the implicit pods:1 slot)
     name_suffix: np.ndarray  # (P,) i32
     priority: np.ndarray     # (P,) i32
-    sel_pairs: np.ndarray    # (P,Q) i32 — node_selector, ANDed pair hashes
-    aff_op: np.ndarray       # (P,T,E) i32 — required node affinity
-    aff_key: np.ndarray      # (P,T,E) i32
-    aff_vals: np.ndarray     # (P,T,E,V) i32
-    aff_has: np.ndarray      # (P,) bool — pod has required affinity terms
-    pref_weight: np.ndarray  # (P,T2) f32 — preferred node affinity
-    pref_op: np.ndarray      # (P,T2,E) i32
-    pref_key: np.ndarray     # (P,T2,E) i32
-    pref_vals: np.ndarray    # (P,T2,E,V) i32
+    na_group: np.ndarray     # (P,) i32 node-affinity group, -1 = unconstrained
     tol_pairs: np.ndarray    # (P,K) i32
     tol_keys: np.ndarray     # (P,K) i32
     tol_ops: np.ndarray      # (P,K) i32
     tol_effects: np.ndarray  # (P,K) i32
     ports: np.ndarray        # (P,PP) i32 host ports requested
     images: np.ndarray       # (P,IM) i32
+    required_node: np.ndarray  # (P,) i32 hash of spec.required_node_name (0=none)
+    volumes_ready: np.ndarray  # (P,) bool — all referenced PVCs are bound
+    # Topology-aware constraints reference SELECTOR GROUPS (GroupFeatures):
+    # pods in a batch share few distinct (topology key, namespace, selector)
+    # combinations — one deployment's replicas all carry the same constraint
+    # — so per-group match/count tensors replace per-pod ones (the key to
+    # making spread/affinity MXU- and memory-friendly at 50k nodes).
+    spread_group: np.ndarray     # (P,C) i32 group index, -1 = unused slot
+    spread_max_skew: np.ndarray  # (P,C) i32
+    spread_mode: np.ndarray      # (P,C) i32 SPREAD_* code
+    aff_req_group: np.ndarray    # (P,T) i32 required pod-affinity terms
+    aff_req_self: np.ndarray     # (P,T) bool — the pod itself matches the
+    #   term's selector+namespace (upstream: a required affinity term with
+    #   NO matching pod anywhere is satisfied if the incoming pod matches
+    #   its own term — else the first replica of a self-affine workload
+    #   could never schedule)
+    aff_pref_group: np.ndarray   # (P,T) i32 preferred pod-affinity terms
+    aff_pref_weight: np.ndarray  # (P,T) f32
+    anti_req_group: np.ndarray   # (P,T) i32 required anti-affinity terms
+    anti_pref_group: np.ndarray  # (P,T) i32 preferred anti-affinity terms
+    anti_pref_weight: np.ndarray  # (P,T) f32
+
+
+class GroupFeatures(NamedTuple):
+    """Distinct (topology key, namespace, label selector) tuples referenced
+    by a batch's spread constraints and pod-(anti-)affinity terms (leading
+    dim G, padded)."""
+
+    valid: np.ndarray      # (G,) bool
+    key_idx: np.ndarray    # (G,) i32 topology-key registry index
+    ns_hash: np.ndarray    # (G,) i32 namespace restriction (0 = any)
+    sel_pairs: np.ndarray  # (G,QT) i32 ANDed selector pair hashes (all-zero
+    #                        with valid=True means match-all, upstream empty
+    #                        selector semantics)
+
+
+class NodeAffinityGroups(NamedTuple):
+    """Distinct (node_selector, required affinity, preferred affinity)
+    signatures in a batch (leading dim G2, padded). Matching runs per group
+    over nodes, then pods gather their group's row."""
+
+    valid: np.ndarray        # (G2,) bool
+    sel_pairs: np.ndarray    # (G2,Q) i32 node_selector ANDed pair hashes
+    req_has: np.ndarray      # (G2,) bool — group has required affinity terms
+    req_op: np.ndarray       # (G2,T,E) i32
+    req_key: np.ndarray      # (G2,T,E) i32
+    req_vals: np.ndarray     # (G2,T,E,V) i32
+    pref_weight: np.ndarray  # (G2,T2) f32
+    pref_op: np.ndarray      # (G2,T2,E) i32
+    pref_key: np.ndarray     # (G2,T2,E) i32
+    pref_vals: np.ndarray    # (G2,T2,E,V) i32
+
+
+class EncodedBatch(NamedTuple):
+    """Everything encode_pods produces for one scheduling batch."""
+
+    pf: "PodFeatures"
+    gf: "GroupFeatures"        # topology-constraint selector groups
+    naf: "NodeAffinityGroups"  # node-affinity signature groups
 
 
 def empty_node_features(n: int, cfg: EncodingConfig = DEFAULT_ENCODING) -> NodeFeatures:
@@ -158,6 +277,7 @@ def empty_node_features(n: int, cfg: EncodingConfig = DEFAULT_ENCODING) -> NodeF
         allocatable=np.zeros((n, NUM_RESOURCES), dtype=np.float32),
         free=np.zeros((n, NUM_RESOURCES), dtype=np.float32),
         name_suffix=np.full(n, -1, dtype=np.int32),
+        name_hash=np.zeros(n, dtype=np.int32),
         label_pairs=np.zeros((n, cfg.max_labels), dtype=np.int32),
         label_keys=np.zeros((n, cfg.max_labels), dtype=np.int32),
         taint_pairs=np.zeros((n, cfg.max_taints), dtype=np.int32),
@@ -165,7 +285,37 @@ def empty_node_features(n: int, cfg: EncodingConfig = DEFAULT_ENCODING) -> NodeF
         taint_effects=np.zeros((n, cfg.max_taints), dtype=np.int32),
         used_ports=np.zeros((n, cfg.max_ports), dtype=np.int32),
         images=np.zeros((n, cfg.max_images), dtype=np.int32),
+        topo_domains=np.full((cfg.max_topology_keys, n), -1, dtype=np.int32),
     )
+
+
+def empty_assigned_features(a: int, cfg: EncodingConfig = DEFAULT_ENCODING
+                            ) -> AssignedPodFeatures:
+    return AssignedPodFeatures(
+        valid=np.zeros(a, dtype=bool),
+        node_row=np.zeros(a, dtype=np.int32),
+        ns_hash=np.zeros(a, dtype=np.int32),
+        label_pairs=np.zeros((a, cfg.max_labels), dtype=np.int32),
+    )
+
+
+def compute_topo_domains_row(feats: NodeFeatures, i: int,
+                             registry: TopologyKeyRegistry,
+                             cfg: EncodingConfig = DEFAULT_ENCODING) -> None:
+    """Fill topo_domains[:, i] for one node row from its label slots."""
+    feats.topo_domains[:, i] = -1
+    if not feats.valid[i]:
+        return
+    for k, key in enumerate(registry.keys()):
+        if k == 0:  # hostname: every node is its own domain
+            feats.topo_domains[0, i] = i
+            continue
+        kh = key_hash(key)
+        for l in range(cfg.max_labels):
+            if feats.label_keys[i, l] == kh:
+                feats.topo_domains[k, i] = (
+                    int(feats.label_pairs[i, l]) % cfg.domain_buckets)
+                break
 
 
 def _fill_slots(dst: np.ndarray, values: List[int], what: str,
@@ -184,6 +334,7 @@ def encode_node_into(feats: NodeFeatures, i: int, node: Node,
     feats.unschedulable[i] = node.spec.unschedulable
     feats.allocatable[i] = resources_vector(node.status.allocatable)
     feats.name_suffix[i] = name_suffix_digit(node.metadata.name)
+    feats.name_hash[i] = _h(node.metadata.name)
 
     labels = list(node.metadata.labels.items())
     if len(labels) > cfg_labels and overflow is not None:
@@ -216,6 +367,7 @@ def clear_node_row(feats: NodeFeatures, i: int) -> None:
     feats.allocatable[i] = 0
     feats.free[i] = 0
     feats.name_suffix[i] = -1
+    feats.name_hash[i] = 0
     feats.label_pairs[i] = 0
     feats.label_keys[i] = 0
     feats.taint_pairs[i] = 0
@@ -223,6 +375,7 @@ def clear_node_row(feats: NodeFeatures, i: int) -> None:
     feats.taint_effects[i] = EFFECT_NONE
     feats.used_ports[i] = 0
     feats.images[i] = 0
+    feats.topo_domains[:, i] = -1
 
 
 def _encode_term_exprs(op_row, key_row, val_row, exprs, overflow, what):
@@ -246,33 +399,227 @@ def _encode_term_exprs(op_row, key_row, val_row, exprs, overflow, what):
         val_row[e, :min(len(vals), v_max)] = vals[:v_max]
 
 
+class GroupBuilder:
+    """Dedupes (topology key index, namespace hash, selector pairs) tuples
+    into stable group ids for one batch."""
+
+    def __init__(self, cfg: EncodingConfig = DEFAULT_ENCODING):
+        self.cfg = cfg
+        self._groups: Dict[tuple, int] = {}
+
+    def group_of(self, key_idx: int, ns_hash: int, selector,
+                 overflow: Optional[List[str]], what: str) -> int:
+        if key_idx < 0:
+            return -1
+        pairs: Tuple[int, ...] = ()
+        if selector is not None:
+            if selector.match_expressions and overflow is not None:
+                overflow.append(
+                    f"{what}: match_expressions in term selector unsupported")
+            raw = sorted(pair_hash(k, v)
+                         for k, v in selector.match_labels.items())
+            if len(raw) > self.cfg.max_term_selector_pairs:
+                if overflow is not None:
+                    overflow.append(f"{what}: selector pairs overflow")
+                raw = raw[: self.cfg.max_term_selector_pairs]
+            pairs = tuple(raw)
+        sig = (key_idx, ns_hash, pairs)
+        gid = self._groups.get(sig)
+        if gid is None:
+            gid = len(self._groups)
+            self._groups[sig] = gid
+        return gid
+
+    def build(self, pad: Optional[int] = None) -> GroupFeatures:
+        n = len(self._groups)
+        target = pad if pad is not None else max(8, _next_pow2(n))
+        if n > target:
+            raise ValueError(f"{n} groups > pad {target}")
+        gf = GroupFeatures(
+            valid=np.zeros(target, dtype=bool),
+            key_idx=np.zeros(target, dtype=np.int32),
+            ns_hash=np.zeros(target, dtype=np.int32),
+            sel_pairs=np.zeros((target, self.cfg.max_term_selector_pairs),
+                               dtype=np.int32))
+        for (key_idx, ns_hash, pairs), gid in self._groups.items():
+            gf.valid[gid] = True
+            gf.key_idx[gid] = key_idx
+            gf.ns_hash[gid] = ns_hash
+            gf.sel_pairs[gid, :len(pairs)] = pairs
+        return gf
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _term_signature(term: "obj.NodeSelectorTerm") -> tuple:
+    return tuple(sorted(
+        (r.key, r.operator, tuple(sorted(r.values)))
+        for r in term.match_expressions))
+
+
+class NodeAffinityBuilder:
+    """Dedupes (node_selector, required/preferred node affinity) signatures
+    into NodeAffinityGroups rows."""
+
+    def __init__(self, cfg: EncodingConfig = DEFAULT_ENCODING):
+        self.cfg = cfg
+        self._sigs: Dict[tuple, int] = {}
+        self._payloads: List[tuple] = []  # (selector_items, na)
+
+    def group_of(self, pod: Pod) -> int:
+        na = pod.spec.affinity.node_affinity if pod.spec.affinity else None
+        sel = tuple(sorted(pod.spec.node_selector.items()))
+        if not sel and na is None:
+            return -1
+        req = (tuple(_term_signature(t) for t in na.required.node_selector_terms)
+               if na and na.required else ())
+        pref = (tuple((p.weight, _term_signature(p.preference))
+                      for p in na.preferred) if na else ())
+        if not sel and not req and not pref:
+            return -1
+        sig = (sel, req, pref)
+        gid = self._sigs.get(sig)
+        if gid is None:
+            gid = len(self._payloads)
+            self._sigs[sig] = gid
+            self._payloads.append((sel, na))
+        return gid
+
+    def build(self, pad: Optional[int] = None,
+              overflow: Optional[List[str]] = None) -> NodeAffinityGroups:
+        cfg = self.cfg
+        n = len(self._payloads)
+        target = pad if pad is not None else max(8, _next_pow2(n))
+        if n > target:
+            raise ValueError(f"{n} node-affinity groups > pad {target}")
+        g = NodeAffinityGroups(
+            valid=np.zeros(target, dtype=bool),
+            sel_pairs=np.zeros((target, cfg.max_selector_pairs), dtype=np.int32),
+            req_has=np.zeros(target, dtype=bool),
+            req_op=np.zeros((target, cfg.max_affinity_terms,
+                             cfg.max_exprs_per_term), dtype=np.int32),
+            req_key=np.zeros((target, cfg.max_affinity_terms,
+                              cfg.max_exprs_per_term), dtype=np.int32),
+            req_vals=np.zeros((target, cfg.max_affinity_terms,
+                               cfg.max_exprs_per_term,
+                               cfg.max_values_per_expr), dtype=np.int32),
+            pref_weight=np.zeros((target, cfg.max_preferred_terms), dtype=np.float32),
+            pref_op=np.zeros((target, cfg.max_preferred_terms,
+                              cfg.max_exprs_per_term), dtype=np.int32),
+            pref_key=np.zeros((target, cfg.max_preferred_terms,
+                               cfg.max_exprs_per_term), dtype=np.int32),
+            pref_vals=np.zeros((target, cfg.max_preferred_terms,
+                                cfg.max_exprs_per_term,
+                                cfg.max_values_per_expr), dtype=np.int32),
+        )
+        for gid, (sel, na) in enumerate(self._payloads):
+            g.valid[gid] = True
+            if len(sel) > cfg.max_selector_pairs and overflow is not None:
+                overflow.append(f"na group {gid}: node_selector overflow")
+            for j, (k, v) in enumerate(sel[:cfg.max_selector_pairs]):
+                g.sel_pairs[gid, j] = pair_hash(k, v)
+            if na and na.required and na.required.node_selector_terms:
+                terms = na.required.node_selector_terms
+                if len(terms) > cfg.max_affinity_terms and overflow is not None:
+                    overflow.append(f"na group {gid}: affinity terms overflow")
+                g.req_has[gid] = True
+                for t, term in enumerate(terms[:cfg.max_affinity_terms]):
+                    _encode_term_exprs(g.req_op[gid, t], g.req_key[gid, t],
+                                       g.req_vals[gid, t],
+                                       term.match_expressions, overflow,
+                                       f"na group {gid} term {t}")
+            if na and na.preferred:
+                prefs = na.preferred
+                if len(prefs) > cfg.max_preferred_terms and overflow is not None:
+                    overflow.append(f"na group {gid}: preferred overflow")
+                for t, pt in enumerate(prefs[:cfg.max_preferred_terms]):
+                    g.pref_weight[gid, t] = float(pt.weight)
+                    _encode_term_exprs(g.pref_op[gid, t], g.pref_key[gid, t],
+                                       g.pref_vals[gid, t],
+                                       pt.preference.match_expressions,
+                                       overflow, f"na group {gid} pref {t}")
+        return g
+
+
+def _encode_pod_affinity_terms(i, terms, group_arr, weight_arr, builder,
+                               registry, pod_ns_hash, overflow, what,
+                               self_arr=None, pod_labels=None):
+    """Encode PodAffinityTerm list (plain or weighted) into group slots."""
+    T = group_arr.shape[1]
+    if len(terms) > T and overflow is not None:
+        overflow.append(f"{what}: {len(terms)} terms > {T} slots")
+    for t, term in enumerate(terms[:T]):
+        if weight_arr is not None:
+            weight, term = term.weight, term.term
+        else:
+            weight = None
+        k_idx = registry.index_of(term.topology_key, overflow)
+        if term.namespaces:
+            if len(term.namespaces) > 1 and overflow is not None:
+                overflow.append(f"{what}: multiple namespaces unsupported")
+            ns = _h(term.namespaces[0])
+        else:
+            ns = pod_ns_hash
+        group_arr[i, t] = builder.group_of(k_idx, ns, term.label_selector,
+                                           overflow, what)
+        if weight is not None and group_arr[i, t] >= 0:
+            weight_arr[i, t] = float(weight)
+        if self_arr is not None and group_arr[i, t] >= 0:
+            self_arr[i, t] = (ns == pod_ns_hash
+                              and (term.label_selector is None
+                                   or term.label_selector.matches(pod_labels or {})))
+
+
 def encode_pods(pods: List[Pod], p_pad: int,
                 cfg: EncodingConfig = DEFAULT_ENCODING,
-                overflow: Optional[List[str]] = None) -> PodFeatures:
-    """Encode a batch of pending pods, padded to ``p_pad`` rows."""
+                overflow: Optional[List[str]] = None,
+                registry: Optional[TopologyKeyRegistry] = None,
+                volumes_ready_fn=None,
+                group_pad: Optional[int] = None):
+    """Encode a batch of pending pods, padded to ``p_pad`` rows.
+
+    Returns an EncodedBatch: pod features plus the batch's distinct
+    topology-constraint selector groups (gf) and node-affinity signature
+    groups (naf). ``registry`` maps topology keys to stable indices (shared
+    with the node cache); ``volumes_ready_fn(pod) -> bool`` reports whether
+    the pod's PVCs are bound (VolumeBinding filter input) — default: ready.
+    """
+    if registry is None:
+        registry = TopologyKeyRegistry(cfg)
+    builder = GroupBuilder(cfg)
+    na_builder = NodeAffinityBuilder(cfg)
     P = p_pad
+    T = cfg.max_pod_affinity_terms
+    C = cfg.max_spread_constraints
     f = PodFeatures(
         valid=np.zeros(P, dtype=bool),
         requests=np.zeros((P, NUM_RESOURCES), dtype=np.float32),
         name_suffix=np.full(P, -1, dtype=np.int32),
         priority=np.zeros(P, dtype=np.int32),
-        sel_pairs=np.zeros((P, cfg.max_selector_pairs), dtype=np.int32),
-        aff_op=np.zeros((P, cfg.max_affinity_terms, cfg.max_exprs_per_term), dtype=np.int32),
-        aff_key=np.zeros((P, cfg.max_affinity_terms, cfg.max_exprs_per_term), dtype=np.int32),
-        aff_vals=np.zeros((P, cfg.max_affinity_terms, cfg.max_exprs_per_term,
-                           cfg.max_values_per_expr), dtype=np.int32),
-        aff_has=np.zeros(P, dtype=bool),
-        pref_weight=np.zeros((P, cfg.max_preferred_terms), dtype=np.float32),
-        pref_op=np.zeros((P, cfg.max_preferred_terms, cfg.max_exprs_per_term), dtype=np.int32),
-        pref_key=np.zeros((P, cfg.max_preferred_terms, cfg.max_exprs_per_term), dtype=np.int32),
-        pref_vals=np.zeros((P, cfg.max_preferred_terms, cfg.max_exprs_per_term,
-                            cfg.max_values_per_expr), dtype=np.int32),
+        na_group=np.full(P, -1, dtype=np.int32),
         tol_pairs=np.zeros((P, cfg.max_tolerations), dtype=np.int32),
         tol_keys=np.zeros((P, cfg.max_tolerations), dtype=np.int32),
         tol_ops=np.zeros((P, cfg.max_tolerations), dtype=np.int32),
         tol_effects=np.zeros((P, cfg.max_tolerations), dtype=np.int32),
         ports=np.zeros((P, cfg.max_pod_ports), dtype=np.int32),
         images=np.zeros((P, cfg.max_images), dtype=np.int32),
+        required_node=np.zeros(P, dtype=np.int32),
+        volumes_ready=np.ones(P, dtype=bool),
+        spread_group=np.full((P, C), -1, dtype=np.int32),
+        spread_max_skew=np.ones((P, C), dtype=np.int32),
+        spread_mode=np.zeros((P, C), dtype=np.int32),
+        aff_req_group=np.full((P, T), -1, dtype=np.int32),
+        aff_req_self=np.zeros((P, T), dtype=bool),
+        aff_pref_group=np.full((P, T), -1, dtype=np.int32),
+        aff_pref_weight=np.zeros((P, T), dtype=np.float32),
+        anti_req_group=np.full((P, T), -1, dtype=np.int32),
+        anti_pref_group=np.full((P, T), -1, dtype=np.int32),
+        anti_pref_weight=np.zeros((P, T), dtype=np.float32),
     )
     for i, pod in enumerate(pods):
         if i >= P:
@@ -281,33 +628,8 @@ def encode_pods(pods: List[Pod], p_pad: int,
         f.requests[i] = resources_vector(obj.pod_requests(pod))
         f.name_suffix[i] = name_suffix_digit(pod.metadata.name)
         f.priority[i] = pod.spec.priority
-
-        sel = list(pod.spec.node_selector.items())
-        if len(sel) > cfg.max_selector_pairs and overflow is not None:
-            overflow.append(f"pod {pod.key} node_selector overflow")
-        for j, (k, v) in enumerate(sel[:cfg.max_selector_pairs]):
-            f.sel_pairs[i, j] = pair_hash(k, v)
-
+        f.na_group[i] = na_builder.group_of(pod)
         aff = pod.spec.affinity
-        na = aff.node_affinity if aff else None
-        if na and na.required and na.required.node_selector_terms:
-            terms = na.required.node_selector_terms
-            if len(terms) > cfg.max_affinity_terms and overflow is not None:
-                overflow.append(f"pod {pod.key} affinity terms overflow")
-            f.aff_has[i] = True
-            for t, term in enumerate(terms[:cfg.max_affinity_terms]):
-                _encode_term_exprs(f.aff_op[i, t], f.aff_key[i, t],
-                                   f.aff_vals[i, t], term.match_expressions,
-                                   overflow, f"pod {pod.key} affinity term {t}")
-        if na and na.preferred:
-            prefs = na.preferred
-            if len(prefs) > cfg.max_preferred_terms and overflow is not None:
-                overflow.append(f"pod {pod.key} preferred affinity overflow")
-            for t, pt in enumerate(prefs[:cfg.max_preferred_terms]):
-                f.pref_weight[i, t] = float(pt.weight)
-                _encode_term_exprs(f.pref_op[i, t], f.pref_key[i, t],
-                                   f.pref_vals[i, t], pt.preference.match_expressions,
-                                   overflow, f"pod {pod.key} preferred term {t}")
 
         tols = pod.spec.tolerations
         if len(tols) > cfg.max_tolerations and overflow is not None:
@@ -322,4 +644,45 @@ def encode_pods(pods: List[Pod], p_pad: int,
         _fill_slots(f.ports[i], host_ports, f"pod {pod.key} host ports", overflow)
         _fill_slots(f.images[i], [_h(im) for im in pod.spec.images],
                     f"pod {pod.key} images", overflow)
-    return f
+
+        if pod.spec.required_node_name:
+            f.required_node[i] = _h(pod.spec.required_node_name)
+        if volumes_ready_fn is not None and pod.spec.volumes:
+            f.volumes_ready[i] = bool(volumes_ready_fn(pod))
+
+        ns_h = _h(pod.metadata.namespace) if pod.metadata.namespace else 0
+        cons = pod.spec.topology_spread_constraints
+        if len(cons) > C and overflow is not None:
+            overflow.append(f"pod {pod.key} spread constraints overflow")
+        for c, tsc in enumerate(cons[:C]):
+            k_idx = registry.index_of(tsc.topology_key, overflow)
+            gid = builder.group_of(k_idx, ns_h, tsc.label_selector, overflow,
+                                   f"pod {pod.key} spread[{c}]")
+            if gid < 0:
+                continue
+            f.spread_group[i, c] = gid
+            f.spread_max_skew[i, c] = int(tsc.max_skew)
+            f.spread_mode[i, c] = (SPREAD_DO_NOT_SCHEDULE
+                                   if tsc.when_unsatisfiable == "DoNotSchedule"
+                                   else SPREAD_SCHEDULE_ANYWAY)
+
+        pa = aff.pod_affinity if aff else None
+        if pa:
+            _encode_pod_affinity_terms(
+                i, pa.required, f.aff_req_group, None, builder, registry,
+                ns_h, overflow, f"pod {pod.key} podAffinity",
+                self_arr=f.aff_req_self, pod_labels=pod.metadata.labels)
+            _encode_pod_affinity_terms(
+                i, pa.preferred, f.aff_pref_group, f.aff_pref_weight, builder,
+                registry, ns_h, overflow, f"pod {pod.key} podAffinity.preferred")
+        anti = aff.pod_anti_affinity if aff else None
+        if anti:
+            _encode_pod_affinity_terms(
+                i, anti.required, f.anti_req_group, None, builder, registry,
+                ns_h, overflow, f"pod {pod.key} podAntiAffinity")
+            _encode_pod_affinity_terms(
+                i, anti.preferred, f.anti_pref_group, f.anti_pref_weight,
+                builder, registry, ns_h, overflow,
+                f"pod {pod.key} podAntiAffinity.preferred")
+    return EncodedBatch(pf=f, gf=builder.build(group_pad),
+                        naf=na_builder.build(overflow=overflow))

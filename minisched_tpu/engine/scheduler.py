@@ -125,12 +125,18 @@ class Scheduler:
         batch = sorted(batch, key=lambda q: -q.pod.spec.priority)
         pods = [q.pod for q in batch]
 
+        # Encode pods FIRST: constraints may register new topology keys,
+        # which the node snapshot's domain tables must reflect.
+        eb = encode_pods(pods, bucket_for(len(pods), cfg.pod_bucket_min),
+                         registry=self.cache.registry,
+                         overflow=self.cache.overflow,
+                         volumes_ready_fn=self._volumes_ready)
         nf, names = self.cache.snapshot()
-        pf = encode_pods(pods, bucket_for(len(pods), cfg.pod_bucket_min))
+        af = self.cache.snapshot_assigned()
 
         self._step_counter += 1
         key = jax.random.fold_in(self._key, self._step_counter)
-        decision: Decision = self._step(pf, nf, key)
+        decision: Decision = self._step(eb, nf, af, key)
 
         chosen = np.asarray(decision.chosen)
         assigned = np.asarray(decision.assigned)
@@ -161,6 +167,19 @@ class Scheduler:
                     f"rejected by {sorted(plugins)}",
                     retryable=False)
         return decision
+
+    def _volumes_ready(self, pod: Pod) -> bool:
+        """VolumeBinding input: all PVCs the pod references are Bound."""
+        for vc in pod.spec.volumes:
+            try:
+                pvc = self.store.get(
+                    "PersistentVolumeClaim",
+                    f"{pod.metadata.namespace}/{vc.claim_name}")
+            except NotFoundError:
+                return False
+            if pvc.phase != "Bound":
+                return False
+        return True
 
     # ---- permit + binding cycle ----------------------------------------
 

@@ -35,9 +35,14 @@ def term_matches(op: jnp.ndarray, key: jnp.ndarray, vals: jnp.ndarray,
     """
     # value membership: any encoded value-pair present on the node
     # (P,T,E,V,N,L) is never materialized — XLA fuses the reductions.
-    val_in = (vals[..., None, None] == node_pairs[None, None, None, None, :, :]).any(-1).any(-2)
+    # 0 is the empty-slot sentinel on BOTH sides; unguarded, padding-zero
+    # vals would "match" padding-zero node label slots.
+    val_eq = ((vals != 0)[..., None, None]
+              & (vals[..., None, None] == node_pairs[None, None, None, None, :, :]))
+    val_in = val_eq.any(-1).any(-2)
     # key presence on node: (P,T,E,N)
-    key_in = (key[..., None, None] == node_keys[None, None, None, :, :]).any(-1)
+    key_in = ((key != 0)[..., None]
+              & (key[..., None, None] == node_keys[None, None, None, :, :]).any(-1))
 
     expr_ok = _select_expr(op, val_in, key_in)
 

@@ -24,8 +24,10 @@ from typing import List, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from ..encode.features import DEFAULT_ENCODING, EncodingConfig
 from ..plugins.base import PluginSet
 from .select import NEG, AssignResult, greedy_assign
+from .topology import group_topology_state
 
 
 class Decision(NamedTuple):
@@ -46,12 +48,14 @@ class Decision(NamedTuple):
 _STEP_CACHE: dict = {}
 
 
-def build_step(plugin_set: PluginSet, *, explain: bool = False):
+def build_step(plugin_set: PluginSet, *, explain: bool = False,
+               cfg: EncodingConfig = DEFAULT_ENCODING):
     """Compile the scheduling step for a plugin profile.
 
-    Returns jitted ``step(pf, nf, key) -> Decision``. pf/nf are
-    PodFeatures/NodeFeatures pytrees (numpy or jnp); shapes must be bucketed
-    by the caller — each distinct (P, N) bucket compiles once. Steps are
+    Returns jitted ``step(eb, nf, af, key) -> Decision`` where eb is an
+    encode.EncodedBatch (pod features + constraint groups), nf the node
+    features, af the assigned-pod corpus. Shapes must be bucketed by the
+    caller — each distinct bucket combination compiles once. Steps are
     memoized on the profile's traced behavior (plugin trace keys + weights +
     explain) so scheduler restarts and equivalent profiles reuse compiles.
     """
@@ -59,7 +63,7 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False):
         tuple(p.trace_key() for p in plugin_set.filter_plugins),
         tuple((p.trace_key(), plugin_set.weight_of(p))
               for p in plugin_set.score_plugins),
-        explain,
+        explain, cfg,
     )
     cached = _STEP_CACHE.get(cache_key)
     if cached is not None:
@@ -67,13 +71,30 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False):
     filters = plugin_set.filter_plugins
     scorers = plugin_set.score_plugins
     weights = [plugin_set.weight_of(p) for p in scorers]
+    active = filters + scorers
+    needs_topology = any(p.needs_topology for p in active)
+    needs_node_affinity = any(p.needs_node_affinity for p in active)
 
-    def step(pf, nf, key) -> Decision:
+    def step(eb, nf, af, key) -> Decision:
+        pf = eb.pf
         P = pf.valid.shape[0]
         N = nf.valid.shape[0]
         valid_pair = pf.valid[:, None] & nf.valid[None, :]
 
-        masks = [p.filter(pf, nf) for p in filters]
+        # Shared cycle state (reference CycleState / RunPreScorePlugins):
+        # computed once, consumed by any plugin that declared a need.
+        ctx = {"af": af, "gf": eb.gf, "naf": eb.naf}
+        if needs_topology:
+            num_domains = max(N, cfg.domain_buckets)
+            ctx.update(group_topology_state(nf, af, eb.gf, num_domains))
+        if needs_node_affinity:
+            from ..plugins.nodeaffinity import (group_preferred_score,
+                                               group_required_match)
+
+            ctx["na_req_match"] = group_required_match(eb.naf, nf)
+            ctx["na_pref_score"] = group_preferred_score(eb.naf, nf)
+
+        masks = [p.filter(pf, nf, ctx) for p in filters]
         feasible = valid_pair
         for m in masks:
             feasible = feasible & m
@@ -87,7 +108,7 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False):
         total = jnp.zeros((P, N), dtype=jnp.float32)
         raws, norms = [], []
         for p, w in zip(scorers, weights):
-            raw = p.score(pf, nf).astype(jnp.float32)
+            raw = p.score(pf, nf, ctx).astype(jnp.float32)
             norm = p.normalize(raw, feasible).astype(jnp.float32)
             total = total + w * norm
             if explain:

@@ -1,0 +1,91 @@
+"""Topology-domain counting for spread and inter-pod affinity.
+
+The reference (and upstream k8s) computes "how many matching pods are in
+this topology domain" by walking pods per node per constraint in Go. The
+TPU formulation (BASELINE config 4 "masked psum over node-sharded mesh"):
+
+  1. match (G × A): which assigned pods match each selector GROUP — exact
+     hashed-pair comparison, G = distinct (key, ns, selector) tuples in the
+     batch (deployment replicas share one), A = assigned-pod corpus.
+  2. counts_dom (G × D): segment-sum of matches over each group's domain
+     ids (domain = node row for kubernetes.io/hostname, hashed label value
+     otherwise). Under a node-sharded mesh this is the masked psum.
+  3. counts_node (G × N): gather each node's domain count; min/max over
+     existing domains feed skew math.
+
+Pods then gather their group's row — (P × N) tensors appear only
+transiently per constraint slot inside the consuming plugin.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def group_assigned_match(gf, af) -> jnp.ndarray:
+    """(G, A) bool: assigned pod a matches group g's namespace + selector.
+    All-zero selector with a valid group = match-all (upstream empty
+    LabelSelector)."""
+    ns_ok = (gf.ns_hash[:, None] == 0) | (
+        gf.ns_hash[:, None] == af.ns_hash[None, :])
+    # (G,QT,A): each non-empty selector pair present among the pod's labels
+    present = (gf.sel_pairs[:, :, None, None]
+               == af.label_pairs[None, None, :, :]).any(-1)
+    sel_ok = jnp.where(gf.sel_pairs[:, :, None] != 0, present, True).all(axis=1)
+    return gf.valid[:, None] & ns_ok & sel_ok & af.valid[None, :]
+
+
+def group_topology_state(nf, af, gf, num_domains: int) -> Dict[str, jnp.ndarray]:
+    """Shared cycle state for topology plugins.
+
+    Returns dict with:
+      counts_node (G,N) f32 — matching assigned pods in node n's domain
+      dom_valid   (G,N) bool — node has the group's topology key
+      min_count   (G,)  f32 — min count over domains that exist on nodes
+      max_count   (G,)  f32 — max count over existing domains
+    """
+    G = gf.valid.shape[0]
+    N = nf.valid.shape[0]
+    match = group_assigned_match(gf, af).astype(jnp.float32)  # (G,A)
+
+    # per-group domain ids
+    node_dom = nf.topo_domains[gf.key_idx]          # (G,N) — gather rows
+    dom_valid = (node_dom >= 0) & nf.valid[None, :] & gf.valid[:, None]
+    a_dom = jnp.take_along_axis(
+        node_dom, af.node_row[None, :], axis=1)      # (G,A)
+    a_ok = (a_dom >= 0) & af.valid[None, :]
+    a_ids = jnp.clip(a_dom, 0, num_domains - 1)
+
+    counts_dom = jax.vmap(
+        lambda m, ids: jax.ops.segment_sum(m, ids, num_segments=num_domains)
+    )(match * a_ok, a_ids)                           # (G,D)
+
+    node_ids = jnp.clip(node_dom, 0, num_domains - 1)
+    dom_exists = jax.vmap(
+        lambda v, ids: jax.ops.segment_sum(v, ids, num_segments=num_domains)
+    )(dom_valid.astype(jnp.float32), node_ids) > 0   # (G,D)
+
+    counts_node = jnp.take_along_axis(counts_dom, node_ids, axis=1)
+    counts_node = jnp.where(dom_valid, counts_node, 0.0)  # (G,N)
+
+    big = jnp.float32(3.0e38)
+    min_count = jnp.where(
+        dom_exists.any(axis=1),
+        jnp.min(jnp.where(dom_exists, counts_dom, big), axis=1), 0.0)
+    max_count = jnp.max(jnp.where(dom_exists, counts_dom, 0.0), axis=1)
+    # does ANY assigned pod match the group at all (upstream's "no pods in
+    # the cluster match this affinity term" special case)
+    has_match = (match * a_ok).any(axis=1)
+    return {"counts_node": counts_node, "dom_valid": dom_valid,
+            "min_count": min_count, "max_count": max_count,
+            "has_match": has_match}
+
+
+def gather_group_rows(group_idx: jnp.ndarray, table: jnp.ndarray,
+                      fill: float = 0.0) -> jnp.ndarray:
+    """table (G,N) gathered by group_idx (P,) → (P,N); fill where idx < 0."""
+    safe = jnp.clip(group_idx, 0, table.shape[0] - 1)
+    out = table[safe]
+    return jnp.where((group_idx >= 0)[:, None], out, fill)

@@ -56,22 +56,35 @@ def pod_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(POD_AXIS))
 
 
-def feature_shardings(mesh: Mesh, pf_template, nf_template) -> Tuple:
-    """Per-leaf NamedShardings: leading dim of every pod-feature leaf over
-    "pod", of every node-feature leaf over "node"; trailing dims replicated."""
-
-    def spec_for(arr, axis_name):
-        extra = (None,) * (arr.ndim - 1)
-        return NamedSharding(mesh, P(axis_name, *extra))
-
-    pf_sh = type(pf_template)(*(spec_for(a, POD_AXIS) for a in pf_template))
-    nf_sh = type(nf_template)(*(spec_for(a, NODE_AXIS) for a in nf_template))
-    return pf_sh, nf_sh
+def _spec_for(mesh, arr, axis_name):
+    extra = (None,) * (arr.ndim - 1)
+    return NamedSharding(mesh, P(axis_name, *extra))
 
 
-def shard_features(mesh: Mesh, pf, nf):
-    """Device-put feature pytrees with their canonical shardings."""
-    pf_sh, nf_sh = feature_shardings(mesh, pf, nf)
-    pf_dev = type(pf)(*(jax.device_put(a, s) for a, s in zip(pf, pf_sh)))
-    nf_dev = type(nf)(*(jax.device_put(a, s) for a, s in zip(nf, nf_sh)))
-    return pf_dev, nf_dev
+def _replicated(mesh, tree):
+    return type(tree)(*(NamedSharding(mesh, P()) for _ in tree))
+
+
+def feature_shardings(mesh: Mesh, eb_template, nf_template, af_template) -> Tuple:
+    """Per-leaf NamedShardings for one step's inputs: pod-feature leaves
+    shard their leading dim over "pod", node features over "node"
+    (topo_domains over its second dim — leading dim is the key registry);
+    constraint groups and the assigned-pod corpus are small relative to the
+    (P×N) matrices and stay replicated."""
+    pf, gf, naf = eb_template.pf, eb_template.gf, eb_template.naf
+    pf_sh = type(pf)(*(_spec_for(mesh, a, POD_AXIS) for a in pf))
+    nf_sh = type(nf_template)(*(
+        NamedSharding(mesh, P(None, NODE_AXIS)) if name == "topo_domains"
+        else _spec_for(mesh, a, NODE_AXIS)
+        for name, a in zip(nf_template._fields, nf_template)))
+    eb_sh = type(eb_template)(pf=pf_sh, gf=_replicated(mesh, gf),
+                              naf=_replicated(mesh, naf))
+    af_sh = _replicated(mesh, af_template)
+    return eb_sh, nf_sh, af_sh
+
+
+def shard_features(mesh: Mesh, eb, nf, af):
+    """Device-put one step's input pytrees with their canonical shardings."""
+    eb_sh, nf_sh, af_sh = feature_shardings(mesh, eb, nf, af)
+    put = lambda tree, sh: jax.tree_util.tree_map(jax.device_put, tree, sh)
+    return put(eb, eb_sh), put(nf, nf_sh), put(af, af_sh)

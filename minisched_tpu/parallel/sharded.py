@@ -18,23 +18,24 @@ from ..plugins.base import PluginSet
 from .mesh import NODE_AXIS, POD_AXIS, feature_shardings
 
 
-def build_sharded_step(plugin_set: PluginSet, mesh, pf_template, nf_template,
-                       *, explain: bool = False):
+def build_sharded_step(plugin_set: PluginSet, mesh, eb_template, nf_template,
+                       af_template, *, explain: bool = False):
     """Compile the scheduling step with mesh shardings.
 
-    pf_template/nf_template supply leaf ranks for the sharding specs (any
-    correctly-shaped PodFeatures/NodeFeatures, e.g. one batch's arrays).
-    Returns ``step(pf, nf, key) -> Decision`` with inputs auto-partitioned.
+    The templates supply leaf ranks for the sharding specs (any correctly-
+    shaped EncodedBatch / NodeFeatures / AssignedPodFeatures). Returns
+    ``step(eb, nf, af, key) -> Decision`` with inputs auto-partitioned.
     """
-    pf_sh, nf_sh = feature_shardings(mesh, pf_template, nf_template)
+    eb_sh, nf_sh, af_sh = feature_shardings(mesh, eb_template, nf_template,
+                                            af_template)
     key_sh = NamedSharding(mesh, P())  # replicated PRNG key
 
-    # Build the *traced* computation once (unjitted body reused from the
-    # single-chip path), then wrap with sharding-annotated jit.
+    # Reuse the single-chip traced computation; sharding-annotated jit lets
+    # GSPMD insert the collectives.
     inner = build_step(plugin_set, explain=explain)
 
-    def stepfn(pf, nf, key):
-        return inner(pf, nf, key)
+    def stepfn(eb, nf, af, key):
+        return inner(eb, nf, af, key)
 
     both = NamedSharding(mesh, P(POD_AXIS, NODE_AXIS))
     pod_only = NamedSharding(mesh, P(POD_AXIS))
@@ -46,5 +47,5 @@ def build_sharded_step(plugin_set: PluginSet, mesh, pf_template, nf_template,
         total_scores=both, free_after=node_res,
         filter_masks=stack_both, raw_scores=stack_both, norm_scores=stack_both)
 
-    return jax.jit(stepfn, in_shardings=(pf_sh, nf_sh, key_sh),
+    return jax.jit(stepfn, in_shardings=(eb_sh, nf_sh, af_sh, key_sh),
                    out_shardings=out_sh)

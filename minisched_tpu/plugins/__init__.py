@@ -7,3 +7,11 @@ from .noderesources import (  # noqa: F401
     NodeResourcesLeastAllocated,
     NodeResourcesMostAllocated,
 )
+from .nodename import NodeName  # noqa: F401
+from .nodeaffinity import NodeAffinity  # noqa: F401
+from .tainttoleration import TaintToleration  # noqa: F401
+from .nodeports import NodePorts  # noqa: F401
+from .imagelocality import ImageLocality  # noqa: F401
+from .volumebinding import VolumeBinding  # noqa: F401
+from .podtopologyspread import PodTopologySpread  # noqa: F401
+from .interpodaffinity import InterPodAffinity  # noqa: F401

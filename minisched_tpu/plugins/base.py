@@ -31,10 +31,20 @@ from ..state.events import ClusterEvent
 
 class BatchedPlugin:
     """Base plugin. Subclasses override any subset of the extension points;
-    the framework detects overrides to classify filter/score plugins."""
+    the framework detects overrides to classify filter/score plugins.
+
+    ``ctx`` is the shared cycle state (the reference's framework.CycleState,
+    built by RunPreScorePlugins at minisched.go:153-162): a dict the
+    pipeline fills once per step with cross-plugin inputs — assigned-pod
+    corpus, topology-domain counts (needs_topology), node-affinity group
+    matches (needs_node_affinity)."""
 
     name: str = "Base"
     default_weight: float = 1.0
+    # shared-cycle-state requirements (computed once per step if any
+    # enabled plugin asks)
+    needs_topology: bool = False
+    needs_node_affinity: bool = False
 
     # -- event interest (drives requeue gating, reference
     #    minisched/initialize.go:140-157 + nodenumber.go:66-70)
@@ -42,10 +52,10 @@ class BatchedPlugin:
         return []
 
     # -- device-side extension points (pure jnp; called under jit)
-    def filter(self, pf, nf) -> jnp.ndarray:  # pragma: no cover - interface
+    def filter(self, pf, nf, ctx) -> jnp.ndarray:  # pragma: no cover
         raise NotImplementedError
 
-    def score(self, pf, nf) -> jnp.ndarray:  # pragma: no cover - interface
+    def score(self, pf, nf, ctx) -> jnp.ndarray:  # pragma: no cover
         raise NotImplementedError
 
     def normalize(self, scores: jnp.ndarray, feasible: jnp.ndarray) -> jnp.ndarray:

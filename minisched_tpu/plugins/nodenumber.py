@@ -32,7 +32,7 @@ class NodeNumber(BatchedPlugin):
     def events_to_register(self):
         return [ClusterEvent(GVK.NODE, ActionType.ADD)]
 
-    def score(self, pf, nf) -> jnp.ndarray:
+    def score(self, pf, nf, ctx) -> jnp.ndarray:
         match = (pf.name_suffix[:, None] == nf.name_suffix[None, :]) & (
             pf.name_suffix[:, None] >= 0)
         return jnp.where(match, 10.0, 0.0)

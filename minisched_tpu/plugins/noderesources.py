@@ -30,7 +30,7 @@ class NodeResourcesFit(BatchedPlugin):
         return [ClusterEvent(GVK.POD, ActionType.DELETE),
                 ClusterEvent(GVK.NODE, ActionType.ADD | ActionType.UPDATE)]
 
-    def filter(self, pf, nf) -> jnp.ndarray:
+    def filter(self, pf, nf, ctx) -> jnp.ndarray:
         # (P,1,R) <= (1,N,R) reduced over R
         return jnp.all(pf.requests[:, None, :] <= nf.free[None, :, :] + _EPS,
                        axis=2)
@@ -57,7 +57,7 @@ class NodeResourcesLeastAllocated(_AllocationScorer):
 
     name = "NodeResourcesLeastAllocated"
 
-    def score(self, pf, nf) -> jnp.ndarray:
+    def score(self, pf, nf, ctx) -> jnp.ndarray:
         util = self._utilization(pf, nf)
         present = nf.allocatable[None, :, :] > 0
         frac_free = jnp.where(present, 1.0 - util, 0.0)
@@ -70,7 +70,7 @@ class NodeResourcesMostAllocated(_AllocationScorer):
 
     name = "NodeResourcesMostAllocated"
 
-    def score(self, pf, nf) -> jnp.ndarray:
+    def score(self, pf, nf, ctx) -> jnp.ndarray:
         util = self._utilization(pf, nf)
         present = nf.allocatable[None, :, :] > 0
         denom = jnp.maximum(present.sum(axis=2), 1)
@@ -83,7 +83,7 @@ class NodeResourcesBalancedAllocation(_AllocationScorer):
 
     name = "NodeResourcesBalancedAllocation"
 
-    def score(self, pf, nf) -> jnp.ndarray:
+    def score(self, pf, nf, ctx) -> jnp.ndarray:
         util = self._utilization(pf, nf)
         present = nf.allocatable[None, :, :] > 0
         count = jnp.maximum(present.sum(axis=2), 1)

@@ -29,7 +29,7 @@ class NodeUnschedulable(BatchedPlugin):
         # Upstream registers {Node, Add | UpdateNodeTaint}.
         return [ClusterEvent(GVK.NODE, ActionType.ADD | ActionType.UPDATE_NODE_TAINT)]
 
-    def filter(self, pf, nf) -> jnp.ndarray:
+    def filter(self, pf, nf, ctx) -> jnp.ndarray:
         # Pod tolerates the implicit unschedulable:NoSchedule taint iff a
         # toleration matches its key (or empty-key Exists), its empty value
         # (for Equal), and the NoSchedule effect.

@@ -54,6 +54,35 @@ register_plugin("NodeResourcesLeastAllocated", NodeResourcesLeastAllocated)
 register_plugin("NodeResourcesMostAllocated", NodeResourcesMostAllocated)
 register_plugin("NodeResourcesBalancedAllocation", NodeResourcesBalancedAllocation)
 
+from ..plugins.imagelocality import ImageLocality  # noqa: E402
+from ..plugins.interpodaffinity import InterPodAffinity  # noqa: E402
+from ..plugins.nodeaffinity import NodeAffinity  # noqa: E402
+from ..plugins.nodename import NodeName  # noqa: E402
+from ..plugins.nodeports import NodePorts  # noqa: E402
+from ..plugins.podtopologyspread import PodTopologySpread  # noqa: E402
+from ..plugins.tainttoleration import TaintToleration  # noqa: E402
+from ..plugins.volumebinding import VolumeBinding  # noqa: E402
+
+register_plugin("NodeName", NodeName)
+register_plugin("NodeAffinity", NodeAffinity)
+register_plugin("TaintToleration", TaintToleration)
+register_plugin("NodePorts", NodePorts)
+register_plugin("ImageLocality", ImageLocality)
+register_plugin("VolumeBinding", VolumeBinding)
+register_plugin("PodTopologySpread", PodTopologySpread)
+register_plugin("InterPodAffinity", InterPodAffinity)
+
+
+def full_scheduler_profile() -> Profile:
+    """All default plugins enabled — the analog of the reference's
+    simulator configuration with every *ForSimulator plugin on."""
+    return Profile(name="full-scheduler", plugins=[
+        "NodeUnschedulable", "NodeName", "NodeAffinity", "TaintToleration",
+        "NodePorts", "VolumeBinding", "NodeResourcesFit",
+        "NodeResourcesLeastAllocated", "NodeResourcesBalancedAllocation",
+        "ImageLocality", "PodTopologySpread", "InterPodAffinity",
+    ])
+
 
 @dataclass
 class Profile:

@@ -222,6 +222,9 @@ class PodPhase:
 @dataclass
 class PodSpec:
     node_name: str = ""
+    # Upstream spec.nodeName as a *constraint* evaluated by the NodeName
+    # plugin (distinct from node_name, which records the committed binding).
+    required_node_name: str = ""
     scheduler_name: str = "default-scheduler"
     priority: int = 0
     requests: ResourceList = field(default_factory=dict)  # aggregated container requests

@@ -7,8 +7,13 @@ import os
 import sys
 
 # Force-override: the ambient environment may pin JAX_PLATFORMS to the TPU
-# tunnel; tests must run on the virtual CPU mesh regardless.
+# tunnel; tests must run on the virtual CPU mesh regardless. The tunnel's
+# site hook (sitecustomize on PYTHONPATH) force-initializes the remote TPU
+# client on ANY backend lookup — and hangs every test run if the tunnel is
+# busy/wedged — so drop it from the module path too before jax imports.
 os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+sys.modules.pop("sitecustomize", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,10 +22,16 @@ if "xla_force_host_platform_device_count" not in flags:
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # The ambient TPU platform plugin may ignore JAX_PLATFORMS and still present
-# the real chip as the default backend; pin all test computation to the
-# virtual CPU devices.
+# the real chip as the default backend (its site hook wraps get_backend and
+# dials the remote client); deregister every non-CPU backend factory before
+# any backend initializes so tests never touch the tunnel.
 import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
 
+for _name in [n for n in _xb._backend_factories if n != "cpu"]:
+    _xb._backend_factories.pop(_name, None)
+
+jax.config.update("jax_platforms", "cpu")  # site hook may have pinned "axon"
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 

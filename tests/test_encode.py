@@ -108,12 +108,13 @@ def test_pod_encoding_fields():
     p.spec.tolerations = [Toleration(key="dedicated", operator="Equal",
                                      value="ml", effect="NoSchedule")]
     p.spec.ports = [ContainerPort(host_port=9000)]
-    pf = encode_pods([p], 4)
+    pf, gf, naf = encode_pods([p], 4)
     assert pf.valid.tolist() == [True, False, False, False]
     assert pf.requests[0, 0] == 250
     assert pf.requests[0, 2] == 1  # implicit pods:1
     assert pf.name_suffix[0] == 3
-    assert pf.sel_pairs[0, 0] == pair_hash("disk", "ssd")
+    assert pf.na_group[0] == 0  # node_selector landed in a group
+    assert naf.sel_pairs[0, 0] == pair_hash("disk", "ssd")
     assert pf.ports[0, 0] == 9000
 
 

@@ -26,8 +26,9 @@ def make_inputs(n_nodes=32, n_pods=16):
         c.upsert_node(node(f"n{i}", cpu=1000 + (i % 7) * 100))
     nf, names = c.snapshot(pad=n_nodes)
     pods = [pod(f"p{i}", cpu=100 + (i % 3) * 50) for i in range(n_pods)]
-    pf = encode_pods(pods, n_pods)
-    return pf, nf, names
+    eb = encode_pods(pods, n_pods, registry=c.registry)
+    af = c.snapshot_assigned()
+    return eb, nf, af, names
 
 
 def test_mesh_axes(eight_devices):
@@ -40,14 +41,14 @@ def test_mesh_axes(eight_devices):
 
 def test_sharded_step_matches_single_chip(eight_devices):
     mesh = make_mesh(eight_devices)
-    pf, nf, names = make_inputs()
+    eb, nf, af, names = make_inputs()
     ps = PluginSet([NodeUnschedulable(), NodeNumber()])
     key = jax.random.PRNGKey(42)
 
-    single = build_step(ps)(pf, nf, key)
-    sharded_step = build_sharded_step(ps, mesh, pf, nf)
-    pf_d, nf_d = shard_features(mesh, pf, nf)
-    sharded = sharded_step(pf_d, nf_d, key)
+    single = build_step(ps)(eb, nf, af, key)
+    sharded_step = build_sharded_step(ps, mesh, eb, nf, af)
+    eb_d, nf_d, af_d = shard_features(mesh, eb, nf, af)
+    sharded = sharded_step(eb_d, nf_d, af_d, key)
 
     np.testing.assert_array_equal(np.asarray(single.chosen),
                                   np.asarray(sharded.chosen))
@@ -65,10 +66,11 @@ def test_sharded_capacity_causality(eight_devices):
         c.upsert_node(node(f"n{i}", cpu=100))  # each fits exactly one pod
     nf, _ = c.snapshot(pad=16)
     pods = [pod(f"p{i}", cpu=100) for i in range(16)]
-    pf = encode_pods(pods, 16)
+    eb = encode_pods(pods, 16, registry=c.registry)
+    af = c.snapshot_assigned()
     ps = PluginSet([NodeUnschedulable()])
-    d = build_sharded_step(ps, mesh, pf, nf)(
-        *shard_features(mesh, pf, nf), jax.random.PRNGKey(0))
+    d = build_sharded_step(ps, mesh, eb, nf, af)(
+        *shard_features(mesh, eb, nf, af), jax.random.PRNGKey(0))
     chosen = np.asarray(d.chosen)
     assert np.asarray(d.assigned).all()
     assert len(set(chosen.tolist())) == 16  # no double-booked node

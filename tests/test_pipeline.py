@@ -19,11 +19,15 @@ def snapshot_for(nodes):
 
 
 def run(nodes, pods, plugins=None, weights=None, explain=True, seed=0):
-    nf, names = snapshot_for(nodes)
-    pf = encode_pods(pods, 16)
+    c = NodeFeatureCache()
+    for n in nodes:
+        c.upsert_node(n)
+    nf, names = c.snapshot()
+    eb = encode_pods(pods, 16, registry=c.registry)
+    af = c.snapshot_assigned()
     ps = PluginSet(plugins or [NodeUnschedulable(), NodeNumber()], weights)
     step = build_step(ps, explain=explain)
-    d = step(pf, nf, jax.random.PRNGKey(seed))
+    d = step(eb, nf, af, jax.random.PRNGKey(seed))
     return d, names
 
 

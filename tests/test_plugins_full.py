@@ -1,0 +1,420 @@
+"""Semantics tests for the full default-plugin set — the batched
+counterparts of the ~20 upstream plugins the reference wraps
+(scheduler/plugin/plugins.go:24-70)."""
+import jax
+import numpy as np
+
+from minisched_tpu.encode import NodeFeatureCache, encode_pods
+from minisched_tpu.ops import build_step
+from minisched_tpu.plugins import (
+    ImageLocality,
+    InterPodAffinity,
+    NodeAffinity,
+    NodeName,
+    NodePorts,
+    NodeUnschedulable,
+    PluginSet,
+    PodTopologySpread,
+    TaintToleration,
+    VolumeBinding,
+)
+from minisched_tpu.state.objects import (
+    Affinity,
+    ContainerPort,
+    LabelSelector,
+    NodeAffinity as NodeAffinitySpec,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PreferredSchedulingTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+from tests.test_encode import node, pod
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def run_plugins(cache, pods, plugins, seed=0, explain=True):
+    eb = encode_pods(pods, 16, registry=cache.registry)
+    nf, names = cache.snapshot()
+    af = cache.snapshot_assigned()
+    d = build_step(PluginSet(plugins), explain=explain)(
+        eb, nf, af, jax.random.PRNGKey(seed))
+    return d, names, cache
+
+
+def mask_for(d, names, node_name, pod_idx=0, plugin_idx=0):
+    row = names.index(node_name)
+    return bool(np.asarray(d.filter_masks[plugin_idx])[pod_idx, row])
+
+
+def score_for(d, names, node_name, pod_idx=0, plugin_idx=0):
+    row = names.index(node_name)
+    return float(np.asarray(d.raw_scores[plugin_idx])[pod_idx, row])
+
+
+def bind(cache, p, node_name):
+    p.spec.node_name = node_name
+    cache.account_bind(p)
+
+
+# ---- NodeName -----------------------------------------------------------
+
+def test_nodename_filter():
+    c = NodeFeatureCache()
+    c.upsert_node(node("alpha"))
+    c.upsert_node(node("beta"))
+    p = pod("p")
+    p.spec.required_node_name = "beta"
+    d, names, _ = run_plugins(c, [p, pod("q")], [NodeName()])
+    assert not mask_for(d, names, "alpha", pod_idx=0)
+    assert mask_for(d, names, "beta", pod_idx=0)
+    # unconstrained pod passes everywhere
+    assert mask_for(d, names, "alpha", pod_idx=1)
+
+
+# ---- NodeAffinity -------------------------------------------------------
+
+def test_node_selector_and_required_affinity():
+    c = NodeFeatureCache()
+    c.upsert_node(node("ssd-zone-a", labels={"disk": "ssd", "zone": "a"}))
+    c.upsert_node(node("hdd-zone-a", labels={"disk": "hdd", "zone": "a"}))
+    c.upsert_node(node("ssd-zone-b", labels={"disk": "ssd", "zone": "b"}))
+
+    p = pod("selector")
+    p.spec.node_selector = {"disk": "ssd"}
+
+    q = pod("affinity")
+    q.spec.affinity = Affinity(node_affinity=NodeAffinitySpec(
+        required=NodeSelector(node_selector_terms=[
+            NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(key="zone", operator="In",
+                                        values=["b", "c"])])])))
+
+    d, names, _ = run_plugins(c, [p, q], [NodeAffinity()])
+    assert mask_for(d, names, "ssd-zone-a", 0)
+    assert not mask_for(d, names, "hdd-zone-a", 0)
+    assert mask_for(d, names, "ssd-zone-b", 0)
+    assert not mask_for(d, names, "ssd-zone-a", 1)
+    assert mask_for(d, names, "ssd-zone-b", 1)
+
+
+def test_required_affinity_terms_are_ored():
+    c = NodeFeatureCache()
+    c.upsert_node(node("a", labels={"k": "1"}))
+    c.upsert_node(node("b", labels={"k": "2"}))
+    c.upsert_node(node("c", labels={"k": "3"}))
+    p = pod("p")
+    p.spec.affinity = Affinity(node_affinity=NodeAffinitySpec(
+        required=NodeSelector(node_selector_terms=[
+            NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(key="k", operator="In", values=["1"])]),
+            NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(key="k", operator="In", values=["3"])]),
+        ])))
+    d, names, _ = run_plugins(c, [p], [NodeAffinity()])
+    assert mask_for(d, names, "a")
+    assert not mask_for(d, names, "b")
+    assert mask_for(d, names, "c")
+
+
+def test_affinity_exists_and_notin():
+    c = NodeFeatureCache()
+    c.upsert_node(node("gpu", labels={"accelerator": "tpu"}))
+    c.upsert_node(node("plain"))
+    p = pod("exists")
+    p.spec.affinity = Affinity(node_affinity=NodeAffinitySpec(
+        required=NodeSelector(node_selector_terms=[
+            NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(key="accelerator", operator="Exists")])])))
+    q = pod("notin")
+    q.spec.affinity = Affinity(node_affinity=NodeAffinitySpec(
+        required=NodeSelector(node_selector_terms=[
+            NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(key="accelerator", operator="NotIn",
+                                        values=["tpu"])])])))
+    d, names, _ = run_plugins(c, [p, q], [NodeAffinity()])
+    assert mask_for(d, names, "gpu", 0) and not mask_for(d, names, "plain", 0)
+    assert not mask_for(d, names, "gpu", 1) and mask_for(d, names, "plain", 1)
+
+
+def test_preferred_affinity_scores():
+    c = NodeFeatureCache()
+    c.upsert_node(node("preferred", labels={"tier": "fast"}))
+    c.upsert_node(node("other"))
+    p = pod("p")
+    p.spec.affinity = Affinity(node_affinity=NodeAffinitySpec(
+        preferred=[PreferredSchedulingTerm(
+            weight=10,
+            preference=NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(key="tier", operator="In",
+                                        values=["fast"])]))]))
+    d, names, _ = run_plugins(c, [p], [NodeUnschedulable(), NodeAffinity()])
+    assert score_for(d, names, "preferred") == 10.0
+    assert score_for(d, names, "other") == 0.0
+    assert names[int(d.chosen[0])] == "preferred"
+
+
+# ---- TaintToleration ----------------------------------------------------
+
+def test_taint_filter_and_toleration():
+    c = NodeFeatureCache()
+    c.upsert_node(node("tainted", taints=[Taint(key="dedicated", value="ml",
+                                                effect="NoSchedule")]))
+    c.upsert_node(node("open"))
+    p = pod("plain")
+    q = pod("tolerates")
+    q.spec.tolerations = [Toleration(key="dedicated", operator="Equal",
+                                     value="ml", effect="NoSchedule")]
+    r = pod("wrongval")
+    r.spec.tolerations = [Toleration(key="dedicated", operator="Equal",
+                                     value="web", effect="NoSchedule")]
+    d, names, _ = run_plugins(c, [p, q, r], [TaintToleration()])
+    assert not mask_for(d, names, "tainted", 0)
+    assert mask_for(d, names, "open", 0)
+    assert mask_for(d, names, "tainted", 1)
+    assert not mask_for(d, names, "tainted", 2)
+
+
+def test_prefer_no_schedule_scoring():
+    c = NodeFeatureCache()
+    c.upsert_node(node("soft-tainted", taints=[
+        Taint(key="maint", value="", effect="PreferNoSchedule")]))
+    c.upsert_node(node("clean"))
+    d, names, _ = run_plugins(c, [pod("p")],
+                              [NodeUnschedulable(), TaintToleration()])
+    assert names[int(d.chosen[0])] == "clean"
+
+
+# ---- NodePorts ----------------------------------------------------------
+
+def test_nodeports_conflict():
+    c = NodeFeatureCache()
+    c.upsert_node(node("busy"))
+    c.upsert_node(node("free"))
+    occupant = pod("occupant")
+    occupant.spec.ports = [ContainerPort(host_port=8080)]
+    bind(c, occupant, "busy")
+
+    p = pod("wants-8080")
+    p.spec.ports = [ContainerPort(host_port=8080)]
+    q = pod("wants-9090")
+    q.spec.ports = [ContainerPort(host_port=9090)]
+    d, names, _ = run_plugins(c, [p, q], [NodePorts()])
+    assert not mask_for(d, names, "busy", 0)
+    assert mask_for(d, names, "free", 0)
+    assert mask_for(d, names, "busy", 1)
+
+
+# ---- ImageLocality ------------------------------------------------------
+
+def test_imagelocality_prefers_cached_image():
+    c = NodeFeatureCache()
+    warm = node("warm")
+    warm.status.images = ["registry/app:v1"]
+    c.upsert_node(warm)
+    c.upsert_node(node("cold"))
+    p = pod("p")
+    p.spec.images = ["registry/app:v1"]
+    d, names, _ = run_plugins(c, [p], [NodeUnschedulable(), ImageLocality()])
+    assert names[int(d.chosen[0])] == "warm"
+    assert score_for(d, names, "warm") == 100.0
+    assert score_for(d, names, "cold") == 0.0
+
+
+# ---- VolumeBinding ------------------------------------------------------
+
+def test_volumebinding_masks_unready_pods():
+    from minisched_tpu.state.objects import VolumeClaim
+
+    c = NodeFeatureCache()
+    c.upsert_node(node("n"))
+    p = pod("needs-volume")
+    p.spec.volumes = [VolumeClaim(claim_name="data")]
+    eb = encode_pods([p], 16, registry=c.registry,
+                     volumes_ready_fn=lambda pod: False)
+    nf, names = c.snapshot()
+    d = build_step(PluginSet([VolumeBinding()]), explain=True)(
+        eb, nf, c.snapshot_assigned(), jax.random.PRNGKey(0))
+    assert not bool(np.asarray(d.filter_masks[0])[0, names.index("n")])
+
+
+# ---- PodTopologySpread --------------------------------------------------
+
+def zone_cluster():
+    c = NodeFeatureCache()
+    for z, name in (("a", "na1"), ("a", "na2"), ("b", "nb1"), ("c", "nc1")):
+        c.upsert_node(node(name, labels={ZONE: z}))
+    return c
+
+
+def spread_pod(name, mode="DoNotSchedule", max_skew=1):
+    p = pod(name)
+    p.metadata.labels = {"app": "web"}
+    p.spec.topology_spread_constraints = [TopologySpreadConstraint(
+        max_skew=max_skew, topology_key=ZONE, when_unsatisfiable=mode,
+        label_selector=LabelSelector(match_labels={"app": "web"}))]
+    return p
+
+
+def test_spread_filter_blocks_skewed_zone():
+    c = zone_cluster()
+    # zone a already has 2 matching pods, zones b/c none
+    for i, n in enumerate(["na1", "na2"]):
+        q = pod(f"existing{i}")
+        q.metadata.labels = {"app": "web"}
+        bind(c, q, n)
+    d, names, _ = run_plugins(c, [spread_pod("new")], [PodTopologySpread()])
+    # min domain count = 0 (b, c); placing in zone a → skew 3 > 1: reject
+    assert not mask_for(d, names, "na1")
+    assert not mask_for(d, names, "na2")
+    assert mask_for(d, names, "nb1")
+    assert mask_for(d, names, "nc1")
+
+
+def test_spread_ignores_nonmatching_pods():
+    c = zone_cluster()
+    q = pod("other")
+    q.metadata.labels = {"app": "db"}  # different app: not counted
+    bind(c, q, "na1")
+    d, names, _ = run_plugins(c, [spread_pod("new")], [PodTopologySpread()])
+    assert all(mask_for(d, names, n) for n in ("na1", "na2", "nb1", "nc1"))
+
+
+def test_spread_score_prefers_empty_domain():
+    c = zone_cluster()
+    q = pod("existing")
+    q.metadata.labels = {"app": "web"}
+    bind(c, q, "na1")
+    d, names, _ = run_plugins(
+        c, [spread_pod("new", mode="ScheduleAnyway")],
+        [NodeUnschedulable(), PodTopologySpread()])
+    assert names[int(d.chosen[0])] in ("nb1", "nc1")
+    assert score_for(d, names, "nb1") > score_for(d, names, "na1")
+
+
+def test_spread_missing_key_filtered():
+    c = zone_cluster()
+    c.upsert_node(node("nolabel"))  # no zone label
+    d, names, _ = run_plugins(c, [spread_pod("new")], [PodTopologySpread()])
+    assert not mask_for(d, names, "nolabel")
+    assert mask_for(d, names, "nb1")
+
+
+# ---- InterPodAffinity ---------------------------------------------------
+
+def affinity_pod(name, *, required=None, anti=None, preferred=None,
+                 topo=ZONE):
+    p = pod(name)
+    terms = lambda sels: [PodAffinityTerm(
+        label_selector=LabelSelector(match_labels=s), topology_key=topo)
+        for s in sels]
+    pa = PodAffinity(required=terms(required or []))
+    if preferred:
+        pa.preferred = [WeightedPodAffinityTerm(weight=w, term=PodAffinityTerm(
+            label_selector=LabelSelector(match_labels=s), topology_key=topo))
+            for w, s in preferred]
+    p.spec.affinity = Affinity(
+        pod_affinity=pa,
+        pod_anti_affinity=PodAntiAffinity(required=terms(anti or [])))
+    return p
+
+
+def test_required_pod_affinity_colocates():
+    c = zone_cluster()
+    cachebuddy = pod("cache-server")
+    cachebuddy.metadata.labels = {"app": "cache"}
+    bind(c, cachebuddy, "nb1")
+
+    p = affinity_pod("web", required=[{"app": "cache"}])
+    d, names, _ = run_plugins(c, [p], [InterPodAffinity()])
+    # only zone b contains a matching pod
+    assert not mask_for(d, names, "na1")
+    assert mask_for(d, names, "nb1")
+    assert not mask_for(d, names, "nc1")
+
+
+def test_required_anti_affinity_excludes_domain():
+    c = zone_cluster()
+    enemy = pod("enemy")
+    enemy.metadata.labels = {"app": "web"}
+    bind(c, enemy, "na1")
+    p = affinity_pod("web2", anti=[{"app": "web"}])
+    p.metadata.labels = {"app": "web"}
+    d, names, _ = run_plugins(c, [p], [InterPodAffinity()])
+    assert not mask_for(d, names, "na1")
+    assert not mask_for(d, names, "na2")  # same zone as enemy
+    assert mask_for(d, names, "nb1")
+
+
+def test_anti_affinity_by_hostname():
+    c = zone_cluster()
+    enemy = pod("enemy")
+    enemy.metadata.labels = {"app": "web"}
+    bind(c, enemy, "na1")
+    p = affinity_pod("web2", anti=[{"app": "web"}],
+                     topo="kubernetes.io/hostname")
+    d, names, _ = run_plugins(c, [p], [InterPodAffinity()])
+    assert not mask_for(d, names, "na1")
+    assert mask_for(d, names, "na2")  # different host, same zone: fine
+
+
+def test_preferred_pod_affinity_scores():
+    c = zone_cluster()
+    buddy = pod("buddy")
+    buddy.metadata.labels = {"app": "cache"}
+    bind(c, buddy, "nc1")
+    p = affinity_pod("web", preferred=[(5, {"app": "cache"})])
+    d, names, _ = run_plugins(c, [p],
+                              [NodeUnschedulable(), InterPodAffinity()])
+    assert names[int(d.chosen[0])] == "nc1"
+    assert score_for(d, names, "nc1") == 5.0
+
+
+def test_self_affine_first_replica_schedules():
+    """Upstream special case: required pod affinity whose selector matches
+    the incoming pod itself passes when NO pod in the cluster matches —
+    otherwise the first replica could never schedule."""
+    c = zone_cluster()
+    p = affinity_pod("web-0", required=[{"app": "web"}])
+    p.metadata.labels = {"app": "web"}
+    d, names, _ = run_plugins(c, [p], [InterPodAffinity()])
+    assert all(mask_for(d, names, n) for n in ("na1", "na2", "nb1", "nc1"))
+
+    # but once a matching pod EXISTS, the term must bind to its domain
+    buddy = pod("web-1")
+    buddy.metadata.labels = {"app": "web"}
+    bind(c, buddy, "nb1")
+    d2, names2, _ = run_plugins(c, [p], [InterPodAffinity()])
+    assert mask_for(d2, names2, "nb1")
+    assert not mask_for(d2, names2, "na1")
+
+
+def test_spread_score_zero_for_missing_key():
+    c = zone_cluster()
+    c.upsert_node(node("unlabeled"))
+    q = pod("existing")
+    q.metadata.labels = {"app": "web"}
+    bind(c, q, "na1")
+    d, names, _ = run_plugins(
+        c, [spread_pod("new", mode="ScheduleAnyway")], [PodTopologySpread()])
+    # unlabeled node must NOT get the top spread score
+    assert score_for(d, names, "unlabeled") == 0.0
+    assert score_for(d, names, "nb1") > 0.0
+
+
+def test_namespace_restriction():
+    c = zone_cluster()
+    other_ns = pod("other", ns="production")
+    other_ns.metadata.labels = {"app": "cache"}
+    bind(c, other_ns, "nb1")
+    # pod in "default" requires affinity to app=cache in ITS OWN namespace
+    p = affinity_pod("web", required=[{"app": "cache"}])
+    d, names, _ = run_plugins(c, [p], [InterPodAffinity()])
+    assert not mask_for(d, names, "nb1")  # match is in another namespace

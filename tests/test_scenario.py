@@ -167,9 +167,9 @@ def test_cordoned_node_tolerated_by_exists_toleration():
         value="true", effect="NoSchedule")]
     plain = pod("plain")
 
-    pf = encode_pods([tolerant, wrong_value, plain], 16)
+    eb = encode_pods([tolerant, wrong_value, plain], 16, registry=c.registry)
     d = build_step(PluginSet([NodeUnschedulable()]), explain=True)(
-        pf, nf, jax.random.PRNGKey(0))
+        eb, nf, c.snapshot_assigned(), jax.random.PRNGKey(0))
     import numpy as np
 
     mask = np.asarray(d.filter_masks[0])
