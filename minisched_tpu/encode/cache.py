@@ -43,6 +43,13 @@ class NodeFeatureCache:
         # pod key → (node row, requests vector, host ports) for incremental
         # free-resource accounting; only bound pods appear here.
         self._bound: Dict[str, Tuple[int, np.ndarray, List[int]]] = {}
+        # Gang membership of bound pods: group → live count, pod key →
+        # group. Feeds quorum accounting (ops/gang.py): a gang's effective
+        # min_count is reduced by members already running cluster-wide, the
+        # way upstream coscheduling counts total group membership — without
+        # this a replacement member of a running gang could never schedule.
+        self._gang_bound: Dict[str, int] = {}
+        self._key_gang: Dict[str, str] = {}
         self.overflow: List[str] = []  # encoding-slot overflow reports
         self.version = 0  # bumped on every mutation (cheap staleness check)
         # topology keys shared with pod encoding; new registrations trigger
@@ -105,6 +112,10 @@ class NodeFeatureCache:
             self._bound[pod.key] = (i, req, ports)
             self._feats.free[i] -= req
             self._add_ports(i, ports)
+            group = pod.spec.pod_group
+            if group:
+                self._key_gang[pod.key] = group
+                self._gang_bound[group] = self._gang_bound.get(group, 0) + 1
 
             a = self._alloc_assigned_row()
             self._a_row[pod.key] = a
@@ -137,7 +148,19 @@ class NodeFeatureCache:
                 self._assigned.valid[a] = False
                 self._assigned.label_pairs[a] = 0
                 self._a_free.append(a)
+            group = self._key_gang.pop(pod_key, None)
+            if group is not None:
+                left = self._gang_bound.get(group, 0) - 1
+                if left > 0:
+                    self._gang_bound[group] = left
+                else:
+                    self._gang_bound.pop(group, None)
             self.version += 1
+
+    def gang_bound_count(self, group: str) -> int:
+        """Live (bound/assumed) members of a gang, cluster-wide."""
+        with self._lock:
+            return self._gang_bound.get(group, 0)
 
     # ---- snapshot -------------------------------------------------------
 

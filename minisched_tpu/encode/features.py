@@ -262,12 +262,23 @@ class NodeAffinityGroups(NamedTuple):
     pref_vals: np.ndarray    # (G2,T2,E,V) i32
 
 
+class GangFeatures(NamedTuple):
+    """Gang (coscheduling) groups in a batch (leading dim GG, padded).
+    Pods sharing spec.pod_group are assigned all-or-nothing by
+    ops.gang.gang_assign (BASELINE config 5; no reference analog)."""
+
+    group: np.ndarray      # (P,) i32 gang id, -1 = ungrouped
+    min_count: np.ndarray  # (GG,) i32 quorum (0 on padding rows)
+    valid: np.ndarray      # (GG,) bool
+
+
 class EncodedBatch(NamedTuple):
     """Everything encode_pods produces for one scheduling batch."""
 
     pf: "PodFeatures"
     gf: "GroupFeatures"        # topology-constraint selector groups
     naf: "NodeAffinityGroups"  # node-affinity signature groups
+    gang: "GangFeatures"       # gang/coscheduling groups
 
 
 def empty_node_features(n: int, cfg: EncodingConfig = DEFAULT_ENCODING) -> NodeFeatures:
@@ -580,7 +591,8 @@ def encode_pods(pods: List[Pod], p_pad: int,
                 overflow: Optional[List[str]] = None,
                 registry: Optional[TopologyKeyRegistry] = None,
                 volumes_ready_fn=None,
-                group_pad: Optional[int] = None):
+                group_pad: Optional[int] = None,
+                gang_bound_fn=None):
     """Encode a batch of pending pods, padded to ``p_pad`` rows.
 
     Returns an EncodedBatch: pod features plus the batch's distinct
@@ -621,6 +633,9 @@ def encode_pods(pods: List[Pod], p_pad: int,
         anti_pref_group=np.full((P, T), -1, dtype=np.int32),
         anti_pref_weight=np.zeros((P, T), dtype=np.float32),
     )
+    gang_group = np.full(P, -1, dtype=np.int32)
+    gang_ids: Dict[str, int] = {}
+    gang_mins: List[int] = []
     for i, pod in enumerate(pods):
         if i >= P:
             raise ValueError(f"{len(pods)} pods > pad {P}")
@@ -629,6 +644,12 @@ def encode_pods(pods: List[Pod], p_pad: int,
         f.name_suffix[i] = name_suffix_digit(pod.metadata.name)
         f.priority[i] = pod.spec.priority
         f.na_group[i] = na_builder.group_of(pod)
+        if pod.spec.pod_group:
+            gid = gang_ids.setdefault(pod.spec.pod_group, len(gang_mins))
+            if gid == len(gang_mins):
+                gang_mins.append(0)
+            gang_mins[gid] = max(gang_mins[gid], int(pod.spec.pod_group_min))
+            gang_group[i] = gid
         aff = pod.spec.affinity
 
         tols = pod.spec.tolerations
@@ -684,5 +705,18 @@ def encode_pods(pods: List[Pod], p_pad: int,
                 i, anti.preferred, f.anti_pref_group, f.anti_pref_weight,
                 builder, registry, ns_h, overflow,
                 f"pod {pod.key} podAntiAffinity.preferred")
+    if gang_bound_fn is not None:
+        # Quorum counts cluster-wide membership (upstream coscheduling):
+        # members already running reduce the in-batch quorum, so a late or
+        # replacement member of a live gang can still schedule.
+        for group, gid in gang_ids.items():
+            gang_mins[gid] = max(0, gang_mins[gid] - int(gang_bound_fn(group)))
+    GG = _next_pow2(max(len(gang_mins), 8))
+    gang = GangFeatures(
+        group=gang_group,
+        min_count=np.array(gang_mins + [0] * (GG - len(gang_mins)),
+                           dtype=np.int32),
+        valid=np.array([True] * len(gang_mins)
+                       + [False] * (GG - len(gang_mins)), dtype=bool))
     return EncodedBatch(pf=f, gf=builder.build(group_pad),
-                        naf=na_builder.build(overflow=overflow))
+                        naf=na_builder.build(overflow=overflow), gang=gang)

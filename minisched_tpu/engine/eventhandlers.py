@@ -25,6 +25,11 @@ def add_all_event_handlers(sched, factory: InformerFactory) -> None:
     def pod_add(pod):
         if not pod.spec.node_name:
             sched.queue.add(pod)
+            if pod.spec.pod_group:
+                # A new gang member may complete a parked group's quorum
+                # (upstream coscheduling's sibling activation).
+                sched.queue.move_all_to_active_or_backoff(
+                    ClusterEvent(GVK.POD, ActionType.ADD))
         else:
             sched.cache.account_bind(pod)
             sched.queue.move_all_to_active_or_backoff(

@@ -39,6 +39,11 @@ from ..state.objects import Pod
 # Registered against node add/update events by the scheduler.
 BATCH_CAPACITY = "BatchCapacity"
 
+# Pseudo-plugin recorded when a pod's gang missed quorum (ops/gang.py).
+# Registered against pod add/delete + node add/update events: a new gang
+# member or freed capacity can complete the group.
+COSCHEDULING = "Coscheduling"
+
 
 @dataclass
 class QueuedPodInfo:
@@ -217,6 +222,30 @@ class SchedulingQueue:
             for qpi in batch:
                 qpi.popped_at_cycle = self._move_cycle
             return batch
+
+    def pop_group(self, group: str) -> List[QueuedPodInfo]:
+        """Pull every queued member of a gang so one batch sees the whole
+        group (a batch boundary splitting a gang would otherwise reject it
+        for missing quorum). Members still in their backoff window are
+        pulled too — gang activation bypasses backoff, like upstream
+        coscheduling's sibling activation — but parked unschedulable
+        members are left to event-driven revival. Non-blocking."""
+        with self._cond:
+            members = [q for q in self._active
+                       if q.pod.spec.pod_group == group]
+            in_backoff = [e for e in self._backoff
+                          if e[2].pod.spec.pod_group == group]
+            if members:
+                self._active = [q for q in self._active
+                                if q.pod.spec.pod_group != group]
+            if in_backoff:
+                self._backoff = [e for e in self._backoff
+                                 if e[2].pod.spec.pod_group != group]
+                heapq.heapify(self._backoff)
+                members.extend(e[2] for e in in_backoff)
+            for qpi in members:
+                qpi.popped_at_cycle = self._move_cycle
+            return members
 
     # ---- lifecycle / introspection -------------------------------------
 

@@ -37,7 +37,8 @@ from ..state.events import ActionType, ClusterEvent, EventBroadcaster, GVK
 from ..state.informer import InformerFactory
 from ..state.objects import Pod, deepcopy_obj
 from . import eventhandlers
-from .queue import BATCH_CAPACITY, QueuedPodInfo, SchedulingQueue
+from .queue import (BATCH_CAPACITY, COSCHEDULING, QueuedPodInfo,
+                    SchedulingQueue)
 from .waitingpod import WaitingPod
 
 log = logging.getLogger(__name__)
@@ -63,6 +64,14 @@ class Scheduler:
         }
         for ev in cap_interest:
             event_map.setdefault(ev, set()).add(BATCH_CAPACITY)
+        # Gang-rejected pods revive when a new member arrives (pod add),
+        # capacity frees (pod delete), or nodes appear/change.
+        cos_interest = {
+            ClusterEvent(GVK.POD, ActionType.ADD | ActionType.DELETE),
+            ClusterEvent(GVK.NODE, ActionType.ADD | ActionType.UPDATE),
+        }
+        for ev in cos_interest:
+            event_map.setdefault(ev, set()).add(COSCHEDULING)
 
         self.queue = SchedulingQueue(
             event_map,
@@ -122,6 +131,11 @@ class Scheduler:
 
     def schedule_batch(self, batch: List[QueuedPodInfo]) -> Decision:
         cfg = self.config
+        # Pull queued gang-mates so no batch boundary splits a gang (the
+        # step would reject the partial group for missing quorum).
+        for group in {q.pod.spec.pod_group for q in batch
+                      if q.pod.spec.pod_group}:
+            batch.extend(self.queue.pop_group(group))
         batch = sorted(batch, key=lambda q: -q.pod.spec.priority)
         pods = [q.pod for q in batch]
 
@@ -130,7 +144,8 @@ class Scheduler:
         eb = encode_pods(pods, bucket_for(len(pods), cfg.pod_bucket_min),
                          registry=self.cache.registry,
                          overflow=self.cache.overflow,
-                         volumes_ready_fn=self._volumes_ready)
+                         volumes_ready_fn=self._volumes_ready,
+                         gang_bound_fn=self.cache.gang_bound_count)
         nf, names = self.cache.snapshot()
         af = self.cache.snapshot_assigned()
 
@@ -140,6 +155,7 @@ class Scheduler:
 
         chosen = np.asarray(decision.chosen)
         assigned = np.asarray(decision.assigned)
+        gang_rejected = np.asarray(decision.gang_rejected)
         feasible = np.asarray(decision.feasible_counts)
         rejects = np.asarray(decision.reject_counts)
 
@@ -150,6 +166,19 @@ class Scheduler:
             if assigned[i]:
                 node_name = names[int(chosen[i])]
                 self._start_binding_cycle(qpi, node_name)
+            elif gang_rejected[i]:
+                # The pod's gang missed quorum — park the whole member set
+                # under Coscheduling (plus any real filter rejections, for
+                # precise event gating) until a new member or capacity event.
+                plugins = {COSCHEDULING}
+                if feasible[i] == 0:
+                    plugins |= {self.filter_names[f]
+                                for f in range(rejects.shape[0])
+                                if rejects[f, i] > 0}
+                self._handle_failure(
+                    qpi, plugins,
+                    f"gang {qpi.pod.spec.pod_group} missed quorum "
+                    f"{qpi.pod.spec.pod_group_min}", retryable=False)
             elif feasible[i] > 0:
                 # Nodes were feasible but earlier pods in the batch took the
                 # capacity — retryable, not unschedulable (SURVEY §7

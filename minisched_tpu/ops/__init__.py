@@ -1,2 +1,3 @@
+from .gang import GangResult, gang_assign  # noqa: F401
 from .pipeline import Decision, build_step  # noqa: F401
 from .select import greedy_assign  # noqa: F401

@@ -1,0 +1,89 @@
+"""Gang (all-or-nothing) assignment inside the XLA step — BASELINE config 5.
+
+The reference has no gang/coscheduling analog (SURVEY §2 — it schedules one
+pod at a time); upstream Kubernetes provides it out-of-tree via the
+sig-scheduling coscheduling plugin's PodGroup CRD (reject pods until the
+group reaches quorum, then admit together). The batched world lets us do
+better than reject-and-retry: gang semantics become part of the joint
+assignment itself.
+
+``gang_assign`` wraps the capacity-aware greedy scan (select.py) in a
+fixed-point loop over *group admission*:
+
+  1. run the greedy assignment with every group admitted;
+  2. any group placing fewer than ``min_count`` members is evicted — all of
+     its tentative placements are revoked at once;
+  3. re-run with the surviving admission set (evicted groups' capacity is
+     released to everyone else) until the admitted set is stable.
+
+The admitted set only shrinks, so the ``lax.while_loop`` terminates in at
+most G+1 iterations; in the common no-gang case the first recount confirms
+the initial assignment and the loop body never runs (cost ≈ one
+segment-sum over the pod axis on top of plain greedy assignment, which is
+why the pipeline uses gang_assign unconditionally).
+
+Ungrouped pods (group id -1) are always admitted; their only interaction
+with gangs is through capacity, exactly as in the sequential semantics.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .select import NEG, AssignResult, greedy_assign
+
+
+class GangResult(NamedTuple):
+    chosen: jnp.ndarray         # (P,) i32 node row, -1 unassigned
+    assigned: jnp.ndarray       # (P,) bool
+    free_after: jnp.ndarray     # (N,R) f32 remaining free resources
+    gang_rejected: jnp.ndarray  # (P,) bool — pod's group missed quorum
+    group_ok: jnp.ndarray       # (G,) bool — group met min_count
+
+
+def gang_assign(scores: jnp.ndarray, requests: jnp.ndarray,
+                free0: jnp.ndarray, group_ids: jnp.ndarray,
+                group_min: jnp.ndarray, key: jax.Array) -> GangResult:
+    """Jointly assign pods to nodes with all-or-nothing group semantics.
+
+    scores:    (P,N) f32 with NEG on infeasible pairs (pods pre-sorted by
+               priority — row order is assignment order)
+    requests:  (P,R) f32 per-pod resource requests
+    free0:     (N,R) f32 free resources entering the batch
+    group_ids: (P,) i32 gang id in [0,G), -1 for ungrouped pods
+    group_min: (G,) i32 quorum per gang (0 for padding rows)
+    """
+    G = group_min.shape[0]
+    grouped = group_ids >= 0
+    gidx = jnp.where(grouped, group_ids, 0)  # safe segment index
+
+    def run(ok):
+        pod_ok = jnp.where(grouped, ok[gidx], True)
+        res = greedy_assign(jnp.where(pod_ok[:, None], scores, NEG),
+                            requests, free0, key)
+        placed = (res.assigned & grouped).astype(jnp.int32)
+        counts = jax.ops.segment_sum(placed, gidx, num_segments=G)
+        return res, ok & (counts >= group_min)
+
+    all_ok = jnp.ones((G,), dtype=bool)
+    res0, ok0 = run(all_ok)
+
+    def cond(carry):
+        prev_ok, _, new_ok = carry
+        return jnp.any(prev_ok != new_ok)
+
+    def body(carry):
+        _, _, ok = carry
+        res, new_ok = run(ok)
+        return ok, res, new_ok
+
+    # Invariant: carry = (ok, run(ok) result, admission induced by that
+    # result). Exits when the admitted set reproduces itself.
+    ok, res, _ = jax.lax.while_loop(cond, body, (all_ok, res0, ok0))
+
+    gang_rejected = grouped & ~ok[gidx]
+    return GangResult(chosen=res.chosen, assigned=res.assigned,
+                      free_after=res.free_after,
+                      gang_rejected=gang_rejected, group_ok=ok)

@@ -26,7 +26,8 @@ import jax.numpy as jnp
 
 from ..encode.features import DEFAULT_ENCODING, EncodingConfig
 from ..plugins.base import PluginSet
-from .select import NEG, AssignResult, greedy_assign
+from .gang import GangResult, gang_assign
+from .select import NEG
 from .topology import group_topology_state
 
 
@@ -35,6 +36,7 @@ class Decision(NamedTuple):
 
     chosen: jnp.ndarray           # (P,) i32 node row, -1 unassigned
     assigned: jnp.ndarray         # (P,) bool
+    gang_rejected: jnp.ndarray    # (P,) bool — pod's gang missed quorum
     feasible_counts: jnp.ndarray  # (P,) i32 nodes passing all filters
     reject_counts: jnp.ndarray    # (F,P) i32 nodes rejected per filter plugin
     total_scores: jnp.ndarray     # (P,N) f32 weighted sum (NEG on infeasible)
@@ -116,7 +118,11 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
                 norms.append(norm)
 
         masked_total = jnp.where(feasible, total, NEG)
-        assign: AssignResult = greedy_assign(masked_total, pf.requests, nf.free, key)
+        # Gang-aware joint assignment (ops/gang.py); with no gangs in the
+        # batch this reduces to plain capacity-aware greedy assignment.
+        assign: GangResult = gang_assign(
+            masked_total, pf.requests, nf.free,
+            eb.gang.group, eb.gang.min_count, key)
 
         if explain:
             filter_stack = (jnp.stack(masks) if masks
@@ -133,6 +139,7 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
         return Decision(
             chosen=assign.chosen,
             assigned=assign.assigned,
+            gang_rejected=assign.gang_rejected,
             feasible_counts=feasible_counts,
             reject_counts=reject_counts,
             total_scores=masked_total,

@@ -71,14 +71,18 @@ def feature_shardings(mesh: Mesh, eb_template, nf_template, af_template) -> Tupl
     (topo_domains over its second dim — leading dim is the key registry);
     constraint groups and the assigned-pod corpus are small relative to the
     (P×N) matrices and stay replicated."""
-    pf, gf, naf = eb_template.pf, eb_template.gf, eb_template.naf
+    pf, gf, naf, gang = (eb_template.pf, eb_template.gf, eb_template.naf,
+                         eb_template.gang)
     pf_sh = type(pf)(*(_spec_for(mesh, a, POD_AXIS) for a in pf))
     nf_sh = type(nf_template)(*(
         NamedSharding(mesh, P(None, NODE_AXIS)) if name == "topo_domains"
         else _spec_for(mesh, a, NODE_AXIS)
         for name, a in zip(nf_template._fields, nf_template)))
+    gang_sh = type(gang)(group=_spec_for(mesh, gang.group, POD_AXIS),
+                         min_count=NamedSharding(mesh, P()),
+                         valid=NamedSharding(mesh, P()))
     eb_sh = type(eb_template)(pf=pf_sh, gf=_replicated(mesh, gf),
-                              naf=_replicated(mesh, naf))
+                              naf=_replicated(mesh, naf), gang=gang_sh)
     af_sh = _replicated(mesh, af_template)
     return eb_sh, nf_sh, af_sh
 

@@ -42,7 +42,8 @@ def build_sharded_step(plugin_set: PluginSet, mesh, eb_template, nf_template,
     node_res = NamedSharding(mesh, P(NODE_AXIS, None))
     stack_both = NamedSharding(mesh, P(None, POD_AXIS, NODE_AXIS))
     out_sh = Decision(
-        chosen=pod_only, assigned=pod_only, feasible_counts=pod_only,
+        chosen=pod_only, assigned=pod_only, gang_rejected=pod_only,
+        feasible_counts=pod_only,
         reject_counts=NamedSharding(mesh, P(None, POD_AXIS)),
         total_scores=both, free_after=node_res,
         filter_masks=stack_both, raw_scores=stack_both, norm_scores=stack_both)

@@ -108,7 +108,7 @@ def test_pod_encoding_fields():
     p.spec.tolerations = [Toleration(key="dedicated", operator="Equal",
                                      value="ml", effect="NoSchedule")]
     p.spec.ports = [ContainerPort(host_port=9000)]
-    pf, gf, naf = encode_pods([p], 4)
+    pf, gf, naf, _gang = encode_pods([p], 4)
     assert pf.valid.tolist() == [True, False, False, False]
     assert pf.requests[0, 0] == 250
     assert pf.requests[0, 2] == 1  # implicit pods:1

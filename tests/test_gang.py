@@ -1,0 +1,211 @@
+"""Gang/coscheduling: all-or-nothing joint assignment (BASELINE config 5).
+
+The reference has no gang analog (SURVEY §2); op semantics follow the
+upstream sig-scheduling coscheduling plugin (quorum or park), folded into
+the batched assignment itself (ops/gang.py).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from minisched_tpu.config import SchedulerConfig
+from minisched_tpu.ops.gang import gang_assign
+from minisched_tpu.ops.select import NEG
+from minisched_tpu.scenario import Cluster, wait_until
+from minisched_tpu.state import objects as obj
+
+
+def fast_config(**kw):
+    kw.setdefault("backoff_initial_s", 0.05)
+    kw.setdefault("backoff_max_s", 0.2)
+    return SchedulerConfig(**kw)
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster()
+    yield c
+    c.shutdown()
+
+
+# ---- op level -----------------------------------------------------------
+
+def _uniform(P, N, cpu_req=100.0, node_cpu=1000.0, score=1.0):
+    scores = jnp.full((P, N), score, dtype=jnp.float32)
+    requests = jnp.tile(jnp.array([[cpu_req]], jnp.float32), (P, 1))
+    free0 = jnp.tile(jnp.array([[node_cpu]], jnp.float32), (N, 1))
+    return scores, requests, free0
+
+
+def test_gang_all_fit():
+    scores, req, free = _uniform(4, 4)
+    res = gang_assign(scores, req, free,
+                      group_ids=jnp.array([0, 0, 0, -1], jnp.int32),
+                      group_min=jnp.array([3, 0], jnp.int32),
+                      key=jax.random.PRNGKey(0))
+    assert bool(res.assigned.all())
+    assert not bool(res.gang_rejected.any())
+    assert bool(res.group_ok[0])
+
+
+def test_gang_misses_quorum_releases_capacity():
+    # One node fits 2 pods; gang of 3 with min 3 cannot fit — the ungrouped
+    # pod must still schedule using the capacity the evicted gang released.
+    scores, req, free = _uniform(4, 1, cpu_req=100.0, node_cpu=200.0)
+    res = gang_assign(scores, req, free,
+                      group_ids=jnp.array([0, 0, 0, -1], jnp.int32),
+                      group_min=jnp.array([3, 0], jnp.int32),
+                      key=jax.random.PRNGKey(0))
+    a = np.asarray(res.assigned)
+    assert not a[:3].any()          # whole gang evicted
+    assert a[3]                     # ungrouped pod got the freed slot
+    assert np.asarray(res.gang_rejected)[:3].all()
+    assert not np.asarray(res.gang_rejected)[3]
+    assert not bool(res.group_ok[0])
+    # evicted gang's capacity fully released
+    assert float(res.free_after[0, 0]) == 100.0
+
+
+def test_two_gangs_competing_one_wins():
+    # Capacity for 3 pods total; gang A (rows 0-2, min 3) is scheduled
+    # first (row order = priority order) and takes everything; gang B
+    # (rows 3-5, min 3) must be evicted atomically.
+    scores, req, free = _uniform(6, 1, cpu_req=100.0, node_cpu=300.0)
+    res = gang_assign(scores, req, free,
+                      group_ids=jnp.array([0, 0, 0, 1, 1, 1], jnp.int32),
+                      group_min=jnp.array([3, 3], jnp.int32),
+                      key=jax.random.PRNGKey(1))
+    a = np.asarray(res.assigned)
+    assert a[:3].all() and not a[3:].any()
+    assert bool(res.group_ok[0]) and not bool(res.group_ok[1])
+
+
+def test_partial_quorum_allowed():
+    # min_count below member count: gang of 3 with min 2 keeps the two
+    # placeable members even when the third has no feasible node.
+    scores, req, free = _uniform(3, 2, cpu_req=100.0, node_cpu=100.0)
+    scores = scores.at[2].set(NEG)  # third member infeasible everywhere
+    res = gang_assign(scores, req, free,
+                      group_ids=jnp.array([0, 0, 0], jnp.int32),
+                      group_min=jnp.array([2], jnp.int32),
+                      key=jax.random.PRNGKey(2))
+    a = np.asarray(res.assigned)
+    assert a[0] and a[1] and not a[2]
+    assert bool(res.group_ok[0])
+    assert not np.asarray(res.gang_rejected).any()
+
+
+def test_no_gangs_is_plain_greedy():
+    from minisched_tpu.ops.select import greedy_assign
+    key = jax.random.PRNGKey(3)
+    scores = jax.random.uniform(key, (8, 5))
+    req = jnp.full((8, 1), 100.0)
+    free = jnp.full((5, 1), 250.0)
+    res = gang_assign(scores, req, free,
+                      group_ids=jnp.full((8,), -1, jnp.int32),
+                      group_min=jnp.zeros((4,), jnp.int32), key=key)
+    base = greedy_assign(scores, req, free, key)
+    assert np.array_equal(np.asarray(res.chosen), np.asarray(base.chosen))
+    assert not np.asarray(res.gang_rejected).any()
+
+
+def test_eviction_cascade_converges():
+    # Fixed-point property under adversarial shapes: final admitted groups
+    # meet quorum with the final assignment; evicted groups place nobody.
+    key = jax.random.PRNGKey(4)
+    P, N, G = 24, 6, 5
+    scores = jax.random.uniform(key, (P, N))
+    req = jnp.full((P, 1), 100.0)
+    free = jnp.full((N, 1), 300.0)  # 18 slots for 24 pods
+    gids = jnp.array([i % G for i in range(P)], jnp.int32)
+    gmin = jnp.array([5, 5, 5, 5, 4], jnp.int32)
+    res = gang_assign(scores, req, free, gids, gmin, key)
+    a = np.asarray(res.assigned)
+    ok = np.asarray(res.group_ok)
+    for g in range(G):
+        members = np.asarray(gids) == g
+        placed = int((a & members).sum())
+        if ok[g]:
+            assert placed >= int(gmin[g])
+        else:
+            assert placed == 0
+
+
+# ---- scenario level -----------------------------------------------------
+
+def _gang_pod_spec(group: str, min_count: int, cpu: float = 100.0):
+    return obj.PodSpec(requests={"cpu": cpu}, pod_group=group,
+                       pod_group_min=min_count)
+
+
+def test_gang_binds_together(cluster):
+    cluster.start(config=fast_config())
+    cluster.create_node("workerA", cpu=1000)
+    for i in range(3):
+        cluster.create_pod(f"g{i}x", spec=_gang_pod_spec("job", 3))
+    for i in range(3):
+        bound = cluster.wait_for_pod_bound(f"g{i}x", timeout=10)
+        assert bound.spec.node_name == "workerA"
+
+
+def test_gang_parks_until_capacity_arrives(cluster):
+    cluster.start(config=fast_config())
+    cluster.create_node("tinyA", cpu=200)  # fits 2 of the 3 members
+    for i in range(3):
+        cluster.create_pod(f"h{i}x", spec=_gang_pod_spec("batchjob", 3))
+    # Whole gang must park under Coscheduling — none may bind.
+    for i in range(3):
+        pending = cluster.wait_for_pod_pending(f"h{i}x", timeout=5)
+        assert "Coscheduling" in pending.status.unschedulable_plugins
+    # Capacity arrives → gang revives and binds atomically.
+    cluster.create_node("bigB", cpu=1000)
+    for i in range(3):
+        cluster.wait_for_pod_bound(f"h{i}x", timeout=10)
+
+
+def test_gang_waits_for_quorum_then_member_arrival_completes_it(cluster):
+    cluster.start(config=fast_config())
+    cluster.create_node("workerB", cpu=1000)
+    cluster.create_pod("m0x", spec=_gang_pod_spec("trio", 3))
+    cluster.create_pod("m1x", spec=_gang_pod_spec("trio", 3))
+    # Two of three members: must park, not bind.
+    for name in ("m0x", "m1x"):
+        pending = cluster.wait_for_pod_pending(name, timeout=5)
+        assert "Coscheduling" in pending.status.unschedulable_plugins
+    # Third member arrives → pod-add event revives the parked mates.
+    cluster.create_pod("m2x", spec=_gang_pod_spec("trio", 3))
+    for name in ("m0x", "m1x", "m2x"):
+        cluster.wait_for_pod_bound(name, timeout=10)
+
+
+def test_replacement_member_of_running_gang_schedules(cluster):
+    """Quorum counts cluster-wide membership: once a gang runs, a deleted
+    member's replacement arrives alone and must still schedule (upstream
+    coscheduling counts total group membership; a batch-local count would
+    starve the replacement forever)."""
+    cluster.start(config=fast_config())
+    cluster.create_node("workerD", cpu=1000)
+    for i in range(3):
+        cluster.create_pod(f"r{i}x", spec=_gang_pod_spec("svc", 3))
+    for i in range(3):
+        cluster.wait_for_pod_bound(f"r{i}x", timeout=10)
+    # A member dies; its controller recreates it. 2 members still run, so
+    # the replacement's effective quorum is 1 — it must bind.
+    cluster.delete_pod("r0x")
+    cluster.create_pod("r0y", spec=_gang_pod_spec("svc", 3))
+    cluster.wait_for_pod_bound("r0y", timeout=10)
+
+
+def test_gang_does_not_starve_ungrouped_pods(cluster):
+    cluster.start(config=fast_config())
+    cluster.create_node("workerC", cpu=250)  # fits 2 pods of 100
+    for i in range(3):
+        cluster.create_pod(f"q{i}x", spec=_gang_pod_spec("bigjob", 3))
+    cluster.create_pod("solo1x", cpu=100)
+    # Gang can't fit (needs 300) and must not hold the capacity.
+    bound = cluster.wait_for_pod_bound("solo1x", timeout=10)
+    assert bound.spec.node_name == "workerC"
+    for i in range(3):
+        pending = cluster.wait_for_pod_pending(f"q{i}x", timeout=5)
+        assert "Coscheduling" in pending.status.unschedulable_plugins
