@@ -1,0 +1,28 @@
+# Dev tooling (analog of the reference Makefile: `make test` = go test ./...,
+# `make start` = build + etcd + run scenario; reference Makefile:1-31,
+# hack/start_simulator.sh:32-35 — here no etcd is needed: the cluster store
+# is in-process).
+
+PY ?= python
+CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+
+.PHONY: test start bench dryrun
+
+# Unit + integration suite on a virtual 8-device CPU mesh.
+test:
+	$(CPU_MESH) $(PY) -m pytest tests/ -x -q
+
+# Run the README scenario end-to-end (reference `make start`): 9
+# unschedulable nodes + 1 pod pending → node10 added → pod bound.
+start:
+	$(CPU_MESH) $(PY) -m minisched_tpu.scenario.runner
+
+# Headline benchmark (BASELINE.md): 50k nodes x 10k pods on whatever
+# accelerator jax picks. MINISCHED_BENCH_{NODES,PODS,REPEATS} override.
+bench:
+	$(PY) bench.py
+
+# Compile-check the flagship single-chip step and the multi-chip sharded
+# step on an 8-device virtual mesh.
+dryrun:
+	$(CPU_MESH) $(PY) __graft_entry__.py

@@ -138,3 +138,25 @@ def run_scenario(scenario: Callable[[Cluster], None],
         scenario(cluster)
     finally:
         cluster.shutdown()
+
+
+def default_scenario(c: Cluster) -> None:
+    """The reference's built-in scenario (sched.go:70-143): nine
+    unschedulable nodes, a pod that must stay pending with its rejecting
+    plugin recorded, then node10 appears and the pod must bind to it."""
+    for i in range(9):
+        c.create_node(f"node{i}", unschedulable=True)
+    c.create_pod("pod1")
+    # Generous timeout: the first scheduling attempt pays XLA compile.
+    pod = c.wait_for_pod_pending("pod1", timeout=30.0)
+    print(f"pod1 pending as expected "
+          f"(unschedulable_plugins={pod.status.unschedulable_plugins})")
+    c.create_node("node10")
+    pod = c.wait_for_pod_bound("pod1", timeout=15.0)
+    print(f"pod1 is bound to {pod.spec.node_name}")
+    assert pod.spec.node_name == "node10"
+
+
+if __name__ == "__main__":
+    run_scenario(default_scenario)
+    print("scenario OK")
