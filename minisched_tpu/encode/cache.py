@@ -14,7 +14,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..state.objects import Node, Pod, pod_requests
+from ..state.objects import Node, Pod, gang_key, pod_requests
 from . import features as F
 from .features import (AssignedPodFeatures, DEFAULT_ENCODING, EncodingConfig,
                        NodeFeatures, TopologyKeyRegistry)
@@ -96,6 +96,7 @@ class NodeFeatureCache:
                     self._assigned.valid[a] = False
                     self._assigned.label_pairs[a] = 0
                     self._a_free.append(a)
+                self._drop_gang_member(k)
             self.version += 1
 
     # ---- pod accounting -------------------------------------------------
@@ -112,7 +113,7 @@ class NodeFeatureCache:
             self._bound[pod.key] = (i, req, ports)
             self._feats.free[i] -= req
             self._add_ports(i, ports)
-            group = pod.spec.pod_group
+            group = gang_key(pod)
             if group:
                 self._key_gang[pod.key] = group
                 self._gang_bound[group] = self._gang_bound.get(group, 0) + 1
@@ -148,17 +149,22 @@ class NodeFeatureCache:
                 self._assigned.valid[a] = False
                 self._assigned.label_pairs[a] = 0
                 self._a_free.append(a)
-            group = self._key_gang.pop(pod_key, None)
-            if group is not None:
-                left = self._gang_bound.get(group, 0) - 1
-                if left > 0:
-                    self._gang_bound[group] = left
-                else:
-                    self._gang_bound.pop(group, None)
+            self._drop_gang_member(pod_key)
             self.version += 1
 
+    def _drop_gang_member(self, pod_key: str) -> None:
+        """Decrement the pod's gang live count (caller holds the lock)."""
+        group = self._key_gang.pop(pod_key, None)
+        if group is not None:
+            left = self._gang_bound.get(group, 0) - 1
+            if left > 0:
+                self._gang_bound[group] = left
+            else:
+                self._gang_bound.pop(group, None)
+
     def gang_bound_count(self, group: str) -> int:
-        """Live (bound/assumed) members of a gang, cluster-wide."""
+        """Live (bound/assumed) members of a gang (namespaced gang key),
+        cluster-wide."""
         with self._lock:
             return self._gang_bound.get(group, 0)
 

@@ -264,12 +264,12 @@ class NodeAffinityGroups(NamedTuple):
 
 class GangFeatures(NamedTuple):
     """Gang (coscheduling) groups in a batch (leading dim GG, padded).
-    Pods sharing spec.pod_group are assigned all-or-nothing by
-    ops.gang.gang_assign (BASELINE config 5; no reference analog)."""
+    Pods sharing a gang key (objects.gang_key — namespace-scoped) are
+    assigned all-or-nothing by ops.gang.gang_assign (BASELINE config 5; no
+    reference analog). Padding rows are inert via min_count == 0."""
 
     group: np.ndarray      # (P,) i32 gang id, -1 = ungrouped
     min_count: np.ndarray  # (GG,) i32 quorum (0 on padding rows)
-    valid: np.ndarray      # (GG,) bool
 
 
 class EncodedBatch(NamedTuple):
@@ -645,7 +645,7 @@ def encode_pods(pods: List[Pod], p_pad: int,
         f.priority[i] = pod.spec.priority
         f.na_group[i] = na_builder.group_of(pod)
         if pod.spec.pod_group:
-            gid = gang_ids.setdefault(pod.spec.pod_group, len(gang_mins))
+            gid = gang_ids.setdefault(obj.gang_key(pod), len(gang_mins))
             if gid == len(gang_mins):
                 gang_mins.append(0)
             gang_mins[gid] = max(gang_mins[gid], int(pod.spec.pod_group_min))
@@ -715,8 +715,6 @@ def encode_pods(pods: List[Pod], p_pad: int,
     gang = GangFeatures(
         group=gang_group,
         min_count=np.array(gang_mins + [0] * (GG - len(gang_mins)),
-                           dtype=np.int32),
-        valid=np.array([True] * len(gang_mins)
-                       + [False] * (GG - len(gang_mins)), dtype=bool))
+                           dtype=np.int32))
     return EncodedBatch(pf=f, gf=builder.build(group_pad),
                         naf=na_builder.build(overflow=overflow), gang=gang)

@@ -32,7 +32,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
 from ..state.events import ClusterEvent
-from ..state.objects import Pod
+from ..state.objects import Pod, gang_key
 
 # Pseudo-plugin recorded when a pod lost only because earlier pods in the
 # same batch consumed the capacity (no reference analog — batching artifact).
@@ -224,23 +224,24 @@ class SchedulingQueue:
             return batch
 
     def pop_group(self, group: str) -> List[QueuedPodInfo]:
-        """Pull every queued member of a gang so one batch sees the whole
-        group (a batch boundary splitting a gang would otherwise reject it
-        for missing quorum). Members still in their backoff window are
-        pulled too — gang activation bypasses backoff, like upstream
-        coscheduling's sibling activation — but parked unschedulable
-        members are left to event-driven revival. Non-blocking."""
+        """Pull every queued member of a gang (namespaced gang key,
+        objects.gang_key) so one batch sees the whole group (a batch
+        boundary splitting a gang would otherwise reject it for missing
+        quorum). Members still in their backoff window are pulled too —
+        gang activation bypasses backoff, like upstream coscheduling's
+        sibling activation — but parked unschedulable members are left to
+        event-driven revival. Non-blocking."""
         with self._cond:
             members = [q for q in self._active
-                       if q.pod.spec.pod_group == group]
+                       if gang_key(q.pod) == group]
             in_backoff = [e for e in self._backoff
-                          if e[2].pod.spec.pod_group == group]
+                          if gang_key(e[2].pod) == group]
             if members:
                 self._active = [q for q in self._active
-                                if q.pod.spec.pod_group != group]
+                                if gang_key(q.pod) != group]
             if in_backoff:
                 self._backoff = [e for e in self._backoff
-                                 if e[2].pod.spec.pod_group != group]
+                                 if gang_key(e[2].pod) != group]
                 heapq.heapify(self._backoff)
                 members.extend(e[2] for e in in_backoff)
             for qpi in members:

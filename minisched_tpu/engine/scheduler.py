@@ -35,7 +35,7 @@ from ..ops.pipeline import Decision, build_step
 from ..plugins.base import PluginSet
 from ..state.events import ActionType, ClusterEvent, EventBroadcaster, GVK
 from ..state.informer import InformerFactory
-from ..state.objects import Pod, deepcopy_obj
+from ..state.objects import Pod, deepcopy_obj, gang_key
 from . import eventhandlers
 from .queue import (BATCH_CAPACITY, COSCHEDULING, QueuedPodInfo,
                     SchedulingQueue)
@@ -132,10 +132,18 @@ class Scheduler:
     def schedule_batch(self, batch: List[QueuedPodInfo]) -> Decision:
         cfg = self.config
         # Pull queued gang-mates so no batch boundary splits a gang (the
-        # step would reject the partial group for missing quorum).
-        for group in {q.pod.spec.pod_group for q in batch
+        # step would reject the partial group for missing quorum). This may
+        # push the batch past max_batch_size — a split gang can never meet
+        # quorum, so the pull wins — but the overflow (bigger pad bucket →
+        # possible recompile + memory spike) should be visible.
+        for group in {gang_key(q.pod) for q in batch
                       if q.pod.spec.pod_group}:
             batch.extend(self.queue.pop_group(group))
+        if len(batch) > cfg.max_batch_size:
+            log.warning(
+                "batch grew to %d pods (> max_batch_size %d) pulling gang "
+                "mates; padding bucket may recompile", len(batch),
+                cfg.max_batch_size)
         batch = sorted(batch, key=lambda q: -q.pod.spec.priority)
         pods = [q.pod for q in batch]
 

@@ -8,19 +8,26 @@ better than reject-and-retry: gang semantics become part of the joint
 assignment itself.
 
 ``gang_assign`` wraps the capacity-aware greedy scan (select.py) in a
-fixed-point loop over *group admission*:
+two-phase loop over *group admission*:
 
-  1. run the greedy assignment with every group admitted;
-  2. any group placing fewer than ``min_count`` members is evicted — all of
-     its tentative placements are revoked at once;
-  3. re-run with the surviving admission set (evicted groups' capacity is
-     released to everyone else) until the admitted set is stable.
+  1. EVICT: run the greedy assignment with every group admitted; while any
+     admitted group places fewer than ``min_count`` members, evict the
+     lowest-priority failing group (largest first-member row; rows are
+     priority-ordered), revoking all of its tentative placements at once,
+     and re-run with the survivors.
+  2. RE-ADMIT (only if anything was evicted): in priority order, tentatively
+     re-admit each evicted group; keep it iff every admitted group then
+     meets quorum. This rescues gangs that missed quorum only because a
+     peer — itself later evicted — was holding the capacity; no single
+     eviction order avoids that case (evict-low-first strands a feasible
+     high-priority gang behind an infeasible low-priority one and vice
+     versa), so the grow-back pass is what makes admission order-robust.
 
-The admitted set only shrinks, so the ``lax.while_loop`` terminates in at
-most G+1 iterations; in the common no-gang case the first recount confirms
-the initial assignment and the loop body never runs (cost ≈ one
-segment-sum over the pod axis on top of plain greedy assignment, which is
-why the pipeline uses gang_assign unconditionally).
+Phase 1 shrinks the admitted set by one group per iteration (≤ G
+iterations); phase 2 is ≤ G more attempts, and both are skipped entirely in
+the common all-fit case (first recount confirms; cost ≈ one segment-sum
+over the pod axis on top of plain greedy assignment, which is why the
+pipeline uses gang_assign unconditionally).
 
 Ungrouped pods (group id -1) are always admitted; their only interaction
 with gangs is through capacity, exactly as in the sequential semantics.
@@ -32,7 +39,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .select import NEG, AssignResult, greedy_assign
+from .select import NEG, greedy_assign
 
 
 class GangResult(NamedTuple):
@@ -55,33 +62,64 @@ def gang_assign(scores: jnp.ndarray, requests: jnp.ndarray,
     group_ids: (P,) i32 gang id in [0,G), -1 for ungrouped pods
     group_min: (G,) i32 quorum per gang (0 for padding rows)
     """
+    P = scores.shape[0]
     G = group_min.shape[0]
     grouped = group_ids >= 0
     gidx = jnp.where(grouped, group_ids, 0)  # safe segment index
+    # Group priority = its best member's row (rows are priority-ordered);
+    # eviction picks the failing group with the LARGEST first row.
+    first_row = jax.ops.segment_min(
+        jnp.where(grouped, jnp.arange(P, dtype=jnp.int32), P), gidx,
+        num_segments=G)
 
-    def run(ok):
+    def attempt(ok):
         pod_ok = jnp.where(grouped, ok[gidx], True)
         res = greedy_assign(jnp.where(pod_ok[:, None], scores, NEG),
                             requests, free0, key)
         placed = (res.assigned & grouped).astype(jnp.int32)
         counts = jax.ops.segment_sum(placed, gidx, num_segments=G)
-        return res, ok & (counts >= group_min)
+        return res, ok & (counts < group_min)  # still-admitted, under quorum
 
     all_ok = jnp.ones((G,), dtype=bool)
-    res0, ok0 = run(all_ok)
+    res0, failing0 = attempt(all_ok)
 
-    def cond(carry):
-        prev_ok, _, new_ok = carry
-        return jnp.any(prev_ok != new_ok)
+    def evict_cond(carry):
+        _, _, failing = carry
+        return jnp.any(failing)
 
-    def body(carry):
-        _, _, ok = carry
-        res, new_ok = run(ok)
-        return ok, res, new_ok
+    def evict_body(carry):
+        ok, _, failing = carry
+        victim = jnp.argmax(jnp.where(failing, first_row, -1))
+        ok = ok.at[victim].set(False)
+        res, still_failing = attempt(ok)
+        return ok, res, still_failing
 
-    # Invariant: carry = (ok, run(ok) result, admission induced by that
-    # result). Exits when the admitted set reproduces itself.
-    ok, res, _ = jax.lax.while_loop(cond, body, (all_ok, res0, ok0))
+    # Phase 1 invariant: carry = (ok, attempt(ok) result, groups of ok
+    # under quorum in that result). Exits when all admitted meet quorum.
+    ok, res, _ = jax.lax.while_loop(
+        evict_cond, evict_body, (all_ok, res0, failing0))
+
+    def readmit(carry):
+        order = jnp.argsort(first_row)  # priority order over groups
+
+        def try_group(i, carry):
+            ok, res = carry
+            g = order[i]
+
+            def admit(carry):
+                ok, res = carry
+                ok2 = ok.at[g].set(True)
+                res2, failing2 = attempt(ok2)
+                good = ~jnp.any(failing2)
+                keep = lambda new, old: jnp.where(good, new, old)
+                return (keep(ok2, ok),
+                        jax.tree_util.tree_map(keep, res2, res))
+
+            return jax.lax.cond(~ok[g], admit, lambda c: c, (ok, res))
+
+        return jax.lax.fori_loop(0, G, try_group, carry)
+
+    ok, res = jax.lax.cond(jnp.any(~ok), readmit, lambda c: c, (ok, res))
 
     gang_rejected = grouped & ~ok[gidx]
     return GangResult(chosen=res.chosen, assigned=res.assigned,

@@ -79,8 +79,7 @@ def feature_shardings(mesh: Mesh, eb_template, nf_template, af_template) -> Tupl
         else _spec_for(mesh, a, NODE_AXIS)
         for name, a in zip(nf_template._fields, nf_template)))
     gang_sh = type(gang)(group=_spec_for(mesh, gang.group, POD_AXIS),
-                         min_count=NamedSharding(mesh, P()),
-                         valid=NamedSharding(mesh, P()))
+                         min_count=NamedSharding(mesh, P()))
     eb_sh = type(eb_template)(pf=pf_sh, gf=_replicated(mesh, gf),
                               naf=_replicated(mesh, naf), gang=gang_sh)
     af_sh = _replicated(mesh, af_template)

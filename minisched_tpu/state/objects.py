@@ -373,3 +373,12 @@ def pod_requests(pod: Pod) -> ResourceList:
     req = dict(pod.spec.requests)
     req.setdefault("pods", 1)
     return req
+
+
+def gang_key(pod: Pod) -> str:
+    """Canonical namespaced gang identity: ``namespace/pod_group`` ("" when
+    ungrouped). Gangs are namespace-scoped like upstream coscheduling's
+    PodGroup — same-named groups in different namespaces are distinct."""
+    if not pod.spec.pod_group:
+        return ""
+    return f"{pod.metadata.namespace}/{pod.spec.pod_group}"

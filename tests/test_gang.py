@@ -110,6 +110,39 @@ def test_no_gangs_is_plain_greedy():
     assert not np.asarray(res.gang_rejected).any()
 
 
+def test_peer_eviction_releases_capacity_to_surviving_gang():
+    # One node, 3 slots. Gang B (rows 0-3, min 4) can't fit; gang A
+    # (rows 4-5, min 2) fits once B is evicted. Evicting one group per
+    # iteration must let A through — simultaneous eviction would reject
+    # both (A only missed quorum because B held the capacity).
+    scores, req, free = _uniform(6, 1, cpu_req=100.0, node_cpu=300.0)
+    res = gang_assign(scores, req, free,
+                      group_ids=jnp.array([0, 0, 0, 0, 1, 1], jnp.int32),
+                      group_min=jnp.array([4, 2], jnp.int32),
+                      key=jax.random.PRNGKey(7))
+    a = np.asarray(res.assigned)
+    assert not a[:4].any()          # B evicted
+    assert a[4:].all()              # A fits in the released capacity
+    assert not bool(res.group_ok[0]) and bool(res.group_ok[1])
+
+
+def test_high_priority_gang_rescued_from_infeasible_peer():
+    # Mirror case: gang A's members straddle rows {0, 3} (min 2); gang C
+    # rows {1, 2} needs min 3 with only 2 members — infeasible. Capacity 3
+    # slots: greedy gives 0→A, 1→C, 2→C, so A places 1 < 2 and C places
+    # 2 < 3 — both fail the first attempt. Evicting the lower-priority C
+    # first must leave A fully placed.
+    scores, req, free = _uniform(4, 1, cpu_req=100.0, node_cpu=300.0)
+    res = gang_assign(scores, req, free,
+                      group_ids=jnp.array([0, 1, 1, 0], jnp.int32),
+                      group_min=jnp.array([2, 3], jnp.int32),
+                      key=jax.random.PRNGKey(8))
+    a = np.asarray(res.assigned)
+    assert a[0] and a[3]            # gang A fully placed
+    assert not a[1] and not a[2]    # infeasible gang C evicted
+    assert bool(res.group_ok[0]) and not bool(res.group_ok[1])
+
+
 def test_eviction_cascade_converges():
     # Fixed-point property under adversarial shapes: final admitted groups
     # meet quorum with the final assignment; evicted groups place nobody.
@@ -195,6 +228,51 @@ def test_replacement_member_of_running_gang_schedules(cluster):
     cluster.delete_pod("r0x")
     cluster.create_pod("r0y", spec=_gang_pod_spec("svc", 3))
     cluster.wait_for_pod_bound("r0y", timeout=10)
+
+
+def test_gangs_are_namespace_scoped(cluster):
+    """Same-named pod_group in different namespaces are distinct gangs
+    (upstream coscheduling's PodGroup is namespace-scoped): a lone member
+    of ns2/job must NOT borrow quorum credit from the running ns1/job."""
+    cluster.start(config=fast_config())
+    cluster.create_node("workerE", cpu=1000)
+    for i in range(3):
+        cluster.create_pod(f"n1p{i}x", namespace="ns1",
+                           spec=_gang_pod_spec("job", 3))
+    for i in range(3):
+        cluster.wait_for_pod_bound(f"n1p{i}x", namespace="ns1", timeout=10)
+    # ns2's lone member: quorum 3, zero ns2 members running → must park.
+    cluster.create_pod("n2p0x", namespace="ns2", spec=_gang_pod_spec("job", 3))
+    pending = cluster.wait_for_pod_pending("n2p0x", namespace="ns2", timeout=5)
+    assert "Coscheduling" in pending.status.unschedulable_plugins
+
+
+def test_node_removal_releases_gang_credit(cluster):
+    """Deleting a node drops its bound pods from the cache, including their
+    gang live-member counts — recreated members must meet full quorum again
+    instead of binding one-by-one against a stale credit."""
+    from minisched_tpu.state.objects import gang_key
+
+    cluster.start(config=fast_config())
+    cluster.create_node("doomed", cpu=1000)
+    for i in range(3):
+        cluster.create_pod(f"d{i}x", spec=_gang_pod_spec("dj", 3))
+    for i in range(3):
+        cluster.wait_for_pod_bound(f"d{i}x", timeout=10)
+    cache = cluster.service.scheduler.cache
+    gk = gang_key(cluster.get_pod("d0x"))
+    assert cache.gang_bound_count(gk) == 3
+    # Node dies; the cache must forget the gang credit with the pods.
+    cluster.store.delete("Node", "doomed")
+    assert wait_until(lambda: cache.gang_bound_count(gk) == 0, timeout=5)
+    # A lone recreated member on a small node must park (full quorum again).
+    cluster.delete_pod("d0x")
+    cluster.delete_pod("d1x")
+    cluster.delete_pod("d2x")
+    cluster.create_node("smallF", cpu=1000)
+    cluster.create_pod("d0y", spec=_gang_pod_spec("dj", 3))
+    pending = cluster.wait_for_pod_pending("d0y", timeout=5)
+    assert "Coscheduling" in pending.status.unschedulable_plugins
 
 
 def test_gang_does_not_starve_ungrouped_pods(cluster):
