@@ -4,6 +4,9 @@
 # is in-process).
 
 PY ?= python
+# JAX_PLATFORMS=cpu: CPU-only runs. tests/conftest.py and the entrypoints
+# additionally deregister ambient TPU-plugin backends under this setting so
+# a wedged tunnel can't hang backend init.
 CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: test start bench dryrun

@@ -20,3 +20,11 @@ matrix, sharded over a node-axis device mesh at scale.
 """
 
 __version__ = "0.1.0"
+
+# Honor an explicit JAX_PLATFORMS=cpu before any submodule can trigger jax
+# backend init (module-level jnp constants do): ambient accelerator plugins
+# are neutered so a wedged remote tunnel can't hang CPU-only runs. No-op on
+# every other JAX_PLATFORMS value.
+from .utils.platform_guard import enforce_cpu_only as _enforce_cpu_only
+
+_enforce_cpu_only()

@@ -52,7 +52,8 @@ class GangResult(NamedTuple):
 
 def gang_assign(scores: jnp.ndarray, requests: jnp.ndarray,
                 free0: jnp.ndarray, group_ids: jnp.ndarray,
-                group_min: jnp.ndarray, key: jax.Array) -> GangResult:
+                group_min: jnp.ndarray, key: jax.Array,
+                greedy_fn=None) -> GangResult:
     """Jointly assign pods to nodes with all-or-nothing group semantics.
 
     scores:    (P,N) f32 with NEG on infeasible pairs (pods pre-sorted by
@@ -61,7 +62,12 @@ def gang_assign(scores: jnp.ndarray, requests: jnp.ndarray,
     free0:     (N,R) f32 free resources entering the batch
     group_ids: (P,) i32 gang id in [0,G), -1 for ungrouped pods
     group_min: (G,) i32 quorum per gang (0 for padding rows)
+    greedy_fn: the inner capacity-aware assignment (default select.
+               greedy_assign; the pipeline swaps in the pallas kernel on
+               TPU — both produce identical results)
     """
+    if greedy_fn is None:
+        greedy_fn = greedy_assign
     P = scores.shape[0]
     G = group_min.shape[0]
     grouped = group_ids >= 0
@@ -74,8 +80,8 @@ def gang_assign(scores: jnp.ndarray, requests: jnp.ndarray,
 
     def attempt(ok):
         pod_ok = jnp.where(grouped, ok[gidx], True)
-        res = greedy_assign(jnp.where(pod_ok[:, None], scores, NEG),
-                            requests, free0, key)
+        res = greedy_fn(jnp.where(pod_ok[:, None], scores, NEG),
+                        requests, free0, key)
         placed = (res.assigned & grouped).astype(jnp.int32)
         counts = jax.ops.segment_sum(placed, gidx, num_segments=G)
         return res, ok & (counts < group_min)  # still-admitted, under quorum

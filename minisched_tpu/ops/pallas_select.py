@@ -1,0 +1,122 @@
+"""Pallas TPU kernel for capacity-aware greedy assignment.
+
+Same semantics as ops.select.greedy_assign's lax.scan — including bitwise-
+identical tie-break noise (select.tie_noise's murmur3 finalizer) — but the
+sequential-by-construction pod loop runs as a pallas grid on the TensorCore
+with the free-capacity matrix resident in VMEM:
+
+  * grid = (P,): TPU grid steps execute sequentially on the core, so VMEM
+    scratch carries the running free matrix across pods (the standard
+    accumulator pattern).
+  * free is stored transposed (R, N): R=8 sublanes x N lanes is a native
+    f32 tile, the per-pod "fits" check is an 8-row AND-reduce onto (1, N),
+    and the capacity update is a lane-masked FMA — no dynamic-lane scatter.
+  * each pod's score row (1, N) streams HBM→VMEM via the pallas pipeline
+    (double-buffered by the runtime); total HBM traffic ≈ the score matrix
+    once (~P·N·4 bytes), vs the scan path re-materializing mask/argmax
+    intermediates through HBM each step.
+
+The scan path (ops/select.py) measures ~285 ms for P=10k, N=50k on one
+v5e core; this kernel replaces it on TPU when shapes are tile-friendly
+(N multiple of 128). CPU tests run it under interpret=True for exact
+equivalence checks against the scan (tests/test_pallas_select.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .select import AssignResult, seed_from_key, tie_noise_from_cols
+
+
+def _kernel(scores_ref, req_ref, free0_ref, seed_ref,
+            chosen_ref, ok_ref, freeout_ref, free_scr):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        free_scr[:] = free0_ref[:]
+
+    neg = jnp.float32(-3.0e38)  # == select.NEG; literal so the kernel
+    free = free_scr[:]                                     # (R, N)
+    req = req_ref[:]                                       # (R, 1)
+    fits = jnp.all(free >= req, axis=0, keepdims=True)     # (1, N)
+    s = jnp.where(fits, scores_ref[:], neg)                # (1, N)
+    m = jnp.max(s)
+    ok = m > neg
+
+    # Tie-break noise: the same definition the scan path uses (2D iota —
+    # TPU has no 1D iota), so both paths pick identical nodes on ties.
+    col = jax.lax.broadcasted_iota(jnp.uint32, s.shape, 1)
+    noise = tie_noise_from_cols(seed_ref[0, 0], i, col)
+
+    tie = (s >= m) & fits
+    idx = jnp.argmax(jnp.where(tie, noise, -1.0)).astype(jnp.int32)
+
+    chosen_ref[0, 0] = jnp.where(ok, idx, -1)
+    ok_ref[0, 0] = ok.astype(jnp.int32)
+
+    # Lane-masked capacity update (no dynamic-lane scatter): subtract req
+    # from exactly the chosen column, or nothing when no node fit.
+    take = ((col == idx.astype(jnp.uint32)) & ok).astype(jnp.float32)
+    free_scr[:] = free - req * take
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _flush():
+        freeout_ref[:] = free_scr[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def greedy_assign_pallas(scores: jnp.ndarray, requests: jnp.ndarray,
+                         free0: jnp.ndarray, key: jax.Array,
+                         *, interpret: bool = False) -> AssignResult:
+    """Drop-in replacement for select.greedy_assign on TPU.
+
+    scores:   (P,N) f32 with NEG on infeasible pairs (priority row order)
+    requests: (P,R) f32 per-pod resource requests
+    free0:    (N,R) f32 free resources entering the batch
+    """
+    P, N = scores.shape
+    R = requests.shape[1]
+    seed = seed_from_key(key).reshape(1, 1)
+    req_t = requests.T          # (R, P): per-pod request as a sublane column
+    free_t = free0.T            # (R, N): resources on sublanes, nodes on lanes
+
+    chosen, ok, free_t_after = pl.pallas_call(
+        _kernel,
+        grid=(P,),
+        in_specs=[
+            pl.BlockSpec((1, N), lambda i: (i, 0)),   # pod's score row
+            pl.BlockSpec((R, 1), lambda i: (0, i)),   # pod's request column
+            pl.BlockSpec((R, N), lambda i: (0, 0)),   # initial free (once)
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),    # tie-break seed
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((R, N), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((P, 1), jnp.int32),
+            jax.ShapeDtypeStruct((P, 1), jnp.int32),
+            jax.ShapeDtypeStruct((R, N), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((R, N), jnp.float32)],
+        interpret=interpret,
+    )(scores, req_t, free_t, seed)
+
+    return AssignResult(chosen=chosen[:, 0],
+                        assigned=ok[:, 0].astype(bool),
+                        free_after=free_t_after.T)
+
+
+def pallas_supported(n_nodes: int, backend: str | None = None) -> bool:
+    """The kernel needs a lane-tiled node axis; used at trace time."""
+    if backend is None:
+        backend = jax.default_backend()
+    return backend == "tpu" and n_nodes % 128 == 0

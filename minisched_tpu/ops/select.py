@@ -9,9 +9,14 @@ fully vectorized N-wide argmax, and the carried free-resource matrix makes
 every pod see all prior in-batch assignments, exactly like the sequential
 scheduler saw all prior binds.
 
-Tie-breaking is seeded jax PRNG noise among max-score nodes — the
-reproducible equivalent of the reference's rand.Intn reservoir tie-break
-(minisched.go:316-322; SURVEY §7 "tie-breaking parity").
+Tie-breaking is seeded noise among max-score nodes — the reproducible
+equivalent of the reference's rand.Intn reservoir tie-break
+(minisched.go:316-322; SURVEY §7 "tie-breaking parity"). The noise is a
+cheap vectorized integer hash (murmur3 finalizer) of (seed, pod row, node
+column) rather than per-step threefry: a counter-based PRNG keyed the same
+way, ~10x cheaper inside the sequential scan where it runs P times, and
+identically computable from the pallas kernel path so both paths pick the
+same nodes.
 """
 from __future__ import annotations
 
@@ -21,6 +26,41 @@ import jax
 import jax.numpy as jnp
 
 NEG = jnp.float32(-3.0e38)  # effectively -inf for masked scores
+
+GOLDEN = 0x9E3779B9
+_COL_MULT = 0x85EBCA77
+
+
+def seed_from_key(key: jax.Array) -> jnp.ndarray:
+    """One u32 tie-break seed per batch from a jax PRNG key."""
+    return jax.random.bits(key, (), jnp.uint32)
+
+
+def fmix32(x: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 finalizer: mixes a u32 lattice into uniform bits."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def tie_noise_from_cols(seed: jnp.ndarray, i: jnp.ndarray,
+                        cols: jnp.ndarray) -> jnp.ndarray:
+    """Counter-based uniform noise in [0,1): fmix32 of (seed + i*golden)
+    + column index. Deterministic in (seed, i, column) — the single
+    definition both the lax.scan path and the pallas kernel use, so the
+    two paths break ties identically. ``cols`` is the u32 column-index
+    array (any shape; the kernel passes a 2D broadcasted_iota since TPU
+    has no 1D iota)."""
+    x = fmix32(cols * jnp.uint32(_COL_MULT) + seed
+               + i.astype(jnp.uint32) * jnp.uint32(GOLDEN))
+    return (x >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+
+def tie_noise(seed: jnp.ndarray, i: jnp.ndarray, n: int) -> jnp.ndarray:
+    return tie_noise_from_cols(seed, i, jnp.arange(n, dtype=jnp.uint32))
 
 
 class AssignResult(NamedTuple):
@@ -38,6 +78,7 @@ def greedy_assign(scores: jnp.ndarray, requests: jnp.ndarray,
     free0:    (N,R) f32 free resources entering the batch
     """
     P, N = scores.shape
+    seed = seed_from_key(key)
 
     def body(free, inp):
         i, req, srow = inp
@@ -45,7 +86,7 @@ def greedy_assign(scores: jnp.ndarray, requests: jnp.ndarray,
         s = jnp.where(fits, srow, NEG)
         m = jnp.max(s)
         ok = m > NEG
-        noise = jax.random.uniform(jax.random.fold_in(key, i), (N,))
+        noise = tie_noise(seed, i, N)
         tie = (s >= m) & fits
         idx = jnp.argmax(jnp.where(tie, noise, -1.0)).astype(jnp.int32)
         safe = jnp.where(ok, idx, 0)

@@ -31,8 +31,9 @@ def build_sharded_step(plugin_set: PluginSet, mesh, eb_template, nf_template,
     key_sh = NamedSharding(mesh, P())  # replicated PRNG key
 
     # Reuse the single-chip traced computation; sharding-annotated jit lets
-    # GSPMD insert the collectives.
-    inner = build_step(plugin_set, explain=explain)
+    # GSPMD insert the collectives. pallas=False: a Mosaic kernel can't be
+    # GSPMD-partitioned, so the sharded path keeps the lax.scan assignment.
+    inner = build_step(plugin_set, explain=explain, pallas=False)
 
     def stepfn(eb, nf, af, key):
         return inner(eb, nf, af, key)

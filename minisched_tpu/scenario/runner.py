@@ -158,5 +158,7 @@ def default_scenario(c: Cluster) -> None:
 
 
 if __name__ == "__main__":
+    # (JAX_PLATFORMS=cpu handling happens at package import —
+    # minisched_tpu/__init__.py.)
     run_scenario(default_scenario)
     print("scenario OK")

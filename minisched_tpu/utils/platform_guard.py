@@ -1,0 +1,47 @@
+"""Make JAX_PLATFORMS=cpu actually mean CPU-only.
+
+The ambient TPU tunnel plugin (when present) wraps jax's backend lookup and
+force-initializes the remote client on ANY backend query — even when the
+caller asked for CPU — which hangs every process if the tunnel is wedged.
+CPU-only entrypoints (tests, `make start`, the multichip dryrun) call
+:func:`enforce_cpu_only` right after deciding they want CPU; it deregisters
+every non-CPU backend factory before one can initialize. No-op when
+JAX_PLATFORMS is anything else or the plugin is absent.
+
+tests/conftest.py inlines the same dance (it must run before this package
+is importable from the test environment).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def enforce_cpu_only() -> bool:
+    """If JAX_PLATFORMS=cpu, strip ambient accelerator plugins so backend
+    init can't touch (or hang on) remote hardware. Returns True if CPU-only
+    mode was enforced. Must run before the first jax backend lookup."""
+    if os.environ.get("JAX_PLATFORMS", "").strip() != "cpu":
+        return False
+    sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+    sys.modules.pop("sitecustomize", None)
+
+    import dataclasses
+
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    def _refuse(name):
+        def factory(*a, **k):
+            raise RuntimeError(
+                f"backend {name!r} disabled (JAX_PLATFORMS=cpu)")
+        return factory
+
+    # Keep registry keys (xb.known_platforms() feeds pallas' lowering
+    # registration); only the factory callable is neutered.
+    for name, reg in list(_xb._backend_factories.items()):
+        if name != "cpu":
+            _xb._backend_factories[name] = dataclasses.replace(
+                reg, factory=_refuse(name), fail_quietly=True)
+    jax.config.update("jax_platforms", "cpu")
+    return True

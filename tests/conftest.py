@@ -23,15 +23,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # The ambient TPU platform plugin may ignore JAX_PLATFORMS and still present
 # the real chip as the default backend (its site hook wraps get_backend and
-# dials the remote client); deregister every non-CPU backend factory before
-# any backend initializes so tests never touch the tunnel.
+# dials the remote client). The shared guard neuters every non-CPU backend
+# factory — keeping the registry keys alive for pallas' platform checks —
+# so tests never touch (or hang on) the tunnel.
 import jax  # noqa: E402
-import jax._src.xla_bridge as _xb  # noqa: E402
 
-for _name in [n for n in _xb._backend_factories if n != "cpu"]:
-    _xb._backend_factories.pop(_name, None)
+from minisched_tpu.utils.platform_guard import enforce_cpu_only  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")  # site hook may have pinned "axon"
+assert enforce_cpu_only()
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 
