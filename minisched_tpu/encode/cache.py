@@ -14,7 +14,11 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..state.objects import Node, Pod, gang_key, pod_requests
+from ..state import objects as obj_mod
+from ..state.objects import (RESOURCE_INDEX, Node, Pod, claim_keys,
+                             gang_key, pod_requests)
+
+_VOL = RESOURCE_INDEX["attachable-volumes"]
 from . import features as F
 from .features import (AssignedPodFeatures, DEFAULT_ENCODING, EncodingConfig,
                        NodeFeatures, TopologyKeyRegistry)
@@ -40,9 +44,12 @@ class NodeFeatureCache:
         self._index: Dict[str, int] = {}  # node name → row
         self._names: List[Optional[str]] = [None] * capacity
         self._free_rows: List[int] = list(range(capacity - 1, -1, -1))
-        # pod key → (node row, requests vector, host ports) for incremental
-        # free-resource accounting; only bound pods appear here.
-        self._bound: Dict[str, Tuple[int, np.ndarray, List[int]]] = {}
+        # pod key → (node row, requests vector, host ports, claim keys) for
+        # incremental free-resource accounting; only bound pods appear here.
+        self._bound: Dict[str, Tuple[int, np.ndarray, List[int], List[str]]] = {}
+        # PVC key → {node row: mount count} (VolumeRestrictions RWO
+        # exclusivity + NodeVolumeLimits attach counts).
+        self._claims: Dict[str, Dict[int, int]] = {}
         # Gang membership of bound pods: group → live count, pod key →
         # group. Feeds quorum accounting (ops/gang.py): a gang's effective
         # min_count is reduced by members already running cluster-wide, the
@@ -90,7 +97,8 @@ class NodeFeatureCache:
             # their pods will be rescheduled by higher layers.
             gone = [k for k, v in self._bound.items() if v[0] == i]
             for k in gone:
-                del self._bound[k]
+                _, _, _, claims = self._bound.pop(k)
+                self._drop_claims(i, claims)
                 a = self._a_row.pop(k, None)
                 if a is not None:
                     self._assigned.valid[a] = False
@@ -110,9 +118,23 @@ class NodeFeatureCache:
                 return
             req = F.resources_vector(pod_requests(pod))
             ports = [p.host_port for p in pod.spec.ports if p.host_port]
-            self._bound[pod.key] = (i, req, ports)
+            claims = claim_keys(pod)
+            if claims:
+                # Attach slots are per-claim-per-node, not per-pod: a claim
+                # already mounted on this node costs no new slot; the slot
+                # frees only when the LAST mounting pod leaves (see
+                # _drop_claims). The stored req's volume component is
+                # zeroed — the claim table owns that axis.
+                newly = sum(1 for ck in claims
+                            if not self._claims.get(ck, {}).get(i))
+                req[_VOL] = 0.0
+                self._feats.free[i, _VOL] -= newly
+            self._bound[pod.key] = (i, req, ports, claims)
             self._feats.free[i] -= req
             self._add_ports(i, ports)
+            for ck in claims:
+                rows = self._claims.setdefault(ck, {})
+                rows[i] = rows.get(i, 0) + 1
             group = gang_key(pod)
             if group:
                 self._key_gang[pod.key] = group
@@ -140,9 +162,11 @@ class NodeFeatureCache:
             entry = self._bound.pop(pod_key, None)
             if entry is None:
                 return
-            i, req, ports = entry
+            i, req, ports, claims = entry
+            released = self._drop_claims(i, claims)
             if self._names[i] is not None:
                 self._feats.free[i] += req
+                self._feats.free[i, _VOL] += released
                 self._remove_ports(i, ports)
             a = self._a_row.pop(pod_key, None)
             if a is not None:
@@ -167,6 +191,42 @@ class NodeFeatureCache:
         cluster-wide."""
         with self._lock:
             return self._gang_bound.get(group, 0)
+
+    def _drop_claims(self, row: int, claims: List[str]) -> int:
+        """Remove one pod's claim mounts from row (caller holds the lock).
+        Returns how many claims became UNMOUNTED on this row — the number
+        of attach slots freed."""
+        released = 0
+        for ck in claims:
+            rows = self._claims.get(ck)
+            if rows is None:
+                continue
+            left = rows.get(row, 0) - 1
+            if left > 0:
+                rows[row] = left
+            else:
+                if rows.pop(row, None) is not None:
+                    released += 1
+            if not rows:
+                del self._claims[ck]
+        return released
+
+    CLAIM_UNUSED = obj_mod.CLAIM_UNUSED
+    CLAIM_MULTI = obj_mod.CLAIM_MULTI
+
+    def claim_node_row(self, claim_key: str) -> int:
+        """Node row a PVC is exclusively mounted on (VolumeRestrictions RWO
+        semantics), CLAIM_UNUSED when nobody mounts it, CLAIM_MULTI when it
+        is mounted on several nodes — both negative values are treated as
+        unrestricted by the filter, but only CLAIM_UNUSED participates in
+        the engine's in-batch RWO arbitration."""
+        with self._lock:
+            rows = self._claims.get(claim_key)
+            if rows is None:
+                return self.CLAIM_UNUSED
+            if len(rows) == 1:
+                return next(iter(rows))
+            return self.CLAIM_MULTI
 
     # ---- snapshot -------------------------------------------------------
 
@@ -274,10 +334,12 @@ class NodeFeatureCache:
     def _recompute_free_row(self, i: int) -> None:
         free = self._feats.allocatable[i].copy()
         ports: List[int] = []
-        for key, (row, req, p) in self._bound.items():
+        for key, (row, req, p, claims) in self._bound.items():
             if row == i:
-                free -= req
+                free -= req  # volume component is 0; claim table owns it
                 ports += p
+        free[_VOL] -= sum(1 for rows in self._claims.values()
+                          if rows.get(i))
         self._feats.free[i] = free
         self._feats.used_ports[i] = 0
         self._add_ports(i, ports)

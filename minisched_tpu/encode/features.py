@@ -74,9 +74,10 @@ class EncodingConfig:
     # topology-aware plugins (PodTopologySpread / InterPodAffinity)
     max_topology_keys: int = 4   # registered topology keys (slot 0=hostname)
     max_spread_constraints: int = 2  # constraints per pod
-    max_pod_affinity_terms: int = 2  # terms per pod per kind (req/pref × aff/anti)
+    max_pod_affinity_terms: int = 2  # terms per pod per kind (req/pref × anti)
     max_term_selector_pairs: int = 4  # match_labels pairs per term selector
     domain_buckets: int = 4096   # hashed domain space for non-hostname keys
+    max_pod_claims: int = 4      # PVC references per pod (volume plugins)
 
 
 # Spread when_unsatisfiable codes.
@@ -211,6 +212,13 @@ class PodFeatures(NamedTuple):
     images: np.ndarray       # (P,IM) i32
     required_node: np.ndarray  # (P,) i32 hash of spec.required_node_name (0=none)
     volumes_ready: np.ndarray  # (P,) bool — all referenced PVCs are bound
+    # claim_rows[c] = node row the pod's c-th claim is currently mounted on
+    # (-1 = unused/unrestricted). VolumeRestrictions' RWO exclusivity.
+    claim_rows: np.ndarray     # (P,CV) i32
+    # VolumeZone: required (topology key slot, domain id) from the pod's
+    # bound PVs' zone labels; -1 = no zone requirement.
+    zone_key: np.ndarray       # (P,) i32
+    zone_dom: np.ndarray       # (P,) i32
     # Topology-aware constraints reference SELECTOR GROUPS (GroupFeatures):
     # pods in a batch share few distinct (topology key, namespace, selector)
     # combinations — one deployment's replicas all carry the same constraint
@@ -344,6 +352,12 @@ def encode_node_into(feats: NodeFeatures, i: int, node: Node,
     feats.valid[i] = True
     feats.unschedulable[i] = node.spec.unschedulable
     feats.allocatable[i] = resources_vector(node.status.allocatable)
+    # Undeclared attach limit → the standard default ceiling, so the
+    # volume axis always has real capacity semantics. An EXPLICIT 0 is
+    # honored (a node that cannot attach volumes at all).
+    if "attachable-volumes" not in node.status.allocatable:
+        feats.allocatable[i, obj.RESOURCE_INDEX["attachable-volumes"]] = \
+            obj.DEFAULT_ATTACHABLE_VOLUMES
     feats.name_suffix[i] = name_suffix_digit(node.metadata.name)
     feats.name_hash[i] = _h(node.metadata.name)
 
@@ -592,7 +606,8 @@ def encode_pods(pods: List[Pod], p_pad: int,
                 registry: Optional[TopologyKeyRegistry] = None,
                 volumes_ready_fn=None,
                 group_pad: Optional[int] = None,
-                gang_bound_fn=None):
+                gang_bound_fn=None,
+                volume_info_fn=None):
     """Encode a batch of pending pods, padded to ``p_pad`` rows.
 
     Returns an EncodedBatch: pod features plus the batch's distinct
@@ -600,6 +615,9 @@ def encode_pods(pods: List[Pod], p_pad: int,
     groups (naf). ``registry`` maps topology keys to stable indices (shared
     with the node cache); ``volumes_ready_fn(pod) -> bool`` reports whether
     the pod's PVCs are bound (VolumeBinding filter input) — default: ready.
+    ``volume_info_fn(pod) -> (claim_rows, zone_key_idx, zone_dom)`` supplies
+    the VolumeRestrictions / VolumeZone inputs (engine resolves them from
+    the store + node cache) — default: unrestricted, no zone requirement.
     """
     if registry is None:
         registry = TopologyKeyRegistry(cfg)
@@ -622,6 +640,9 @@ def encode_pods(pods: List[Pod], p_pad: int,
         images=np.zeros((P, cfg.max_images), dtype=np.int32),
         required_node=np.zeros(P, dtype=np.int32),
         volumes_ready=np.ones(P, dtype=bool),
+        claim_rows=np.full((P, cfg.max_pod_claims), -1, dtype=np.int32),
+        zone_key=np.full(P, -1, dtype=np.int32),
+        zone_dom=np.full(P, -1, dtype=np.int32),
         spread_group=np.full((P, C), -1, dtype=np.int32),
         spread_max_skew=np.ones((P, C), dtype=np.int32),
         spread_mode=np.zeros((P, C), dtype=np.int32),
@@ -668,8 +689,23 @@ def encode_pods(pods: List[Pod], p_pad: int,
 
         if pod.spec.required_node_name:
             f.required_node[i] = _h(pod.spec.required_node_name)
-        if volumes_ready_fn is not None and pod.spec.volumes:
-            f.volumes_ready[i] = bool(volumes_ready_fn(pod))
+        if pod.spec.volumes:
+            if volumes_ready_fn is not None:
+                f.volumes_ready[i] = bool(volumes_ready_fn(pod))
+            if volume_info_fn is not None:
+                claim_rows, zk, zd = volume_info_fn(pod)
+                _fill_slots(f.claim_rows[i], list(claim_rows),
+                            f"pod {pod.key} volume claims", overflow)
+                f.zone_key[i] = zk
+                f.zone_dom[i] = zd
+                # Attach-slot charge = claims that may need a NEW
+                # attachment: pinned claims (row >= 0) cost nothing on
+                # their only feasible node; unused and multi-node shared
+                # claims charge one slot (for multi-node claims that
+                # over-charges nodes already mounting them — the safe
+                # direction; under-charging could over-commit a node).
+                f.requests[i, obj.RESOURCE_INDEX["attachable-volumes"]] = \
+                    sum(1 for r in claim_rows if r < 0)
 
         ns_h = _h(pod.metadata.namespace) if pod.metadata.namespace else 0
         cons = pod.spec.topology_spread_constraints

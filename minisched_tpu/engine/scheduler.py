@@ -35,7 +35,7 @@ from ..ops.pipeline import Decision, build_step
 from ..plugins.base import PluginSet
 from ..state.events import ActionType, ClusterEvent, EventBroadcaster, GVK
 from ..state.informer import InformerFactory
-from ..state.objects import Pod, deepcopy_obj, gang_key
+from ..state.objects import Pod, claim_keys, deepcopy_obj, gang_key
 from . import eventhandlers
 from .queue import (BATCH_CAPACITY, COSCHEDULING, QueuedPodInfo,
                     SchedulingQueue)
@@ -149,11 +149,23 @@ class Scheduler:
 
         # Encode pods FIRST: constraints may register new topology keys,
         # which the node snapshot's domain tables must reflect.
+        # One store pass per pod resolves every volume-derived input
+        # (readiness, claim mount rows, zone requirement); both encode
+        # callbacks share it via a per-batch memo.
+        vol_memo: Dict[str, tuple] = {}
+
+        def vol_state(pod: Pod) -> tuple:
+            st = vol_memo.get(pod.key)
+            if st is None:
+                st = vol_memo[pod.key] = self._volume_state(pod)
+            return st
+
         eb = encode_pods(pods, bucket_for(len(pods), cfg.pod_bucket_min),
                          registry=self.cache.registry,
                          overflow=self.cache.overflow,
-                         volumes_ready_fn=self._volumes_ready,
-                         gang_bound_fn=self.cache.gang_bound_count)
+                         volumes_ready_fn=lambda p: vol_state(p)[0],
+                         gang_bound_fn=self.cache.gang_bound_count,
+                         volume_info_fn=lambda p: vol_state(p)[1:])
         nf, names = self.cache.snapshot()
         af = self.cache.snapshot_assigned()
 
@@ -170,7 +182,47 @@ class Scheduler:
         if self.recorder is not None:
             self.recorder.record_batch(pods, names, decision, self.plugin_set)
 
+        # In-batch RWO arbitration: the filter pins pods to a claim's
+        # existing mount node, but an UNUSED claim shared by several pods
+        # in this batch could be jointly assigned to different nodes. Walk
+        # assignments in priority order; the first pod pins each unused
+        # claim, later pods choosing a different node are revoked and
+        # retried (next cycle sees the pinned claim — sequential RWO
+        # semantics without splitting gangs out of the batch).
+        claim_pin: Dict[str, int] = {}
+        revoked: Set[int] = set()
         for i, qpi in enumerate(batch):
+            if assigned[i]:
+                row = int(chosen[i])
+                for ck in claim_keys(qpi.pod):
+                    if self.cache.claim_node_row(ck) != \
+                            NodeFeatureCache.CLAIM_UNUSED:
+                        continue
+                    pin = claim_pin.get(ck)
+                    if pin is None:
+                        claim_pin[ck] = row
+                    elif pin != row:
+                        revoked.add(i)
+                        break
+        if revoked:
+            # Gang atomicity: revoking one member must revoke its whole
+            # gang — peers binding at sub-quorum is the partial-allocation
+            # deadlock gang scheduling exists to prevent.
+            gangs = {gang_key(batch[i].pod) for i in revoked
+                     if batch[i].pod.spec.pod_group}
+            if gangs:
+                for i, qpi in enumerate(batch):
+                    if assigned[i] and gang_key(qpi.pod) in gangs:
+                        revoked.add(i)
+        for i in revoked:
+            self._handle_failure(
+                batch[i], {BATCH_CAPACITY},
+                "RWO claim pinned by an earlier pod in this batch",
+                retryable=True)
+
+        for i, qpi in enumerate(batch):
+            if i in revoked:
+                continue
             if assigned[i]:
                 node_name = names[int(chosen[i])]
                 self._start_binding_cycle(qpi, node_name)
@@ -205,18 +257,55 @@ class Scheduler:
                     retryable=False)
         return decision
 
-    def _volumes_ready(self, pod: Pod) -> bool:
-        """VolumeBinding input: all PVCs the pod references are Bound."""
-        for vc in pod.spec.volumes:
+    ZONE_KEY = "topology.kubernetes.io/zone"
+    IMPOSSIBLE_DOMAIN = -2  # matches no node (multi-zone PVs, registry full)
+
+    def _volume_state(self, pod: Pod):
+        """Single store pass resolving every volume-derived encode input:
+        (ready, claim_rows, zone_key_idx, zone_dom).
+
+        ready      — all referenced PVCs Bound (VolumeBinding input)
+        claim_rows — per-claim current mount row (VolumeRestrictions RWO)
+        zone       — required zone domain from the bound PVs' zone labels
+                     (VolumeZone). PVs in several DISTINCT zones, or a
+                     zone key that can't be registered (topology-key
+                     registry full), yield IMPOSSIBLE_DOMAIN under the
+                     always-present hostname slot — fail CLOSED: no node
+                     matches, the pod parks under VolumeZone rather than
+                     binding somewhere its volume can't attach."""
+        from ..encode.features import pair_hash
+
+        ready = True
+        claim_rows = []
+        zone_key_idx, zone_dom = -1, -1
+        zones_seen = set()
+        for ck in claim_keys(pod):
+            claim_rows.append(self.cache.claim_node_row(ck))
             try:
-                pvc = self.store.get(
-                    "PersistentVolumeClaim",
-                    f"{pod.metadata.namespace}/{vc.claim_name}")
+                pvc = self.store.get("PersistentVolumeClaim", ck)
             except NotFoundError:
-                return False
+                ready = False
+                continue
             if pvc.phase != "Bound":
-                return False
-        return True
+                ready = False
+            if not pvc.volume_name:
+                continue
+            try:
+                pv = self.store.get("PersistentVolume", pvc.volume_name)
+            except NotFoundError:
+                continue
+            zone = pv.metadata.labels.get(self.ZONE_KEY)
+            if zone and zone not in zones_seen:
+                zones_seen.add(zone)
+                idx = self.cache.registry.index_of(
+                    self.ZONE_KEY, self.cache.overflow)
+                if idx < 0 or len(zones_seen) > 1:
+                    zone_key_idx, zone_dom = 0, self.IMPOSSIBLE_DOMAIN
+                else:
+                    zone_key_idx = idx
+                    zone_dom = (pair_hash(self.ZONE_KEY, zone)
+                                % self.cache.cfg.domain_buckets)
+        return ready, claim_rows, zone_key_idx, zone_dom
 
     # ---- permit + binding cycle ----------------------------------------
 

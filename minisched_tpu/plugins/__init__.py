@@ -13,5 +13,8 @@ from .tainttoleration import TaintToleration  # noqa: F401
 from .nodeports import NodePorts  # noqa: F401
 from .imagelocality import ImageLocality  # noqa: F401
 from .volumebinding import VolumeBinding  # noqa: F401
+from .volumerestrictions import VolumeRestrictions  # noqa: F401
+from .volumezone import VolumeZone  # noqa: F401
+from .nodevolumelimits import NodeVolumeLimits  # noqa: F401
 from .podtopologyspread import PodTopologySpread  # noqa: F401
 from .interpodaffinity import InterPodAffinity  # noqa: F401

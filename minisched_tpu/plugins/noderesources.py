@@ -14,9 +14,15 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..state.events import ActionType, ClusterEvent, GVK
+from ..state.objects import RESOURCE_INDEX
 from .base import BatchedPlugin
 
 _EPS = 1e-9
+
+# Upstream's allocation scorers default to cpu+memory (scoring every axis
+# would let utilization-free axes like max-pods or attach slots skew the
+# mean/stddev); the Fit FILTER still checks every tracked axis.
+DEFAULT_SCORED_RESOURCES = ("cpu", "memory")
 
 
 class NodeResourcesFit(BatchedPlugin):
@@ -37,29 +43,38 @@ class NodeResourcesFit(BatchedPlugin):
 
 
 class _AllocationScorer(BatchedPlugin):
-    """Shared math: per-resource utilization after placing the pod."""
+    """Shared math: per-resource utilization after placing the pod, over a
+    configurable scored-resource set (upstream's `resources` plugin arg;
+    defaults to cpu+memory like upstream)."""
+
+    def __init__(self, resources=DEFAULT_SCORED_RESOURCES):
+        self._resources = tuple(resources)
+        self._axes = [RESOURCE_INDEX[r] for r in self._resources]
+
+    def trace_key(self) -> tuple:
+        return super().trace_key() + (self._resources,)
 
     def events_to_register(self):
         return [ClusterEvent(GVK.POD, ActionType.DELETE),
                 ClusterEvent(GVK.NODE, ActionType.ADD | ActionType.UPDATE)]
 
-    def _utilization(self, pf, nf) -> jnp.ndarray:
-        """(P,N,R) requested fraction of allocatable after hypothetical
-        placement: (allocatable - free + request) / allocatable."""
-        alloc = nf.allocatable[None, :, :]
-        used = alloc - nf.free[None, :, :] + pf.requests[:, None, :]
-        return jnp.where(alloc > 0, used / jnp.maximum(alloc, _EPS), 0.0)
+    def _utilization(self, pf, nf):
+        """(P,N,S) requested fraction of allocatable after hypothetical
+        placement over the scored axes, plus the (1,N,S) presence mask."""
+        alloc = nf.allocatable[None, :, self._axes]
+        used = alloc - nf.free[None, :, self._axes] + pf.requests[:, None, self._axes]
+        util = jnp.where(alloc > 0, used / jnp.maximum(alloc, _EPS), 0.0)
+        return util, alloc > 0
 
 
 class NodeResourcesLeastAllocated(_AllocationScorer):
     """Score 0..100, higher for emptier nodes (upstream leastAllocatedScorer:
-    mean over resources of (capacity - used)/capacity × 100)."""
+    mean over scored resources of (capacity - used)/capacity × 100)."""
 
     name = "NodeResourcesLeastAllocated"
 
     def score(self, pf, nf, ctx) -> jnp.ndarray:
-        util = self._utilization(pf, nf)
-        present = nf.allocatable[None, :, :] > 0
+        util, present = self._utilization(pf, nf)
         frac_free = jnp.where(present, 1.0 - util, 0.0)
         denom = jnp.maximum(present.sum(axis=2), 1)
         return 100.0 * frac_free.sum(axis=2) / denom
@@ -71,8 +86,7 @@ class NodeResourcesMostAllocated(_AllocationScorer):
     name = "NodeResourcesMostAllocated"
 
     def score(self, pf, nf, ctx) -> jnp.ndarray:
-        util = self._utilization(pf, nf)
-        present = nf.allocatable[None, :, :] > 0
+        util, present = self._utilization(pf, nf)
         denom = jnp.maximum(present.sum(axis=2), 1)
         return 100.0 * jnp.where(present, jnp.clip(util, 0.0, 1.0), 0.0).sum(axis=2) / denom
 
@@ -84,8 +98,7 @@ class NodeResourcesBalancedAllocation(_AllocationScorer):
     name = "NodeResourcesBalancedAllocation"
 
     def score(self, pf, nf, ctx) -> jnp.ndarray:
-        util = self._utilization(pf, nf)
-        present = nf.allocatable[None, :, :] > 0
+        util, present = self._utilization(pf, nf)
         count = jnp.maximum(present.sum(axis=2), 1)
         u = jnp.where(present, jnp.clip(util, 0.0, 1.0), 0.0)
         mean = u.sum(axis=2) / count

@@ -1,0 +1,39 @@
+"""NodeVolumeLimits filter.
+
+Batched counterpart of the upstream volume-count limit plugins the
+reference wraps (reference scheduler/plugin/plugins.go:24-70 registry:
+NodeVolumeLimits plus the per-cloud EBS/GCEPD/AzureDisk variants — one
+dense column here): a node can attach only so many volumes; a pod whose
+claims would exceed the remaining headroom is filtered out.
+
+Attachable volumes are a RESOURCE AXIS (state/objects.RESOURCES): nodes get
+``allocatable["attachable-volumes"]`` (default
+objects.DEFAULT_ATTACHABLE_VOLUMES when undeclared), pods implicitly
+request one slot per PVC (objects.pod_requests), and the node cache's free
+matrix tracks headroom incrementally. That design means the capacity-aware
+greedy assignment also respects attach limits WITHIN a batch; this plugin
+contributes the named filter column so rejections are attributed to
+NodeVolumeLimits for requeue gating and explainability.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..state.events import ActionType, ClusterEvent, GVK
+from ..state.objects import RESOURCE_INDEX
+from .base import BatchedPlugin
+
+_VOL = RESOURCE_INDEX["attachable-volumes"]
+
+
+class NodeVolumeLimits(BatchedPlugin):
+    name = "NodeVolumeLimits"
+
+    def events_to_register(self):
+        # Freed attachments (pod delete) or raised limits (node update).
+        return [ClusterEvent(GVK.POD, ActionType.DELETE),
+                ClusterEvent(GVK.NODE,
+                             ActionType.ADD | ActionType.UPDATE_NODE_ALLOCATABLE)]
+
+    def filter(self, pf, nf, ctx) -> jnp.ndarray:
+        return pf.requests[:, _VOL][:, None] <= nf.free[:, _VOL][None, :]

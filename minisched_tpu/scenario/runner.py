@@ -62,14 +62,39 @@ class Cluster:
                     cpu: float = 4000, memory: float = 16 << 30,
                     pods: float = 110, labels: Optional[dict] = None,
                     taints: Optional[list] = None,
-                    accelerator: float = 0) -> obj.Node:
+                    accelerator: float = 0,
+                    attachable_volumes: Optional[float] = None) -> obj.Node:
+        allocatable = {"cpu": cpu, "memory": memory, "pods": pods,
+                       "accelerator": accelerator}
+        if attachable_volumes is not None:  # explicit 0 = no attach slots
+            allocatable["attachable-volumes"] = attachable_volumes
         node = obj.Node(
             metadata=obj.ObjectMeta(name=name, labels=labels or {}),
             spec=obj.NodeSpec(unschedulable=unschedulable, taints=taints or []),
-            status=obj.NodeStatus(allocatable={
-                "cpu": cpu, "memory": memory, "pods": pods,
-                "accelerator": accelerator}))
+            status=obj.NodeStatus(allocatable=allocatable))
         return self.store.create(node)
+
+    def create_pv(self, name: str, *, storage: float = 1 << 30,
+                  storage_class: str = "", zone: Optional[str] = None,
+                  phase: str = "Available",
+                  claim_ref: str = "") -> obj.PersistentVolume:
+        labels = {"topology.kubernetes.io/zone": zone} if zone else {}
+        pv = obj.PersistentVolume(
+            metadata=obj.ObjectMeta(name=name, labels=labels),
+            capacity={"ephemeral-storage": storage},
+            storage_class=storage_class, phase=phase, claim_ref=claim_ref)
+        return self.store.create(pv)
+
+    def create_pvc(self, name: str, namespace: str = "default", *,
+                   storage: float = 1 << 30, storage_class: str = "",
+                   volume_name: str = "",
+                   phase: Optional[str] = None) -> obj.PersistentVolumeClaim:
+        pvc = obj.PersistentVolumeClaim(
+            metadata=obj.ObjectMeta(name=name, namespace=namespace),
+            request={"ephemeral-storage": storage},
+            storage_class=storage_class, volume_name=volume_name,
+            phase=phase or ("Bound" if volume_name else "Pending"))
+        return self.store.create(pvc)
 
     def create_pod(self, name: str, *, namespace: str = "default",
                    cpu: float = 100, memory: float = 0,

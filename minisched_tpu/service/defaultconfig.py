@@ -59,9 +59,12 @@ from ..plugins.interpodaffinity import InterPodAffinity  # noqa: E402
 from ..plugins.nodeaffinity import NodeAffinity  # noqa: E402
 from ..plugins.nodename import NodeName  # noqa: E402
 from ..plugins.nodeports import NodePorts  # noqa: E402
+from ..plugins.nodevolumelimits import NodeVolumeLimits  # noqa: E402
 from ..plugins.podtopologyspread import PodTopologySpread  # noqa: E402
 from ..plugins.tainttoleration import TaintToleration  # noqa: E402
 from ..plugins.volumebinding import VolumeBinding  # noqa: E402
+from ..plugins.volumerestrictions import VolumeRestrictions  # noqa: E402
+from ..plugins.volumezone import VolumeZone  # noqa: E402
 
 register_plugin("NodeName", NodeName)
 register_plugin("NodeAffinity", NodeAffinity)
@@ -69,6 +72,9 @@ register_plugin("TaintToleration", TaintToleration)
 register_plugin("NodePorts", NodePorts)
 register_plugin("ImageLocality", ImageLocality)
 register_plugin("VolumeBinding", VolumeBinding)
+register_plugin("VolumeRestrictions", VolumeRestrictions)
+register_plugin("VolumeZone", VolumeZone)
+register_plugin("NodeVolumeLimits", NodeVolumeLimits)
 register_plugin("PodTopologySpread", PodTopologySpread)
 register_plugin("InterPodAffinity", InterPodAffinity)
 
@@ -78,7 +84,8 @@ def full_scheduler_profile() -> Profile:
     simulator configuration with every *ForSimulator plugin on."""
     return Profile(name="full-scheduler", plugins=[
         "NodeUnschedulable", "NodeName", "NodeAffinity", "TaintToleration",
-        "NodePorts", "VolumeBinding", "NodeResourcesFit",
+        "NodePorts", "VolumeBinding", "VolumeRestrictions", "VolumeZone",
+        "NodeVolumeLimits", "NodeResourcesFit",
         "NodeResourcesLeastAllocated", "NodeResourcesBalancedAllocation",
         "ImageLocality", "PodTopologySpread", "InterPodAffinity",
     ])

@@ -23,9 +23,18 @@ from typing import Any, Dict, List, Optional, Tuple
 
 # Resource dimensions tracked in dense feature vectors, in this order.
 # (cpu millicores, memory bytes, max pods, ephemeral storage bytes,
-#  generic accelerator count — the TPU-world stand-in for nvidia.com/gpu.)
-RESOURCES: Tuple[str, ...] = ("cpu", "memory", "pods", "ephemeral-storage", "accelerator")
+#  generic accelerator count — the TPU-world stand-in for nvidia.com/gpu —
+#  and attachable volume slots.) Volumes-as-a-resource makes the
+#  capacity-aware greedy assignment respect attach limits WITHIN a batch,
+#  not just across batches (SURVEY §7 batch-internal causality).
+RESOURCES: Tuple[str, ...] = ("cpu", "memory", "pods", "ephemeral-storage",
+                              "accelerator", "attachable-volumes")
 RESOURCE_INDEX: Dict[str, int] = {r: i for i, r in enumerate(RESOURCES)}
+
+# Nodes that don't declare allocatable["attachable-volumes"] get this
+# ceiling (the common cloud attach limit upstream's per-driver plugins
+# default to).
+DEFAULT_ATTACHABLE_VOLUMES = 26.0
 
 ResourceList = Dict[str, float]
 
@@ -369,10 +378,35 @@ def to_dict(obj: Any) -> Dict[str, Any]:
 
 
 def pod_requests(pod: Pod) -> ResourceList:
-    """Effective resource requests incl. the implicit one-pod slot."""
+    """Effective resource requests incl. the implicit one-pod slot and the
+    pod's volume-attachment slots."""
     req = dict(pod.spec.requests)
     req.setdefault("pods", 1)
+    if pod.spec.volumes:
+        req.setdefault("attachable-volumes", float(len(claim_keys(pod))))
     return req
+
+
+# Claim mount states (NodeFeatureCache.claim_node_row): a non-negative
+# value is the single node row mounting the claim.
+CLAIM_UNUSED = -1   # nobody mounts the claim
+CLAIM_MULTI = -2    # mounted on several nodes (shared RWX-style use)
+
+
+def claim_keys(pod: Pod) -> List[str]:
+    """Namespaced PVC keys of the pod's volume claims — the single
+    definition every claim-tracking site (cache claim table, engine volume
+    info, RWO arbitration) must share. Deduplicated: a pod mounting the
+    same PVC through several volume entries (the subPath pattern) attaches
+    it once, so it must be tracked/charged once."""
+    seen = set()
+    out = []
+    for v in pod.spec.volumes:
+        ck = f"{pod.metadata.namespace}/{v.claim_name}"
+        if ck not in seen:
+            seen.add(ck)
+            out.append(ck)
+    return out
 
 
 def gang_key(pod: Pod) -> str:

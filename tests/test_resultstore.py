@@ -1,0 +1,130 @@
+"""Explainability result store (reference scheduler/plugin/resultstore/
+store_test.go strategy: table-style record tests + the annotation-flush
+path against an in-memory cluster, with injected update failures for the
+retry/backoff behavior)."""
+import json
+
+import numpy as np
+import pytest
+
+from minisched_tpu.errors import ConflictError
+from minisched_tpu.explain.annotation import (FILTER_RESULT_KEY,
+                                              FINAL_SCORE_RESULT_KEY,
+                                              SCORE_RESULT_KEY)
+from minisched_tpu.explain.resultstore import PASSED, ResultStore
+from minisched_tpu.plugins import (NodeNumber, NodeUnschedulable, PluginSet)
+from minisched_tpu.state import objects as obj
+from minisched_tpu.state.store import ClusterStore
+
+
+class FakeDecision:
+    """Just the explain-mode fields record_batch reads."""
+
+    def __init__(self, filter_masks, raw, norm):
+        self.filter_masks = np.asarray(filter_masks)
+        self.raw_scores = np.asarray(raw)
+        self.norm_scores = np.asarray(norm)
+
+
+def _pod(name, ns="default"):
+    return obj.Pod(metadata=obj.ObjectMeta(name=name, namespace=ns),
+                   spec=obj.PodSpec(requests={"cpu": 100}))
+
+
+def _setup(n_pods=2, flush=True, weights=None):
+    store = ClusterStore()
+    pods = [store.create(_pod(f"p{i}")) for i in range(n_pods)]
+    plugin_set = PluginSet([NodeUnschedulable(), NodeNumber()],
+                           weights or {})
+    rs = ResultStore(store, flush=flush, retry_initial_s=0.001)
+    names = ["nodeA", "nodeB", None]  # padding row must be skipped
+    # F=1 filter, S=1 scorer, P=n_pods, N=3 (last row padding)
+    fm = np.zeros((1, n_pods, 3), dtype=bool)
+    fm[0, :, 0] = True  # nodeA passes, nodeB fails, for every pod
+    raw = np.zeros((1, n_pods, 3), dtype=np.float32)
+    raw[0, :, 0] = 10.0
+    raw[0, :, 1] = 4.0
+    norm = raw * 10.0
+    return store, pods, plugin_set, rs, names, FakeDecision(fm, raw, norm)
+
+
+def test_record_and_flush_writes_all_three_annotations():
+    store, pods, ps, rs, names, dec = _setup()
+    rs.record_batch(pods, names, dec, ps)
+    pod = store.get("Pod", pods[0].key)
+    fr = json.loads(pod.metadata.annotations[FILTER_RESULT_KEY])
+    sr = json.loads(pod.metadata.annotations[SCORE_RESULT_KEY])
+    fs = json.loads(pod.metadata.annotations[FINAL_SCORE_RESULT_KEY])
+    assert fr == {"nodeA": {"NodeUnschedulable": PASSED},
+                  "nodeB": {"NodeUnschedulable":
+                            "node(s) didn't pass the filter"}}
+    assert sr["nodeA"]["NodeNumber"] == 10.0
+    assert sr["nodeB"]["NodeNumber"] == 4.0
+    # finalscore = normalized * weight (default weight 1.0)
+    assert fs["nodeA"]["NodeNumber"] == 100.0
+    # padding node row (None name) never appears
+    assert set(fr) == {"nodeA", "nodeB"}
+    # evicted after successful flush (reference store.go:134,236-238)
+    assert rs.pending_keys() == []
+
+
+def test_weight_applied_to_final_score():
+    store, pods, ps, rs, names, dec = _setup(weights={"NodeNumber": 3.0})
+    rs.record_batch(pods, names, dec, ps)
+    pod = store.get("Pod", pods[0].key)
+    fs = json.loads(pod.metadata.annotations[FINAL_SCORE_RESULT_KEY])
+    assert fs["nodeA"]["NodeNumber"] == 300.0
+
+
+def test_flush_retries_conflicts_then_succeeds():
+    store, pods, ps, rs, names, dec = _setup(flush=False)
+    rs.record_batch(pods, names, dec, ps)
+    assert sorted(rs.pending_keys()) == sorted(p.key for p in pods)
+
+    fails = {"left": 2}
+    real_update = store.update
+
+    def flaky_update(o, **kw):
+        if fails["left"] > 0:
+            fails["left"] -= 1
+            raise ConflictError("injected")
+        return real_update(o, **kw)
+
+    store.update = flaky_update
+    assert rs.flush_pod(pods[0].key)
+    assert fails["left"] == 0
+    pod = store.get("Pod", pods[0].key)
+    assert FILTER_RESULT_KEY in pod.metadata.annotations
+    assert pods[0].key not in rs.pending_keys()
+
+
+def test_flush_gives_up_after_retry_budget_keeps_data():
+    store, pods, ps, rs, names, dec = _setup(flush=False)
+    rs.record_batch(pods, names, dec, ps)
+
+    def always_conflict(o, **kw):
+        raise ConflictError("injected")
+
+    store.update = always_conflict
+    assert not rs.flush_pod(pods[0].key)
+    # data retained for a later flush (reference keeps it on failure)
+    assert pods[0].key in rs.pending_keys()
+
+
+def test_flush_of_deleted_pod_succeeds_and_evicts():
+    store, pods, ps, rs, names, dec = _setup(flush=False)
+    rs.record_batch(pods, names, dec, ps)
+    store.delete("Pod", pods[0].key)
+    assert rs.flush_pod(pods[0].key)
+    assert pods[0].key not in rs.pending_keys()
+
+
+def test_noop_without_explain_outputs():
+    store, pods, ps, rs, names, _ = _setup()
+    empty = FakeDecision(np.zeros((0, 2, 3), bool),
+                         np.zeros((0, 2, 3), np.float32),
+                         np.zeros((0, 2, 3), np.float32))
+    rs.record_batch(pods, names, empty, ps)
+    assert rs.pending_keys() == []
+    pod = store.get("Pod", pods[0].key)
+    assert FILTER_RESULT_KEY not in pod.metadata.annotations

@@ -1,0 +1,138 @@
+"""Service lifecycle, profile/registry config surgery, env config.
+
+Ports the reference's config-layer test strategy: scheduler_test.go's
+Test_convertConfigurationForSimulator table cases map onto Profile
+build/disable/weights/args merging; plugins_test.go's registry tests map
+onto the plugin factory registry; config/config.go's typed env errors map
+onto config_from_env."""
+import pytest
+
+from minisched_tpu.config import EmptyEnvError, SchedulerConfig, config_from_env
+from minisched_tpu.service.defaultconfig import (Profile,
+                                                 default_scheduler_profile,
+                                                 full_scheduler_profile,
+                                                 make_plugin,
+                                                 registered_plugins)
+from minisched_tpu.service.service import SchedulerService
+from minisched_tpu.state.store import ClusterStore
+
+
+# ---- profiles / registry (reference plugins.go:24-70, scheduler.go:97) --
+
+def test_default_profile_matches_reference_live_set():
+    """reference minisched/initialize.go:185-186: NodeUnschedulable filter +
+    NodeNumber score/permit are the hardcoded live plugins."""
+    ps = default_scheduler_profile().build()
+    assert [p.name for p in ps.filter_plugins] == ["NodeUnschedulable"]
+    assert [p.name for p in ps.score_plugins] == ["NodeNumber"]
+    assert [p.name for p in ps.permit_plugins] == ["NodeNumber"]
+
+
+def test_full_profile_builds_every_default_plugin():
+    ps = full_scheduler_profile().build()
+    names = set(ps.names())
+    for expected in ("NodeUnschedulable", "NodeName", "NodeAffinity",
+                     "TaintToleration", "NodePorts", "VolumeBinding",
+                     "VolumeRestrictions", "VolumeZone", "NodeVolumeLimits",
+                     "NodeResourcesFit", "NodeResourcesLeastAllocated",
+                     "NodeResourcesBalancedAllocation", "ImageLocality",
+                     "PodTopologySpread", "InterPodAffinity"):
+        assert expected in names
+
+
+def test_registry_lists_and_rejects_unknown():
+    assert "NodeNumber" in registered_plugins()
+    with pytest.raises(KeyError) as ei:
+        make_plugin("NoSuchPlugin")
+    assert "registered" in str(ei.value)
+
+
+def test_profile_disable_removes_plugin():
+    """reference ConvertForSimulator disables originals via the profile's
+    Disabled list (plugins.go:146-202)."""
+    prof = Profile(plugins=["NodeUnschedulable", "NodeNumber"],
+                   disabled=["NodeNumber"])
+    ps = prof.build()
+    assert ps.names() == ["NodeUnschedulable"]
+    assert ps.score_plugins == []
+
+
+def test_profile_weights_and_args_merge():
+    """reference NewPluginConfig merges user PluginConfig over defaults
+    (plugins.go:77-141)."""
+    prof = Profile(plugins=["NodeUnschedulable", "NodeNumber"],
+                   weights={"NodeNumber": 5.0},
+                   plugin_args={"NodeNumber": {"permit_delay": False}})
+    ps = prof.build()
+    nn = ps.score_plugins[0]
+    assert ps.weight_of(nn) == 5.0
+    # args reached the factory: permit disabled → plugin allows instantly
+    assert nn.permit(None, "node3") == ("allow", 0.0, 0.0)
+
+
+def test_profile_default_weight_used_when_unspecified():
+    ps = Profile(plugins=["NodeNumber"]).build()
+    nn = ps.score_plugins[0]
+    assert ps.weight_of(nn) == nn.default_weight
+
+
+# ---- service lifecycle (reference scheduler/scheduler.go:36-91) ---------
+
+def test_service_start_shutdown_restart():
+    store = ClusterStore()
+    svc = SchedulerService(store)
+    cfg = SchedulerConfig(backoff_initial_s=0.05, backoff_max_s=0.1)
+    prof = Profile(plugins=["NodeUnschedulable"])
+    sched = svc.start_scheduler(prof, cfg)
+    assert svc.scheduler is sched
+    with pytest.raises(RuntimeError):
+        svc.start_scheduler(prof, cfg)  # double-start refused
+    # restart retains profile + config (reference RestartScheduler :40-47)
+    sched2 = svc.restart_scheduler()
+    assert sched2 is not sched
+    assert svc.get_scheduler_profile() is prof
+    assert sched2.config is cfg
+    svc.shutdown_scheduler()
+    assert svc.scheduler is None
+    svc.shutdown_scheduler()  # idempotent
+
+
+def test_service_explain_wires_result_store():
+    store = ClusterStore()
+    svc = SchedulerService(store)
+    svc.start_scheduler(config=SchedulerConfig(explain=True))
+    try:
+        assert svc.result_store is not None
+        assert svc.scheduler.recorder is svc.result_store
+    finally:
+        svc.shutdown_scheduler()
+
+
+# ---- env config (reference config/config.go:14-75) ----------------------
+
+def test_config_from_env_defaults(monkeypatch):
+    for var in ("MINISCHED_MAX_BATCH", "MINISCHED_EXPLAIN", "MINISCHED_SEED",
+                "MINISCHED_BACKOFF_INITIAL", "MINISCHED_BACKOFF_MAX",
+                "MINISCHED_PLATFORM"):
+        monkeypatch.delenv(var, raising=False)
+    cfg = config_from_env()
+    assert cfg.max_batch_size == 1024
+    assert cfg.explain is False
+    assert cfg.backoff_initial_s == 1.0 and cfg.backoff_max_s == 10.0
+
+
+def test_config_from_env_overrides(monkeypatch):
+    monkeypatch.setenv("MINISCHED_MAX_BATCH", "64")
+    monkeypatch.setenv("MINISCHED_EXPLAIN", "1")
+    monkeypatch.setenv("MINISCHED_SEED", "7")
+    cfg = config_from_env()
+    assert cfg.max_batch_size == 64
+    assert cfg.explain is True
+    assert cfg.seed == 7
+
+
+def test_config_from_env_empty_is_typed_error(monkeypatch):
+    """reference config.ErrEmptyEnv (config/config.go:18)."""
+    monkeypatch.setenv("MINISCHED_MAX_BATCH", "")
+    with pytest.raises(EmptyEnvError):
+        config_from_env()

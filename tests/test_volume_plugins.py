@@ -1,0 +1,325 @@
+"""Volume plugin family: VolumeRestrictions, VolumeZone, NodeVolumeLimits
+(the upstream plugins the reference wraps in its simulator registry,
+scheduler/plugin/plugins.go:24-70), plus volumes-as-a-resource batch
+semantics."""
+import pytest
+
+from minisched_tpu.config import SchedulerConfig
+from minisched_tpu.scenario import Cluster
+from minisched_tpu.service.defaultconfig import Profile
+from minisched_tpu.state import objects as obj
+
+
+def fast_config(**kw):
+    kw.setdefault("backoff_initial_s", 0.05)
+    kw.setdefault("backoff_max_s", 0.2)
+    return SchedulerConfig(**kw)
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster()
+    yield c
+    c.shutdown()
+
+
+def _vol_spec(*claims, cpu: float = 100.0):
+    return obj.PodSpec(requests={"cpu": cpu},
+                       volumes=[obj.VolumeClaim(claim_name=c) for c in claims])
+
+
+def test_volume_restrictions_pins_claim_to_its_node(cluster):
+    cluster.start(profile=Profile(plugins=["VolumeRestrictions"]),
+                  with_pv_controller=False)
+    cluster.create_node("vr-node1")
+    cluster.create_pvc("claim-a", phase="Bound")
+    cluster.create_pod("vr-p1", spec=_vol_spec("claim-a"))
+    assert cluster.wait_for_pod_bound("vr-p1", timeout=30).spec.node_name == "vr-node1"
+    # Another node appears; a second pod sharing the RWO claim must land
+    # on vr-node1 regardless.
+    cluster.create_node("vr-node2")
+    cluster.create_pod("vr-p2", spec=_vol_spec("claim-a"))
+    assert cluster.wait_for_pod_bound("vr-p2", timeout=10).spec.node_name == "vr-node1"
+    # An unrelated claim is unrestricted (any node passes).
+    cluster.create_pod("vr-p3", spec=_vol_spec("claim-b"))
+    cluster.wait_for_pod_bound("vr-p3", timeout=10)
+
+
+def test_volume_restrictions_releases_on_pod_delete(cluster):
+    cluster.start(profile=Profile(plugins=["VolumeRestrictions"]),
+                  with_pv_controller=False)
+    cluster.create_node("vrr-node1", pods=1)  # full after the first pod
+    cluster.create_pvc("claim-c", phase="Bound")
+    cluster.create_pod("vrr-p1", spec=_vol_spec("claim-c"))
+    cluster.wait_for_pod_bound("vrr-p1", timeout=30)
+    cluster.create_node("vrr-node2")
+    # Same claim, but its node is full → pinned and unschedulable.
+    cluster.create_pod("vrr-p2", spec=_vol_spec("claim-c"))
+    pending = cluster.wait_for_pod_pending("vrr-p2", timeout=5)
+    assert pending.status.unschedulable_plugins  # recorded an attempt
+    # Deleting the holder frees the claim; the pod-delete event revives.
+    cluster.delete_pod("vrr-p1")
+    cluster.wait_for_pod_bound("vrr-p2", timeout=10)
+
+
+def test_volume_zone_restricts_to_pv_zone(cluster):
+    cluster.start(profile=Profile(plugins=["VolumeZone"]),
+                  with_pv_controller=False)
+    cluster.create_node("z1-node",
+                        labels={"topology.kubernetes.io/zone": "z1"})
+    cluster.create_node("z2-node",
+                        labels={"topology.kubernetes.io/zone": "z2"})
+    cluster.create_pv("pv-z1", zone="z1", phase="Bound",
+                      claim_ref="default/claim-z")
+    cluster.create_pvc("claim-z", volume_name="pv-z1")
+    for i in range(3):  # repeated: tie-break must never pick z2
+        cluster.create_pod(f"vz-p{i}", spec=_vol_spec("claim-z"))
+        bound = cluster.wait_for_pod_bound(f"vz-p{i}", timeout=30)
+        assert bound.spec.node_name == "z1-node"
+    # A pod without volumes is free to go anywhere.
+    cluster.create_pod("vz-free")
+    cluster.wait_for_pod_bound("vz-free", timeout=10)
+
+
+def test_volume_zone_no_matching_zone_parks_pod(cluster):
+    cluster.start(profile=Profile(plugins=["VolumeZone"]),
+                  with_pv_controller=False)
+    cluster.create_node("zx-node",
+                        labels={"topology.kubernetes.io/zone": "z9"})
+    cluster.create_pv("pv-z3", zone="z3", phase="Bound",
+                      claim_ref="default/claim-x")
+    cluster.create_pvc("claim-x", volume_name="pv-z3")
+    cluster.create_pod("vzx-p", spec=_vol_spec("claim-x"))
+    pending = cluster.wait_for_pod_pending("vzx-p", timeout=30)
+    assert "VolumeZone" in pending.status.unschedulable_plugins
+    # The right zone arrives → node-add event revives the pod.
+    cluster.create_node("z3-node",
+                        labels={"topology.kubernetes.io/zone": "z3"})
+    assert cluster.wait_for_pod_bound("vzx-p", timeout=10).spec.node_name == "z3-node"
+
+
+def test_node_volume_limits_filters_and_attributes(cluster):
+    cluster.start(profile=Profile(plugins=["NodeVolumeLimits"]),
+                  with_pv_controller=False)
+    cluster.create_node("nvl-node", attachable_volumes=2)
+    cluster.create_pod("nvl-p1", spec=_vol_spec("c1", "c2"))
+    cluster.wait_for_pod_bound("nvl-p1", timeout=30)
+    # Headroom is 0 now; the next volume-using pod parks with attribution.
+    cluster.create_pod("nvl-p2", spec=_vol_spec("c3"))
+    pending = cluster.wait_for_pod_pending("nvl-p2", timeout=5)
+    assert "NodeVolumeLimits" in pending.status.unschedulable_plugins
+    # Volume-free pods are unaffected.
+    cluster.create_pod("nvl-free")
+    cluster.wait_for_pod_bound("nvl-free", timeout=10)
+    # Freeing attachments (pod delete event) revives the parked pod.
+    cluster.delete_pod("nvl-p1")
+    cluster.wait_for_pod_bound("nvl-p2", timeout=10)
+
+
+def test_shared_unpinned_claim_colocates_within_one_batch(cluster):
+    """Two pods sharing a claim nobody mounts yet, arriving in ONE batch,
+    must still end on the SAME node (the engine defers the follower until
+    the first mount pins the claim — sequential RWO semantics)."""
+    cluster.start(profile=Profile(plugins=["VolumeRestrictions"]),
+                  with_pv_controller=False)
+    cluster.create_node("co-node1")
+    cluster.create_node("co-node2")
+    cluster.create_pvc("claim-shared", phase="Bound")
+    for i in range(3):
+        cluster.create_pod(f"co-p{i}", spec=_vol_spec("claim-shared"))
+    nodes = {cluster.wait_for_pod_bound(f"co-p{i}", timeout=30).spec.node_name
+             for i in range(3)}
+    assert len(nodes) == 1, f"RWO claim split across nodes: {nodes}"
+
+
+def test_explicit_zero_attachable_volumes_honored(cluster):
+    cluster.start(profile=Profile(plugins=["NodeVolumeLimits"]),
+                  with_pv_controller=False)
+    cluster.create_node("zero-node", attachable_volumes=0)
+    cluster.create_pod("za-p1", spec=_vol_spec("c-z"))
+    pending = cluster.wait_for_pod_pending("za-p1", timeout=30)
+    assert "NodeVolumeLimits" in pending.status.unschedulable_plugins
+    # volume-free pods still schedule there
+    cluster.create_pod("za-free")
+    cluster.wait_for_pod_bound("za-free", timeout=10)
+
+
+def test_shared_claim_does_not_double_charge_attach_slot(cluster):
+    """A claim already mounted on a node costs NO new attach slot there:
+    with attachable_volumes=1, a second pod sharing the claim must still
+    fit on the claim's node (it is simultaneously pinned there by
+    VolumeRestrictions — double-charging would wedge it forever)."""
+    cluster.start(profile=Profile(plugins=["VolumeRestrictions",
+                                           "NodeVolumeLimits"]),
+                  with_pv_controller=False)
+    cluster.create_node("dc-node", attachable_volumes=1)
+    cluster.create_pod("dc-p1", spec=_vol_spec("claim-dc"))
+    cluster.wait_for_pod_bound("dc-p1", timeout=30)
+    cluster.create_pod("dc-p2", spec=_vol_spec("claim-dc"))
+    assert cluster.wait_for_pod_bound("dc-p2", timeout=10).spec.node_name == "dc-node"
+    # A pod with a NEW claim needs a new slot → filtered out.
+    cluster.create_pod("dc-p3", spec=_vol_spec("claim-other"))
+    pending = cluster.wait_for_pod_pending("dc-p3", timeout=5)
+    assert "NodeVolumeLimits" in pending.status.unschedulable_plugins
+
+
+def test_multi_zone_pvs_make_pod_unschedulable(cluster):
+    """PVs bound to the pod's claims sitting in DIFFERENT zones: no node
+    can satisfy both — the pod must park under VolumeZone."""
+    cluster.start(profile=Profile(plugins=["VolumeZone"]),
+                  with_pv_controller=False)
+    cluster.create_node("mz-node",
+                        labels={"topology.kubernetes.io/zone": "za"})
+    cluster.create_pv("pv-za", zone="za", phase="Bound",
+                      claim_ref="default/claim-za")
+    cluster.create_pvc("claim-za", volume_name="pv-za")
+    cluster.create_pv("pv-zb", zone="zb", phase="Bound",
+                      claim_ref="default/claim-zb")
+    cluster.create_pvc("claim-zb", volume_name="pv-zb")
+    cluster.create_pod("mz-p", spec=_vol_spec("claim-za", "claim-zb"))
+    pending = cluster.wait_for_pod_pending("mz-p", timeout=30)
+    assert "VolumeZone" in pending.status.unschedulable_plugins
+
+
+def test_cache_claim_states_and_slot_accounting():
+    """Unit: claim_node_row distinguishes unused/pinned/multi, and attach
+    slots follow per-claim-per-node mount transitions."""
+    from minisched_tpu.encode import NodeFeatureCache
+    from minisched_tpu.state.objects import (CLAIM_MULTI, CLAIM_UNUSED,
+                                             RESOURCE_INDEX)
+
+    vol = RESOURCE_INDEX["attachable-volumes"]
+    cache = NodeFeatureCache()
+    n1 = obj.Node(metadata=obj.ObjectMeta(name="n1"),
+                  status=obj.NodeStatus(allocatable={
+                      "cpu": 1000, "attachable-volumes": 5}))
+    n2 = obj.Node(metadata=obj.ObjectMeta(name="n2"),
+                  status=obj.NodeStatus(allocatable={"cpu": 1000}))
+    cache.upsert_node(n1)
+    cache.upsert_node(n2)
+    r1 = cache.row_of("n1")
+
+    def pod_on(name, node, claim):
+        p = obj.Pod(metadata=obj.ObjectMeta(name=name, namespace="default"),
+                    spec=_vol_spec(claim))
+        p.spec.node_name = node
+        return p
+
+    assert cache.claim_node_row("default/ck") == CLAIM_UNUSED
+    cache.account_bind(pod_on("a", "n1", "ck"))
+    assert cache.claim_node_row("default/ck") == r1
+    assert cache._feats.free[r1, vol] == 4.0  # one slot taken
+    # second pod, same claim, same node: no extra slot
+    cache.account_bind(pod_on("b", "n1", "ck"))
+    assert cache._feats.free[r1, vol] == 4.0
+    # third pod mounts it on n2 → multi-node shared state
+    cache.account_bind(pod_on("c", "n2", "ck"))
+    assert cache.claim_node_row("default/ck") == CLAIM_MULTI
+    # unbinding one of two n1 mounts frees nothing; the last frees the slot
+    cache.account_unbind("default/a")
+    assert cache._feats.free[r1, vol] == 4.0
+    cache.account_unbind("default/b")
+    assert cache._feats.free[r1, vol] == 5.0
+    assert cache.claim_node_row("default/ck") == cache.row_of("n2")
+    cache.account_unbind("default/c")
+    assert cache.claim_node_row("default/ck") == CLAIM_UNUSED
+
+
+def test_rwo_revocation_takes_whole_gang(cluster):
+    """If in-batch RWO arbitration revokes a gang member, its whole gang
+    must be revoked — peers binding at sub-quorum would be exactly the
+    partial allocation gang scheduling prevents."""
+    cluster.start(profile=Profile(plugins=["NodeName", "VolumeRestrictions"]),
+                  with_pv_controller=False)
+    cluster.create_node("rg-n1")
+    cluster.create_node("rg-n2")
+    cluster.create_pvc("claim-rg", phase="Bound")
+    # High-priority pod pinned to rg-n1 with the claim; gang members pinned
+    # to rg-n2, one sharing the claim. All arrive in one batch: the member
+    # conflicts (claim pinned to rg-n1), so the WHOLE gang must miss.
+    cluster.create_pod("rg-x", spec=obj.PodSpec(
+        requests={"cpu": 100}, priority=10, required_node_name="rg-n1",
+        volumes=[obj.VolumeClaim(claim_name="claim-rg")]))
+    cluster.create_pod("rg-g1", spec=obj.PodSpec(
+        requests={"cpu": 100}, required_node_name="rg-n2",
+        volumes=[obj.VolumeClaim(claim_name="claim-rg")],
+        pod_group="rgang", pod_group_min=2))
+    cluster.create_pod("rg-g2", spec=obj.PodSpec(
+        requests={"cpu": 100}, required_node_name="rg-n2",
+        pod_group="rgang", pod_group_min=2))
+    cluster.wait_for_pod_bound("rg-x", timeout=30)
+    import time
+    time.sleep(1.0)  # give any (wrong) partial gang bind time to land
+    g1 = cluster.get_pod("rg-g1")
+    g2 = cluster.get_pod("rg-g2")
+    # g1 can never run (claim pinned to rg-n1, pod pinned to rg-n2) — and
+    # g2 must not be running without it.
+    assert not g1.spec.node_name
+    assert not g2.spec.node_name
+
+
+def test_zone_requirement_fails_closed_when_registry_full(cluster):
+    """A zone key that can't be registered (topology-key registry full)
+    must park the pod, not silently drop the zone requirement."""
+    cluster.start(profile=Profile(plugins=["VolumeZone"]),
+                  with_pv_controller=False)
+    sched = cluster.service.scheduler
+    for k in ("k1", "k2", "k3"):  # fill slots 1-3 (slot 0 = hostname)
+        assert sched.cache.registry.index_of(k) > 0
+    cluster.create_node("rf-node",
+                        labels={"topology.kubernetes.io/zone": "zf"})
+    cluster.create_pv("pv-rf", zone="zf", phase="Bound",
+                      claim_ref="default/claim-rf")
+    cluster.create_pvc("claim-rf", volume_name="pv-rf")
+    cluster.create_pod("rf-p", spec=_vol_spec("claim-rf"))
+    pending = cluster.wait_for_pod_pending("rf-p", timeout=30)
+    assert "VolumeZone" in pending.status.unschedulable_plugins
+
+
+def test_duplicate_claim_entries_attach_once():
+    """A pod mounting the same PVC via two volume entries (subPath
+    pattern) charges and releases exactly one attach slot."""
+    from minisched_tpu.encode import NodeFeatureCache
+    from minisched_tpu.state.objects import RESOURCE_INDEX
+
+    vol = RESOURCE_INDEX["attachable-volumes"]
+    cache = NodeFeatureCache()
+    cache.upsert_node(obj.Node(
+        metadata=obj.ObjectMeta(name="dup-n"),
+        status=obj.NodeStatus(allocatable={"cpu": 1000,
+                                           "attachable-volumes": 5})))
+    r = cache.row_of("dup-n")
+    p = obj.Pod(metadata=obj.ObjectMeta(name="dup-p", namespace="default"),
+                spec=obj.PodSpec(requests={"cpu": 100},
+                                 volumes=[obj.VolumeClaim(claim_name="dd"),
+                                          obj.VolumeClaim(claim_name="dd")]))
+    p.spec.node_name = "dup-n"
+    cache.account_bind(p)
+    assert cache._feats.free[r, vol] == 4.0
+    cache.account_unbind("default/dup-p")
+    assert cache._feats.free[r, vol] == 5.0
+
+
+def test_volume_capacity_respected_within_one_batch(cluster):
+    """Volumes are a resource axis, so the capacity-aware greedy assignment
+    must not over-commit attach slots even when all pods arrive in ONE
+    batch (SURVEY §7 batch-internal causality)."""
+    cluster.start(profile=Profile(plugins=["NodeVolumeLimits"]),
+                  with_pv_controller=False)
+    cluster.create_node("batch-node", attachable_volumes=2)
+    for i in range(3):
+        cluster.create_pod(f"bv-p{i}", spec=_vol_spec(f"bc{i}"))
+    bound, parked = [], []
+    import time
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        pods = [cluster.get_pod(f"bv-p{i}") for i in range(3)]
+        bound = [p for p in pods if p.spec.node_name]
+        parked = [p for p in pods
+                  if not p.spec.node_name and p.status.unschedulable_plugins]
+        if len(bound) == 2 and len(parked) == 1:
+            break
+        time.sleep(0.05)
+    assert len(bound) == 2 and len(parked) == 1, (
+        f"bound={[p.metadata.name for p in bound]}, "
+        f"parked={[p.metadata.name for p in parked]}")
