@@ -74,7 +74,7 @@ class EncodingConfig:
     # topology-aware plugins (PodTopologySpread / InterPodAffinity)
     max_topology_keys: int = 4   # registered topology keys (slot 0=hostname)
     max_spread_constraints: int = 2  # constraints per pod
-    max_pod_affinity_terms: int = 2  # terms per pod per kind (req/pref × anti)
+    max_pod_affinity_terms: int = 2  # terms per pod per kind (req/pref × aff/anti)
     max_term_selector_pairs: int = 4  # match_labels pairs per term selector
     domain_buckets: int = 4096   # hashed domain space for non-hostname keys
     max_pod_claims: int = 4      # PVC references per pod (volume plugins)
@@ -694,7 +694,12 @@ def encode_pods(pods: List[Pod], p_pad: int,
                 f.volumes_ready[i] = bool(volumes_ready_fn(pod))
             if volume_info_fn is not None:
                 claim_rows, zk, zd = volume_info_fn(pod)
-                _fill_slots(f.claim_rows[i], list(claim_rows),
+                # On slot overflow, PINNED rows (>= 0) must survive — they
+                # carry RWO placement constraints; unused/multi states are
+                # filter no-ops. Two distinct pinned rows correctly make
+                # the pod unschedulable (claims on different nodes).
+                ordered = sorted(claim_rows, key=lambda r: r < 0)
+                _fill_slots(f.claim_rows[i], ordered,
                             f"pod {pod.key} volume claims", overflow)
                 f.zone_key[i] = zk
                 f.zone_dom[i] = zd

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Set
 
@@ -88,9 +89,25 @@ class Scheduler:
         self._waiting_lock = threading.Lock()
         self._binder = ThreadPoolExecutor(
             max_workers=self.config.bind_workers, thread_name_prefix="binder")
+        # In-batch RWO arbitration only applies when the plugin enforcing
+        # claim exclusivity is part of the profile.
+        self._rwo_enabled = any(p.name == "VolumeRestrictions"
+                                for p in plugin_set.plugins)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.filter_names = [p.name for p in plugin_set.filter_plugins]
+        # Timing/counter metrics (beyond the reference's klog-only
+        # observability, SURVEY §5): cumulative sums + last-batch values,
+        # guarded by a dedicated lock (read from any thread).
+        self._metrics_lock = threading.Lock()
+        self._metrics: Dict[str, float] = {
+            "batches": 0, "pods_seen": 0, "pods_assigned": 0,
+            "pods_failed": 0, "pods_bound": 0, "bind_conflicts": 0,
+            "encode_s_total": 0.0, "step_s_total": 0.0,
+            "commit_s_total": 0.0,
+            "last_batch_size": 0, "last_encode_s": 0.0,
+            "last_step_s": 0.0, "last_commit_s": 0.0,
+        }
 
     # ---- lifecycle ------------------------------------------------------
 
@@ -160,6 +177,7 @@ class Scheduler:
                 st = vol_memo[pod.key] = self._volume_state(pod)
             return st
 
+        t0 = time.perf_counter()
         eb = encode_pods(pods, bucket_for(len(pods), cfg.pod_bucket_min),
                          registry=self.cache.registry,
                          overflow=self.cache.overflow,
@@ -168,6 +186,7 @@ class Scheduler:
                          volume_info_fn=lambda p: vol_state(p)[1:])
         nf, names = self.cache.snapshot()
         af = self.cache.snapshot_assigned()
+        t_encode = time.perf_counter()
 
         self._step_counter += 1
         key = jax.random.fold_in(self._key, self._step_counter)
@@ -178,6 +197,7 @@ class Scheduler:
         gang_rejected = np.asarray(decision.gang_rejected)
         feasible = np.asarray(decision.feasible_counts)
         rejects = np.asarray(decision.reject_counts)
+        t_step = time.perf_counter()
 
         if self.recorder is not None:
             self.recorder.record_batch(pods, names, decision, self.plugin_set)
@@ -189,21 +209,30 @@ class Scheduler:
         # claim, later pods choosing a different node are revoked and
         # retried (next cycle sees the pinned claim — sequential RWO
         # semantics without splitting gangs out of the batch).
-        claim_pin: Dict[str, int] = {}
+        claim_pin: Dict[str, tuple] = {}  # ck → (node row, pinner's gang)
         revoked: Set[int] = set()
-        for i, qpi in enumerate(batch):
-            if assigned[i]:
-                row = int(chosen[i])
-                for ck in claim_keys(qpi.pod):
-                    if self.cache.claim_node_row(ck) != \
-                            NodeFeatureCache.CLAIM_UNUSED:
-                        continue
-                    pin = claim_pin.get(ck)
-                    if pin is None:
-                        claim_pin[ck] = row
-                    elif pin != row:
-                        revoked.add(i)
-                        break
+        parked_gangs: Set[str] = set()  # intra-gang conflicts: unsatisfiable
+        if self._rwo_enabled:
+            for i, qpi in enumerate(batch):
+                if assigned[i]:
+                    row = int(chosen[i])
+                    gk = gang_key(qpi.pod)
+                    for ck in claim_keys(qpi.pod):
+                        if self.cache.claim_node_row(ck) != \
+                                NodeFeatureCache.CLAIM_UNUSED:
+                            continue
+                        pin = claim_pin.get(ck)
+                        if pin is None:
+                            claim_pin[ck] = (row, gk)
+                        elif pin[0] != row:
+                            revoked.add(i)
+                            if gk and gk == pin[1]:
+                                # The conflict is INSIDE one gang: its
+                                # members demand the claim on different
+                                # nodes, so retrying reproduces it forever
+                                # — park the gang instead.
+                                parked_gangs.add(gk)
+                            break
         if revoked:
             # Gang atomicity: revoking one member must revoke its whole
             # gang — peers binding at sub-quorum is the partial-allocation
@@ -215,10 +244,16 @@ class Scheduler:
                     if assigned[i] and gang_key(qpi.pod) in gangs:
                         revoked.add(i)
         for i in revoked:
-            self._handle_failure(
-                batch[i], {BATCH_CAPACITY},
-                "RWO claim pinned by an earlier pod in this batch",
-                retryable=True)
+            if gang_key(batch[i].pod) in parked_gangs:
+                self._handle_failure(
+                    batch[i], {COSCHEDULING},
+                    "gang members demand the same RWO claim on different "
+                    "nodes", retryable=False)
+            else:
+                self._handle_failure(
+                    batch[i], {BATCH_CAPACITY},
+                    "RWO claim pinned by an earlier pod in this batch",
+                    retryable=True)
 
         for i, qpi in enumerate(batch):
             if i in revoked:
@@ -255,7 +290,33 @@ class Scheduler:
                     f"0/{self.cache.node_count()} nodes are available: "
                     f"rejected by {sorted(plugins)}",
                     retryable=False)
+
+        t_commit = time.perf_counter()
+        n_assigned = int(assigned[:len(batch)].sum()) - len(revoked)
+        with self._metrics_lock:
+            m = self._metrics
+            m["batches"] += 1
+            m["pods_seen"] += len(batch)
+            m["pods_assigned"] += n_assigned
+            m["pods_failed"] += len(batch) - n_assigned
+            m["encode_s_total"] += t_encode - t0
+            m["step_s_total"] += t_step - t_encode
+            m["commit_s_total"] += t_commit - t_step
+            m["last_batch_size"] = len(batch)
+            m["last_encode_s"] = t_encode - t0
+            m["last_step_s"] = t_step - t_encode
+            m["last_commit_s"] = t_commit - t_step
         return decision
+
+    def metrics(self) -> Dict[str, float]:
+        """Cumulative and last-batch scheduling metrics plus current queue
+        depths — the timing observability the reference lacks entirely
+        (SURVEY §5: klog lines only)."""
+        with self._metrics_lock:
+            out = dict(self._metrics)
+        out.update({f"queue_{k}": v for k, v in self.queue.stats().items()})
+        out["waiting_pods"] = len(self.waiting_pods)
+        return out
 
     ZONE_KEY = "topology.kubernetes.io/zone"
     IMPOSSIBLE_DOMAIN = -2  # matches no node (multi-zone PVs, registry full)
@@ -367,6 +428,8 @@ class Scheduler:
             bound = self.store.bind_pod(pod.key, node_name)
         except (ConflictError, NotFoundError) as e:
             self._unassume(qpi)
+            with self._metrics_lock:
+                self._metrics["bind_conflicts"] += 1
             try:
                 self.store.get("Pod", pod.key)
             except NotFoundError:
@@ -376,6 +439,8 @@ class Scheduler:
             self.queue.requeue_backoff(qpi)
             return
         self.queue.forget(pod.key)
+        with self._metrics_lock:
+            self._metrics["pods_bound"] += 1
         self.broadcaster.scheduled(bound, node_name)
         log.info("bound %s to %s", pod.key, node_name)
 

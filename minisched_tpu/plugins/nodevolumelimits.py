@@ -36,4 +36,16 @@ class NodeVolumeLimits(BatchedPlugin):
                              ActionType.ADD | ActionType.UPDATE_NODE_ALLOCATABLE)]
 
     def filter(self, pf, nf, ctx) -> jnp.ndarray:
-        return pf.requests[:, _VOL][:, None] <= nf.free[:, _VOL][None, :]
+        # Node-accurate demand: the static request charges unpinned/multi
+        # claims; a PINNED claim (mounted on exactly one node) costs an
+        # extra slot on every node EXCEPT its mount node. Without this,
+        # a profile running NodeVolumeLimits alone could place the pod on
+        # a full node the claim isn't mounted on.
+        N = nf.valid.shape[0]
+        need = jnp.broadcast_to(pf.requests[:, _VOL][:, None],
+                                (pf.valid.shape[0], N))
+        node_idx = jnp.arange(N, dtype=jnp.int32)[None, :]
+        for c in range(pf.claim_rows.shape[1]):
+            row = pf.claim_rows[:, c:c + 1]                  # (P,1)
+            need = need + ((row >= 0) & (row != node_idx))
+        return need <= nf.free[:, _VOL][None, :]

@@ -108,6 +108,29 @@ def test_service_explain_wires_result_store():
         svc.shutdown_scheduler()
 
 
+def test_scheduler_metrics_accumulate():
+    from minisched_tpu.scenario import Cluster
+
+    c = Cluster()
+    try:
+        c.start(config=SchedulerConfig(backoff_initial_s=0.05,
+                                       backoff_max_s=0.1),
+                with_pv_controller=False)
+        c.create_node("m-node")
+        c.create_pod("m-pod")
+        c.wait_for_pod_bound("m-pod", timeout=30)
+        m = c.service.scheduler.metrics()
+        assert m["batches"] >= 1
+        assert m["pods_seen"] >= 1
+        assert m["pods_assigned"] >= 1
+        assert m["pods_bound"] >= 1
+        assert m["last_batch_size"] >= 1
+        assert m["step_s_total"] > 0 and m["encode_s_total"] > 0
+        assert "queue_active" in m and "waiting_pods" in m
+    finally:
+        c.shutdown()
+
+
 # ---- env config (reference config/config.go:14-75) ----------------------
 
 def test_config_from_env_defaults(monkeypatch):
