@@ -110,7 +110,7 @@ def main() -> None:
         t_dev = time.perf_counter()
         assignments = [(pods[i].key, names[int(chosen[i])])
                        for i in range(n_pods) if assigned[i]]
-        scheduled = store.bind_pods(assignments)
+        scheduled = len(store.bind_pods(assignments))
         t_end = time.perf_counter()
 
         times["encode"].append(t_enc - t_start)

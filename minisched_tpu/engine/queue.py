@@ -57,6 +57,13 @@ class QueuedPodInfo:
     # move-request cycle observed when this pod was popped; see
     # SchedulingQueue._move_cycle.
     popped_at_cycle: int = 0
+    # Which sub-queue holds the pod ("active" | "backoff" | "unsched" |
+    # "popped") — lets update/delete be O(1) dict lookups instead of the
+    # linear scans the round-1 design used (quadratic churn at 10k+ pods).
+    where: str = "active"
+    # Lazy-deletion marker: list/heap entries for a deleted pod stay in
+    # place and are skipped at pop/flush time (heap removal is O(n)).
+    gone: bool = False
 
     @property
     def key(self) -> str:
@@ -69,9 +76,14 @@ class SchedulingQueue:
                  flush_interval: float = 0.05):
         self._cond = threading.Condition()
         self._active: List[QueuedPodInfo] = []
+        self._active_live = 0  # entries in _active not marked gone
         self._backoff: List = []  # heap of (ready_time, seq, qpi)
+        self._backoff_live = 0
         self._unschedulable: Dict[str, QueuedPodInfo] = {}
         self._known: Set[str] = set()  # keys present in any queue
+        # key → live QueuedPodInfo for every pod currently held by a
+        # sub-queue (NOT popped/in-flight pods): O(1) update/delete.
+        self._index: Dict[str, QueuedPodInfo] = {}
         self._event_map = dict(cluster_event_map)
         self._backoff_initial = backoff_initial
         self._backoff_max = backoff_max
@@ -97,7 +109,8 @@ class SchedulingQueue:
             if pod.key in self._known or self._closed:
                 return
             self._known.add(pod.key)
-            self._active.append(QueuedPodInfo(pod=pod))
+            qpi = QueuedPodInfo(pod=pod)
+            self._push_active(qpi)
             self._cond.notify_all()
 
     def update(self, old: Pod, new: Pod) -> None:
@@ -107,32 +120,30 @@ class SchedulingQueue:
         active; status-only updates — e.g. the scheduler recording
         unschedulable_plugins — must NOT revive it)."""
         with self._cond:
-            key = new.key
-            for qpi in self._active:
-                if qpi.key == key:
-                    qpi.pod = new
-                    return
-            for _, _, qpi in self._backoff:
-                if qpi.key == key:
-                    qpi.pod = new
-                    return
-            qpi = self._unschedulable.get(key)
-            if qpi is not None:
-                qpi.pod = new
-                if old is None or old.spec != new.spec:
-                    del self._unschedulable[key]
-                    self._active.append(qpi)
-                    self._cond.notify_all()
+            qpi = self._index.get(new.key)
+            if qpi is None:
+                return
+            qpi.pod = new
+            if qpi.where == "unsched" and (old is None or old.spec != new.spec):
+                del self._unschedulable[new.key]
+                self._push_active(qpi)
+                self._cond.notify_all()
 
     def delete(self, pod: Pod) -> None:
         """Pod deleted (reference Delete panics, queue.go:120-127)."""
         with self._cond:
             key = pod.key
             self._known.discard(key)
-            self._active = [q for q in self._active if q.key != key]
-            self._backoff = [e for e in self._backoff if e[2].key != key]
-            heapq.heapify(self._backoff)
-            self._unschedulable.pop(key, None)
+            qpi = self._index.pop(key, None)
+            if qpi is None:
+                return
+            qpi.gone = True  # list/heap entries are skipped lazily
+            if qpi.where == "active":
+                self._active_live -= 1
+            elif qpi.where == "backoff":
+                self._backoff_live -= 1
+            elif qpi.where == "unsched":
+                self._unschedulable.pop(key, None)
 
     def forget(self, key: str) -> None:
         """Pod left the scheduling pipeline for good (bound, or deleted
@@ -145,7 +156,7 @@ class SchedulingQueue:
         """Scheduling attempt failed (reference AddUnschedulable
         queue.go:95-107): record rejecting plugins and park the pod."""
         with self._cond:
-            if qpi.key not in self._known or self._closed:
+            if not self._may_requeue(qpi):
                 return
             qpi.attempts += 1
             qpi.last_failure_at = time.monotonic()
@@ -153,21 +164,21 @@ class SchedulingQueue:
             if qpi.popped_at_cycle < self._move_cycle:
                 # A move request fired during the attempt; retry via backoff
                 # instead of parking (the event can no longer revive us).
-                ready = qpi.last_failure_at + self._backoff_duration(qpi)
-                heapq.heappush(self._backoff, (ready, next(self._seq), qpi))
+                self._push_backoff(qpi)
                 return
+            qpi.where, qpi.gone = "unsched", False
+            self._index[qpi.key] = qpi
             self._unschedulable[qpi.key] = qpi
 
     def requeue_backoff(self, qpi: QueuedPodInfo) -> None:
         """Retryable failure (in-batch capacity loss, bind conflict): back
         off, then automatically return to activeQ via the flusher."""
         with self._cond:
-            if qpi.key not in self._known or self._closed:
+            if not self._may_requeue(qpi):
                 return
             qpi.attempts += 1
             qpi.last_failure_at = time.monotonic()
-            ready = qpi.last_failure_at + self._backoff_duration(qpi)
-            heapq.heappush(self._backoff, (ready, next(self._seq), qpi))
+            self._push_backoff(qpi)
 
     # ---- event-driven requeue ------------------------------------------
 
@@ -182,10 +193,9 @@ class SchedulingQueue:
                     moved.append(key)
                     del self._unschedulable[key]
                     if self._is_backing_off(qpi):
-                        ready = qpi.last_failure_at + self._backoff_duration(qpi)
-                        heapq.heappush(self._backoff, (ready, next(self._seq), qpi))
+                        self._push_backoff(qpi)
                     else:
-                        self._active.append(qpi)
+                        self._push_active(qpi)
             if moved:
                 self._cond.notify_all()
 
@@ -207,7 +217,7 @@ class SchedulingQueue:
         descending priority (stable FIFO within a priority)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
-            while not self._active and not self._closed:
+            while self._active_live == 0 and not self._closed:
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
@@ -217,10 +227,12 @@ class SchedulingQueue:
                     self._cond.wait(1.0)
             if self._closed:
                 return []
-            self._active.sort(key=lambda q: -q.pod.spec.priority)
-            batch, self._active = self._active[:max_n], self._active[max_n:]
+            live = [q for q in self._active if not q.gone]
+            live.sort(key=lambda q: -q.pod.spec.priority)
+            batch, self._active = live[:max_n], live[max_n:]
+            self._active_live = len(self._active)
             for qpi in batch:
-                qpi.popped_at_cycle = self._move_cycle
+                self._mark_popped(qpi)
             return batch
 
     def pop_group(self, group: str) -> List[QueuedPodInfo]:
@@ -233,19 +245,21 @@ class SchedulingQueue:
         event-driven revival. Non-blocking."""
         with self._cond:
             members = [q for q in self._active
-                       if gang_key(q.pod) == group]
+                       if not q.gone and gang_key(q.pod) == group]
             in_backoff = [e for e in self._backoff
-                          if gang_key(e[2].pod) == group]
+                          if not e[2].gone and gang_key(e[2].pod) == group]
             if members:
                 self._active = [q for q in self._active
-                                if gang_key(q.pod) != group]
+                                if q.gone or gang_key(q.pod) != group]
+                self._active_live -= len(members)
             if in_backoff:
                 self._backoff = [e for e in self._backoff
-                                 if gang_key(e[2].pod) != group]
+                                 if e[2].gone or gang_key(e[2].pod) != group]
                 heapq.heapify(self._backoff)
+                self._backoff_live -= len(in_backoff)
                 members.extend(e[2] for e in in_backoff)
             for qpi in members:
-                qpi.popped_at_cycle = self._move_cycle
+                self._mark_popped(qpi)
             return members
 
     # ---- lifecycle / introspection -------------------------------------
@@ -257,7 +271,8 @@ class SchedulingQueue:
 
     def stats(self) -> Dict[str, int]:
         with self._cond:
-            return {"active": len(self._active), "backoff": len(self._backoff),
+            return {"active": self._active_live,
+                    "backoff": self._backoff_live,
                     "unschedulable": len(self._unschedulable)}
 
     def unschedulable_keys(self) -> Set[str]:
@@ -265,6 +280,40 @@ class SchedulingQueue:
             return set(self._unschedulable)
 
     # ---- internals ------------------------------------------------------
+
+    def _may_requeue(self, qpi: QueuedPodInfo) -> bool:
+        """Can an in-flight qpi re-enter the queues? (caller holds the lock)
+        No if the pod left the pipeline (deleted/bound → not in _known) or
+        if the key is now held by a DIFFERENT qpi — the pod was deleted and
+        recreated while this attempt was in flight; indexing the stale qpi
+        would orphan the live one and resurrect a stale spec."""
+        if qpi.key not in self._known or self._closed:
+            return False
+        existing = self._index.get(qpi.key)
+        return existing is None or existing is qpi
+
+    def _push_active(self, qpi: QueuedPodInfo) -> None:
+        """Append to activeQ and index (caller holds the lock)."""
+        qpi.where, qpi.gone = "active", False
+        self._index[qpi.key] = qpi
+        self._active.append(qpi)
+        self._active_live += 1
+
+    def _push_backoff(self, qpi: QueuedPodInfo) -> None:
+        """Push onto the backoff heap and index (caller holds the lock)."""
+        qpi.where, qpi.gone = "backoff", False
+        self._index[qpi.key] = qpi
+        ready = qpi.last_failure_at + self._backoff_duration(qpi)
+        heapq.heappush(self._backoff, (ready, next(self._seq), qpi))
+        self._backoff_live += 1
+
+    def _mark_popped(self, qpi: QueuedPodInfo) -> None:
+        """Pod leaves the queues for a scheduling attempt (caller holds the
+        lock): drop it from the index so updates during the attempt don't
+        touch it (it re-enters via add_unschedulable/requeue_backoff)."""
+        qpi.popped_at_cycle = self._move_cycle
+        qpi.where = "popped"
+        self._index.pop(qpi.key, None)
 
     def _backoff_duration(self, qpi: QueuedPodInfo) -> float:
         """1s initial, ×2 per attempt, 10s cap (reference queue.go:218-235)."""
@@ -290,7 +339,10 @@ class SchedulingQueue:
                 fired = False
                 while self._backoff and self._backoff[0][0] <= now:
                     _, _, qpi = heapq.heappop(self._backoff)
-                    self._active.append(qpi)
+                    if qpi.gone or qpi.where != "backoff":
+                        continue  # lazily-deleted or already moved elsewhere
+                    self._backoff_live -= 1
+                    self._push_active(qpi)
                     fired = True
                 if fired:
                     self._cond.notify_all()

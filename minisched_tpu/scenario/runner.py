@@ -136,6 +136,12 @@ class Cluster:
                 f"pod {namespace}/{name} not bound within {timeout}s "
                 f"(phase={pod.status.phase}, "
                 f"unschedulable_plugins={pod.status.unschedulable_plugins})")
+        # Event recording is asynchronous (state/events.py sink worker);
+        # drain it so scenarios can assert on Scheduled events right after
+        # the bind becomes visible.
+        sched = self.service.scheduler
+        if sched is not None:
+            sched.broadcaster.flush(timeout=2.0)
         return pod
 
     def wait_for_pod_pending(self, name: str, namespace: str = "default",

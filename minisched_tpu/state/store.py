@@ -188,16 +188,19 @@ class ClusterStore:
             updated.status.message = ""
             return self.update(updated)
 
-    def bind_pods(self, assignments) -> int:
+    def bind_pods(self, assignments) -> List[str]:
         """Bulk binding commit: one lock acquisition for a whole batch of
-        (pod_key, node_name) pairs; returns how many bound. Pods already
-        bound/deleted or nodes gone are skipped (callers re-schedule).
+        (pod_key, node_name) pairs; returns the keys of the newly-bound
+        pods (keys, not objects — the live stored objects must not escape
+        the store's copy-on-read isolation). Pods already bound/deleted or
+        nodes gone are skipped (callers diff the returned keys against the
+        request to re-schedule).
         Uses dataclasses.replace instead of deep copies — stored objects are
         replacement-only, so structural sharing with superseded versions is
         safe; watch events carry the same immutable-by-convention snapshots."""
         import dataclasses as _dc
 
-        bound = 0
+        bound: List[str] = []
         with self._cond:
             for pod_key, node_name in assignments:
                 pod = self._objects["Pod"].get(pod_key)
@@ -215,7 +218,7 @@ class ClusterStore:
                 self._objects["Pod"][pod_key] = new
                 self._append(WatchEvent(EventType.MODIFIED, "Pod", new, pod,
                                         self._rv))
-                bound += 1
+                bound.append(pod_key)
         return bound
 
     # ---- Watch ----------------------------------------------------------
