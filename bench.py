@@ -2,40 +2,83 @@
 
 The reference publishes no numbers (BASELINE.md); the anchor is the driver's
 north star: 50k nodes × 10k pods *scored and bound* in < 1 s on one TPU host
-versus > 60 s for the reference's sequential Go loop (BASELINE.json). The
-measured cycle is everything a scheduling batch costs end-to-end:
+versus > 60 s for the reference's sequential Go loop (BASELINE.json).
 
-  encode 10k pods → device transfer → one XLA step (filter masks + scores +
-  normalize + weighted sum + capacity-aware greedy assignment over the full
-  (P × N) matrix) → read back choices → bulk-commit bindings to the store.
+Two measured paths:
+  * raw step — encode 10k pods → one XLA step (filter masks + scores +
+    normalize + weighted sum + capacity-aware greedy assignment over the
+    full (P × N) matrix) → read back choices → bulk-commit bindings.
+  * engine-through — the same pods created in the store and scheduled by
+    the real engine (queue → informers → batched cycle → permit → bulk
+    bind), reported from scheduler.metrics(). This measures the product,
+    not a hand-rolled loop.
+
+Robustness (the round-1 failure mode was a wedged TPU tunnel killing the
+whole benchmark with rc=1 and no data): the top-level process runs the
+actual benchmark in a subprocess with a hard timeout; if the TPU attempt
+fails or hangs, it retries on CPU at reduced shapes. It ALWAYS prints
+exactly one parseable JSON line, including platform/error diagnostics of
+any failed attempt.
 
 Prints ONE json line:
   {"metric": "pods_scheduled_per_sec@50k_nodes", "value": ..., "unit":
    "pods/s", "vs_baseline": <speedup over the 60 s Go-loop anchor>, ...}
 
 Env overrides: MINISCHED_BENCH_NODES, MINISCHED_BENCH_PODS,
-MINISCHED_BENCH_REPEATS.
+MINISCHED_BENCH_REPEATS, MINISCHED_BENCH_TIMEOUT (s, per attempt),
+MINISCHED_BENCH_CPU_NODES, MINISCHED_BENCH_CPU_PODS.
 """
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
-import numpy as np  # noqa: E402
 
+# ---------------------------------------------------------------------------
+# child: the actual benchmark (runs in a subprocess the parent can kill)
+# ---------------------------------------------------------------------------
 
-def pad_to(n: int, multiple: int = 256) -> int:
+def _pad_to(n: int, multiple: int = 256) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
-def main() -> None:
+def run_child() -> None:
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # CPU explicitly pinned: drop the axon site hook, which force-dials
+        # the remote TPU client on ANY backend lookup (and hangs when the
+        # tunnel is wedged) regardless of JAX_PLATFORMS.
+        sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+        sys.modules.pop("sitecustomize", None)
+        import minisched_tpu  # noqa: F401  (platform guard neuters TPU factories)
+
+    import numpy as np
+
     n_nodes = int(os.environ.get("MINISCHED_BENCH_NODES", "50000"))
     n_pods = int(os.environ.get("MINISCHED_BENCH_PODS", "10000"))
     repeats = int(os.environ.get("MINISCHED_BENCH_REPEATS", "3"))
 
-    import jax
+    detail = {"nodes": n_nodes, "pods": n_pods}
+    result = {"metric": f"pods_scheduled_per_sec@{n_nodes // 1000}k_nodes",
+              "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
+              "detail": detail}
+
+    def emit_and_exit(rc: int = 0) -> None:
+        print(json.dumps(result))
+        sys.stdout.flush()
+        os._exit(rc)  # skip atexit: a wedged TPU client must not hang exit
+
+    try:
+        import jax
+
+        detail["platform"] = jax.devices()[0].platform
+        detail["device"] = str(jax.devices()[0])
+    except Exception as e:  # backend init failed → no numbers possible
+        detail["error"] = f"backend init: {type(e).__name__}: {e}"[:500]
+        emit_and_exit(1)
 
     from minisched_tpu.encode import NodeFeatureCache, encode_pods
     from minisched_tpu.ops import build_step
@@ -48,58 +91,61 @@ def main() -> None:
     from minisched_tpu.state.store import ClusterStore
 
     rng = np.random.default_rng(0)
-    t_setup = time.perf_counter()
-
-    # --- cluster state: 50k nodes in the store + feature cache ----------
-    store = ClusterStore(max_log=1000)
-    cache = NodeFeatureCache(capacity=max(64, n_nodes))
     cpu_choices = np.array([4000, 8000, 16000, 32000])
     node_cpus = cpu_choices[rng.integers(0, len(cpu_choices), n_nodes)]
-    for i in range(n_nodes):
-        node = Node(
-            metadata=ObjectMeta(name=f"node-{i}-{i % 10}",
-                                labels={"zone": f"z{i % 16}"}),
-            spec=NodeSpec(unschedulable=bool(i % 97 == 0)),
-            status=NodeStatus(allocatable={
-                "cpu": float(node_cpus[i]), "memory": float(64 << 30),
-                "pods": 110.0}))
-        store.create(node)
-        cache.upsert_node(node)
-
-    # --- 10k pending pods -----------------------------------------------
     pod_cpus = rng.integers(1, 8, n_pods) * 250
-    pods = [Pod(metadata=ObjectMeta(name=f"pod-{i}-{i % 10}",
-                                    namespace="bench"),
-                spec=PodSpec(requests={"cpu": float(pod_cpus[i]),
-                                       "memory": float(2 << 30)}))
-            for i in range(n_pods)]
-    for p in pods:
-        store.create(p)
-    setup_s = time.perf_counter() - t_setup
 
-    # --- compile the dense-matrix profile (BASELINE configs 3/4 shape) --
+    def make_nodes():
+        return [Node(metadata=ObjectMeta(name=f"node-{i}-{i % 10}",
+                                         labels={"zone": f"z{i % 16}"}),
+                     spec=NodeSpec(unschedulable=bool(i % 97 == 0)),
+                     status=NodeStatus(allocatable={
+                         "cpu": float(node_cpus[i]),
+                         "memory": float(64 << 30), "pods": 110.0}))
+                for i in range(n_nodes)]
+
+    def make_pods():
+        return [Pod(metadata=ObjectMeta(name=f"pod-{i}-{i % 10}",
+                                        namespace="bench"),
+                    spec=PodSpec(requests={"cpu": float(pod_cpus[i]),
+                                           "memory": float(2 << 30)}))
+                for i in range(n_pods)]
+
+    plugins = ["NodeUnschedulable", "NodeResourcesFit",
+               "NodeResourcesLeastAllocated",
+               "NodeResourcesBalancedAllocation"]
     plugin_set = PluginSet([NodeUnschedulable(), NodeResourcesFit(),
                             NodeResourcesLeastAllocated(),
                             NodeResourcesBalancedAllocation()])
+    detail["profile"] = plugins
+
+    # ---- raw-step bench ------------------------------------------------
+    t_setup = time.perf_counter()
+    store = ClusterStore(max_log=1000)
+    cache = NodeFeatureCache(capacity=max(64, n_nodes))
+    for node in make_nodes():
+        store.create(node)
+        cache.upsert_node(node)
+    pods = make_pods()
+    for p in pods:
+        store.create(p)
+    detail["setup_s"] = round(time.perf_counter() - t_setup, 2)
+
+    p_pad, n_pad = _pad_to(n_pods), _pad_to(n_nodes)
+    key = jax.random.PRNGKey(0)
     step = build_step(plugin_set, explain=False)
 
-    p_pad, n_pad = pad_to(n_pods), pad_to(n_nodes)
-    key = jax.random.PRNGKey(0)
-
-    t0 = time.perf_counter()
     eb = encode_pods(pods, p_pad, registry=cache.registry)
-    encode_s = time.perf_counter() - t0
     nf, names = cache.snapshot(pad=n_pad)
     af = cache.snapshot_assigned()
 
     t0 = time.perf_counter()
-    decision = step(eb, nf, af, key)
-    jax.block_until_ready(decision.chosen)
-    compile_s = time.perf_counter() - t0
+    d = step(eb, nf, af, key)
+    jax.block_until_ready(d.chosen)
+    detail["compile_s"] = round(time.perf_counter() - t0, 2)
 
-    # --- timed runs: encode → step → readback → bulk bind commit --------
     times = {"encode": [], "device": [], "commit": [], "total": []}
-    runs = []  # (scheduled, total_s) pairs, kept together per repeat
+    runs = []
     for r in range(repeats):
         t_start = time.perf_counter()
         eb = encode_pods(pods, p_pad, registry=cache.registry)
@@ -112,47 +158,213 @@ def main() -> None:
                        for i in range(n_pods) if assigned[i]]
         scheduled = len(store.bind_pods(assignments))
         t_end = time.perf_counter()
-
         times["encode"].append(t_enc - t_start)
         times["device"].append(t_dev - t_enc)
         times["commit"].append(t_end - t_dev)
         times["total"].append(t_end - t_start)
         runs.append((scheduled, t_end - t_start))
-
-        # reset (untimed): return pods to pending so the next repeat's
-        # binds really commit
-        for key_, node_name in assignments:
+        # reset (untimed): return pods to pending for the next repeat
+        for key_, _node in assignments:
             p = store.get("Pod", key_)
             p.spec.node_name = ""
             p.status.phase = "Pending"
             store.update(p)
 
-    # best single run by achieved throughput (numerator and denominator
-    # from the same repeat)
     scheduled, best_total = max(runs, key=lambda x: x[0] / max(x[1], 1e-9))
-    pods_per_sec = scheduled / best_total if best_total > 0 else 0.0
+    raw_pps = scheduled / best_total if best_total > 0 else 0.0
+    detail.update({
+        "scheduled": int(scheduled), "total_s": round(best_total, 4),
+        "encode_s": round(min(times["encode"]), 4),
+        "device_s": round(min(times["device"]), 4),
+        "commit_s": round(min(times["commit"]), 4),
+    })
     # Anchor: the Go loop takes >60 s for this config (BASELINE.json) —
     # i.e. ≤ n_pods/60 pods/s. vs_baseline = speedup over that anchor.
-    baseline_pods_per_sec = n_pods / 60.0
-    result = {
-        "metric": f"pods_scheduled_per_sec@{n_nodes // 1000}k_nodes",
-        "value": round(pods_per_sec, 1),
-        "unit": "pods/s",
-        "vs_baseline": round(pods_per_sec / baseline_pods_per_sec, 2),
-        "detail": {
-            "nodes": n_nodes, "pods": n_pods, "scheduled": int(scheduled),
-            "total_s": round(best_total, 4),
-            "encode_s": round(min(times["encode"]), 4),
-            "device_s": round(min(times["device"]), 4),
-            "commit_s": round(min(times["commit"]), 4),
-            "compile_s": round(compile_s, 2),
-            "setup_s": round(setup_s, 2),
-            "platform": jax.devices()[0].platform,
-            "device": str(jax.devices()[0]),
-        },
-    }
+    result["value"] = round(raw_pps, 1)
+    result["vs_baseline"] = round(raw_pps / (n_pods / 60.0), 2)
+    # Incremental emission: the headline number exists NOW. Print it so a
+    # later phase blowing the attempt timeout doesn't discard it — the
+    # parent parses the LAST valid JSON line of whatever stdout it got.
     print(json.dumps(result))
+    sys.stdout.flush()
+
+    # ---- pallas vs scan: equality + timings (TPU only) -----------------
+    try:
+        from minisched_tpu.ops.pallas_select import pallas_supported
+
+        if pallas_supported(n_pad):
+            d_scan = None
+            for name, flag in (("pallas", True), ("scan", False)):
+                v_step = build_step(plugin_set, explain=False, pallas=flag)
+                dv = v_step(eb, nf, af, key)
+                jax.block_until_ready(dv.chosen)
+                t0 = time.perf_counter()
+                dv = v_step(eb, nf, af, key)
+                jax.block_until_ready(dv.chosen)
+                detail[f"device_s_{name}"] = round(time.perf_counter() - t0, 4)
+                if flag:
+                    d_pallas = dv
+                else:
+                    d_scan = dv
+            eq = (np.array_equal(np.asarray(d_pallas.chosen),
+                                 np.asarray(d_scan.chosen))
+                  and np.array_equal(np.asarray(d_pallas.assigned),
+                                     np.asarray(d_scan.assigned)))
+            detail["pallas_equals_scan"] = bool(eq)
+            if not eq:
+                detail["error"] = "pallas kernel disagrees with lax.scan"
+        else:
+            detail["pallas_equals_scan"] = "skipped (platform/tiling)"
+    except Exception as e:
+        detail["pallas_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(result))
+    sys.stdout.flush()
+
+    # ---- engine-through bench ------------------------------------------
+    try:
+        detail.update(engine_bench(n_nodes, n_pods, make_nodes, make_pods,
+                                   plugins))
+    except Exception as e:
+        detail["engine_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    emit_and_exit(0)
+
+
+def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins) -> dict:
+    """Schedule the same workload through the REAL engine: store + informers
+    + queue + batched cycle + bulk bind; throughput from scheduler.metrics().
+    Two passes — the first eats XLA compiles for the engine's pad buckets,
+    the second (fresh store, warm step cache) is the measurement."""
+    from minisched_tpu.config import SchedulerConfig
+    from minisched_tpu.service.defaultconfig import Profile
+    from minisched_tpu.service.service import SchedulerService
+    from minisched_tpu.state.store import ClusterStore
+
+    profile = Profile(name="bench", plugins=plugins)
+    out = {}
+    for attempt in ("warmup", "measured"):
+        store = ClusterStore(max_log=1000)
+        for node in make_nodes():
+            store.create(node)
+        for pod in make_pods():
+            store.create(pod)
+        svc = SchedulerService(store)
+        t0 = time.perf_counter()
+        sched = svc.start_scheduler(
+            profile, SchedulerConfig(max_batch_size=n_pods))
+        deadline = time.time() + float(
+            os.environ.get("MINISCHED_BENCH_ENGINE_DEADLINE", "240"))
+        bound = 0
+        while time.time() < deadline:
+            m = sched.metrics()
+            bound = int(m["pods_bound"])
+            if bound >= n_pods:
+                break
+            time.sleep(0.02)
+        total_s = time.perf_counter() - t0
+        m = sched.metrics()
+        svc.shutdown_scheduler()
+        if attempt == "warmup" and bound < n_pods:
+            # Warm-up couldn't bind everything inside the deadline; the
+            # measured pass would only repeat that. Report the warm-up
+            # pass (marked) instead of burning a second deadline.
+            return {"engine_bound": bound, "engine_batches": int(m["batches"]),
+                    "engine_total_s": round(total_s, 4),
+                    "engine_note": "warmup pass reported; did not converge"}
+        if attempt == "measured":
+            out = {
+                "engine_bound": bound,
+                "engine_total_s": round(total_s, 4),
+                "engine_pods_per_sec": round(bound / max(total_s, 1e-9), 1),
+                "engine_batches": int(m["batches"]),
+                "engine_encode_s": round(m["encode_s_total"], 4),
+                "engine_step_s": round(m["step_s_total"], 4),
+                "engine_commit_s": round(m["commit_s_total"], 4),
+                "engine_bind_conflicts": int(m["bind_conflicts"]),
+            }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# parent: attempt orchestration with hard timeouts + guaranteed JSON output
+# ---------------------------------------------------------------------------
+
+def _attempt(env: dict, timeout_s: float) -> tuple:
+    """Run the child benchmark; return (parsed_json_or_None, diagnostic)."""
+    def last_json(stdout: str):
+        for line in reversed((stdout or "").strip().splitlines()):
+            try:
+                parsed = json.loads(line)
+                if isinstance(parsed, dict) and "metric" in parsed:
+                    return parsed
+            except json.JSONDecodeError:
+                continue
+        return None
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env, capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired as e:
+        # The child emits incrementally — a timeout that killed a late
+        # phase may still leave a complete headline line in the buffer.
+        stdout = e.stdout.decode() if isinstance(e.stdout, bytes) else e.stdout
+        parsed = last_json(stdout or "")
+        if parsed is not None:
+            parsed.setdefault("detail", {})["truncated"] = (
+                f"attempt killed at {timeout_s:.0f}s; partial phases")
+            return parsed, None
+        return None, f"timed out after {timeout_s:.0f}s"
+    parsed = last_json(proc.stdout)
+    if parsed is not None:
+        return parsed, None
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+    return None, f"rc={proc.returncode}: " + " | ".join(tail)[:800]
+
+
+def main() -> None:
+    timeout_s = float(os.environ.get("MINISCHED_BENCH_TIMEOUT", "900"))
+    attempts = {}
+
+    # Attempt 1: ambient platform (TPU under axon).
+    parsed, diag = _attempt(dict(os.environ), timeout_s)
+    if parsed is not None and "error" not in parsed.get("detail", {}):
+        parsed.setdefault("detail", {})["attempts"] = attempts or None
+        print(json.dumps(parsed))
+        return
+    attempts["ambient"] = (diag or parsed.get("detail", {}).get("error", "?"))
+
+    # Attempt 2: CPU fallback at reduced shapes (the error's own remedy is
+    # JAX_PLATFORMS=''; pinning cpu also drops a wedged TPU plugin). Shapes
+    # shrink because the sequential-scan assignment is slow off-TPU.
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MINISCHED_BENCH_NODES"] = os.environ.get(
+        "MINISCHED_BENCH_CPU_NODES", "2000")
+    env["MINISCHED_BENCH_PODS"] = os.environ.get(
+        "MINISCHED_BENCH_CPU_PODS", "1000")
+    # Drop the axon site hook (it force-dials the TPU client on any backend
+    # lookup, wedging even CPU-only runs).
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p)
+    parsed, diag = _attempt(env, timeout_s)
+    if parsed is not None:
+        parsed.setdefault("detail", {})["attempts"] = attempts
+        print(json.dumps(parsed))
+        return
+    attempts["cpu-fallback"] = diag
+
+    # Both attempts dead: still emit one parseable line with diagnostics.
+    print(json.dumps({
+        "metric": "pods_scheduled_per_sec@50k_nodes", "value": 0.0,
+        "unit": "pods/s", "vs_baseline": 0.0,
+        "detail": {"error": "all attempts failed", "attempts": attempts},
+    }))
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        run_child()
+    else:
+        main()

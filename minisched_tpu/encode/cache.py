@@ -109,11 +109,14 @@ class NodeFeatureCache:
 
     # ---- pod accounting -------------------------------------------------
 
-    def account_bind(self, pod: Pod) -> None:
+    def account_bind(self, pod: Pod, node_name: str = "") -> None:
         """Pod became bound: subtract its requests from the node's free row
-        and add it to the assigned-pod corpus."""
+        and add it to the assigned-pod corpus. ``node_name`` overrides
+        ``pod.spec.node_name`` for the assume path, where the engine
+        accounts a still-pending pod onto its selected node without
+        mutating (or copying) the queued object."""
         with self._lock:
-            i = self._index.get(pod.spec.node_name)
+            i = self._index.get(node_name or pod.spec.node_name)
             if i is None or pod.key in self._bound:
                 return
             req = F.resources_vector(pod_requests(pod))

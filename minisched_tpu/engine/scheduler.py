@@ -36,7 +36,7 @@ from ..ops.pipeline import Decision, build_step
 from ..plugins.base import PluginSet
 from ..state.events import ActionType, ClusterEvent, EventBroadcaster, GVK
 from ..state.informer import InformerFactory
-from ..state.objects import Pod, claim_keys, deepcopy_obj, gang_key
+from ..state.objects import Pod, claim_keys, gang_key
 from . import eventhandlers
 from .queue import (BATCH_CAPACITY, COSCHEDULING, QueuedPodInfo,
                     SchedulingQueue)
@@ -129,13 +129,13 @@ class Scheduler:
             self._thread = None
         self.informer_factory.shutdown()
         self._binder.shutdown(wait=False)
-        # Flush (don't close) the broadcaster: binder tasks queued before
-        # shutdown may still run and record events after this returns — a
-        # closed sink would drop them. The sink worker is a daemon thread
-        # blocked on an empty queue; it costs nothing and dies with the
-        # process (the reference likewise never stops its broadcaster
-        # before process exit, scheduler/scheduler.go:55-59).
+        # Drain recorded events, then stop the sink worker so it releases
+        # its store reference (a service that restarts schedulers must not
+        # accumulate parked threads pinning old stores). Binder tasks still
+        # running after this record into a closed sink and are dropped —
+        # events are best-effort, like upstream's broadcaster at shutdown.
         self.broadcaster.flush(timeout=2.0)
+        self.broadcaster.close()
 
     def run(self) -> None:
         """The scheduling loop (reference minisched.go:28-30
@@ -397,9 +397,7 @@ class Scheduler:
         pod = qpi.pod
         # Assume the pod onto the node immediately so the next batch's
         # snapshot sees the capacity taken (upstream assume/forget model).
-        assumed = deepcopy_obj(pod)
-        assumed.spec.node_name = node_name
-        self.cache.account_bind(assumed)
+        self.cache.account_bind(pod, node_name=node_name)
 
         waits = []
         for plugin in self.plugin_set.permit_plugins:

@@ -136,12 +136,17 @@ class Cluster:
                 f"pod {namespace}/{name} not bound within {timeout}s "
                 f"(phase={pod.status.phase}, "
                 f"unschedulable_plugins={pod.status.unschedulable_plugins})")
-        # Event recording is asynchronous (state/events.py sink worker);
-        # drain it so scenarios can assert on Scheduled events right after
-        # the bind becomes visible.
+        # Event recording is asynchronous (state/events.py sink worker) and
+        # the bind commit becomes visible BEFORE the binder enqueues the
+        # Scheduled event — so wait for the pod's own event, not just a
+        # queue drain, before scenarios assert on store Events.
         sched = self.service.scheduler
         if sched is not None:
-            sched.broadcaster.flush(timeout=2.0)
+            involved = f"Pod:{pod.key}"
+            wait_until(
+                lambda: any(e.reason == "Scheduled"
+                            and e.involved_object == involved
+                            for e in self.store.list("Event")), timeout=2.0)
         return pod
 
     def wait_for_pod_pending(self, name: str, namespace: str = "default",

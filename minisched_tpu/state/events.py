@@ -103,12 +103,15 @@ class EventBroadcaster:
         self._store = store
         self._source = source
         self._q: "_queue.Queue" = _queue.Queue(maxsize=max_queue)
+        self._closed = False
         self._worker = _threading.Thread(target=self._sink_loop, daemon=True,
                                          name="event-broadcaster")
         self._worker.start()
 
     def record(self, *, involved: str, reason: str, message: str,
                type_: str = "Normal", namespace: str = "default") -> None:
+        if self._closed:
+            return  # shutdown already drained; late events are best-effort
         try:
             self._q.put_nowait((involved, reason, message, type_, namespace))
         except Exception:  # queue full: events are best-effort, like upstream
@@ -154,6 +157,10 @@ class EventBroadcaster:
         return True
 
     def close(self) -> None:
+        """Stop the sink worker (releases its store reference). Events
+        recorded after close are dropped — best-effort semantics, same as
+        a full queue."""
+        self._closed = True
         self._q.put(self._SENTINEL)
 
     def scheduled(self, pod: obj.Pod, node_name: str) -> None:
