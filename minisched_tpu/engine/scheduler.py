@@ -129,6 +129,13 @@ class Scheduler:
             self._thread = None
         self.informer_factory.shutdown()
         self._binder.shutdown(wait=False)
+        if self.recorder is not None:
+            # Budget past one flush's full retry backoff (~6 s at defaults)
+            # so a mid-retry flush isn't abandoned silently.
+            if not self.recorder.drain(timeout=8.0):
+                log.warning("unflushed scheduling results at shutdown: %s",
+                            self.recorder.pending_keys()[:10])
+            self.recorder.close()
         # Drain recorded events, then stop the sink worker so it releases
         # its store reference (a service that restarts schedulers must not
         # accumulate parked threads pinning old stores). Binder tasks still

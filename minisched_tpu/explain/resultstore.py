@@ -8,16 +8,27 @@ reference annotation/annotation.go:5-9), retried with exponential backoff
 (reference store.go:120-131 → util/retry.go:18), then evicted from memory
 (store.go:134,236-238).
 
-In the batched world this is nearly free (SURVEY §7 step 6): the per-plugin
-(P × N) mask/score matrices already exist as the explain-mode outputs of the
-XLA step; recording slices rows out of them.
+Hot-path cost: ``record_batch`` only stores references to the step's
+explain-mode output arrays plus a per-pod top-k column selection — O(P·k)
+— and defers all JSON/dict building to flush time. Flushing itself runs
+either synchronously (``flush=True``, the test/table mode), on a background
+worker (``async_flush=True``, the engine mode — the analog of the
+reference flushing on informer events off the scheduling thread,
+store.go:60-68), or manually (``flush_pod``).
+
+Bounding: at ``top_k`` (default 128) the per-pod annotation records only
+the k best nodes by weighted normalized score (all nodes when N ≤ k) —
+an unbounded record at 50k nodes would be a multi-megabyte annotation per
+pod and O(P×N) host work per batch.
 """
 from __future__ import annotations
 
 import json
 import logging
+import queue as queue_mod
 import threading
-from typing import Dict, List, Optional
+import time
+from typing import Dict, List, NamedTuple, Optional
 
 import numpy as np
 
@@ -27,22 +38,45 @@ from ..utils.retry import retry_with_exponential_backoff
 log = logging.getLogger(__name__)
 
 PASSED = "passed"
+FAILED = "node(s) didn't pass the filter"
+
+
+class _BatchRecord(NamedTuple):
+    """One step's explain output, shared by every pod row in the batch."""
+
+    node_names: List[str]          # per recorded column
+    node_cols: np.ndarray          # (K,) column indices into the matrices
+    per_pod_cols: Optional[np.ndarray]  # (P,K) per-pod top-k, or None = shared
+    fnames: List[str]
+    snames: List[str]
+    weights: List[float]
+    filter_masks: np.ndarray       # (F,P,N) bool
+    raw: np.ndarray                # (S,P,N) f32
+    norm: np.ndarray               # (S,P,N) f32
 
 
 class ResultStore:
     """Records batched-step results and flushes them as pod annotations."""
 
     def __init__(self, store, *, flush: bool = True,
+                 async_flush: bool = False, top_k: int = 128,
                  retry_initial_s: float = 0.05, retry_steps: int = 6):
         self._cluster = store
         self._flush = flush
+        self._top_k = top_k
         self._lock = threading.Lock()
-        # pod key → {"filter": {node: {plugin: str}},
-        #            "score": {node: {plugin: float}},
-        #            "finalscore": {node: {plugin: float}}}
-        self._results: Dict[str, Dict[str, Dict[str, Dict[str, object]]]] = {}
+        # pod key → (batch record, pod row)
+        self._results: Dict[str, tuple] = {}
         self._retry_initial = retry_initial_s
         self._retry_steps = retry_steps
+        self._worker: Optional[threading.Thread] = None
+        self._q: Optional[queue_mod.Queue] = None
+        if async_flush:
+            self._q = queue_mod.Queue()
+            self._worker = threading.Thread(target=self._flush_loop,
+                                            daemon=True,
+                                            name="resultstore-flusher")
+            self._worker.start()
 
     # ---- recording (called by the engine after each step) ---------------
 
@@ -55,36 +89,82 @@ class ResultStore:
         fnames = [p.name for p in plugin_set.filter_plugins]
         snames = [p.name for p in plugin_set.score_plugins]
         weights = [plugin_set.weight_of(p) for p in plugin_set.score_plugins]
-        node_idx = [(j, n) for j, n in enumerate(names) if n is not None]
 
+        valid_cols = np.array([j for j, n in enumerate(names)
+                               if n is not None], dtype=np.int64)
+        per_pod_cols = None
+        if len(valid_cols) > self._top_k:
+            # Rank nodes per pod the way the scheduler ranked them: all
+            # FEASIBLE nodes (by weighted normalized score) strictly above
+            # infeasible ones — so the chosen node always makes the cut —
+            # with infeasible nodes (ranked by score) filling any leftover
+            # slots, preserving "didn't pass the filter" examples for pods
+            # with few feasible nodes.
+            if norm.shape[0]:
+                w = np.asarray(weights, dtype=np.float64)
+                total = np.einsum("spn,s->pn", norm.astype(np.float64),
+                                  w)[:, valid_cols]
+            else:  # filter-only profile: all-zero scores
+                total = np.zeros((filter_masks.shape[1], len(valid_cols)))
+            if filter_masks.shape[0]:
+                feasible = filter_masks.all(axis=0)[:, valid_cols]
+                total = total + feasible.astype(np.float64) * 1e12
+            kth = self._top_k
+            part = np.argpartition(-total, kth - 1, axis=1)[:, :kth]
+            per_pod_cols = valid_cols[part]                # (P,K)
+
+        batch = _BatchRecord(
+            node_names=[names[j] for j in valid_cols]
+            if per_pod_cols is None else list(names),
+            node_cols=valid_cols, per_pod_cols=per_pod_cols,
+            fnames=fnames, snames=snames, weights=weights,
+            filter_masks=filter_masks, raw=raw, norm=norm)
+
+        keys = []
         with self._lock:
             for i, pod in enumerate(pods):
-                fr = {n: {fnames[f]: (PASSED if filter_masks[f, i, j]
-                                      else "node(s) didn't pass the filter")
-                          for f in range(len(fnames))}
-                      for j, n in node_idx}
-                sr = {n: {snames[s]: float(raw[s, i, j])
-                          for s in range(len(snames))}
-                      for j, n in node_idx}
-                fs = {n: {snames[s]: float(norm[s, i, j] * weights[s])
-                          for s in range(len(snames))}
-                      for j, n in node_idx}
-                self._results[pod.key] = {"filter": fr, "score": sr,
-                                          "finalscore": fs}
-        if self._flush:
-            for pod in pods:
-                self.flush_pod(pod.key)
+                self._results[pod.key] = (batch, i)
+                keys.append(pod.key)
+        if self._q is not None:
+            for k in keys:
+                self._q.put(k)
+        elif self._flush:
+            for k in keys:
+                self.flush_pod(k)
 
     # ---- flushing (reference addSchedulingResultToPod store.go:90-135) --
+
+    def _build(self, batch: _BatchRecord, i: int) -> Dict[str, dict]:
+        """Materialize one pod's three annotation dicts (flush-time only)."""
+        if batch.per_pod_cols is None:
+            cols = batch.node_cols
+            names = batch.node_names
+        else:
+            cols = batch.per_pod_cols[i]
+            names = [batch.node_names[j] for j in cols]
+        fm, raw, norm = batch.filter_masks, batch.raw, batch.norm
+        fr = {n: {batch.fnames[f]: (PASSED if fm[f, i, j] else FAILED)
+                  for f in range(len(batch.fnames))}
+              for n, j in zip(names, cols)}
+        sr = {n: {batch.snames[s]: float(raw[s, i, j])
+                  for s in range(len(batch.snames))}
+              for n, j in zip(names, cols)}
+        fs = {n: {batch.snames[s]: float(norm[s, i, j] * batch.weights[s])
+                  for s in range(len(batch.snames))}
+              for n, j in zip(names, cols)}
+        return {"filter": fr, "score": sr, "finalscore": fs}
 
     def flush_pod(self, key: str) -> bool:
         from .annotation import (FILTER_RESULT_KEY, FINAL_SCORE_RESULT_KEY,
                                  SCORE_RESULT_KEY)
 
         with self._lock:
-            data = self._results.get(key)
-        if data is None:
+            entry = self._results.get(key)
+        if entry is None:
             return True
+        # entry is (batch, row) normally, or prebuilt dicts if an earlier
+        # flush exhausted its retries (see below).
+        data = entry if isinstance(entry, dict) else self._build(*entry)
 
         def attempt() -> bool:
             try:
@@ -106,11 +186,49 @@ class ResultStore:
         ok = retry_with_exponential_backoff(
             attempt, initial_duration=self._retry_initial,
             steps=self._retry_steps)
-        if ok:
-            self.delete_data(key)  # evict on success (store.go:134)
-        else:
+        with self._lock:
+            # Evict/downgrade only if the entry we flushed is still the
+            # current one — record_batch may have stored a NEWER attempt's
+            # result for this pod while we were flushing; that one must
+            # survive to be flushed in turn.
+            if self._results.get(key) is entry:
+                if ok:  # evict on success (store.go:134)
+                    del self._results[key]
+                else:
+                    # Keep the pod's data for a later flush, but as its
+                    # small materialized dicts — a retained (batch, row)
+                    # entry would pin the whole batch's (F/S,P,N) arrays.
+                    self._results[key] = data
+        if not ok:
             log.warning("failed to flush scheduling results for %s", key)
         return ok
+
+    def _flush_loop(self) -> None:
+        while True:
+            key = self._q.get()
+            try:
+                if key is None:
+                    return
+                self.flush_pod(key)
+            except Exception:
+                log.exception("async flush of %s failed", key)
+            finally:
+                self._q.task_done()
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Wait for the async flusher to finish everything enqueued so far."""
+        if self._q is None:
+            return True
+        deadline = time.monotonic() + timeout
+        while self._q.unfinished_tasks:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
+        return True
+
+    def close(self) -> None:
+        if self._q is not None:
+            self._q.put(None)
 
     def delete_data(self, key: str) -> None:
         with self._lock:

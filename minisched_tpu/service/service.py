@@ -38,7 +38,10 @@ class SchedulerService:
         self._config = config or SchedulerConfig()
         recorder = None
         if self._config.explain:
-            self.result_store = recorder = ResultStore(self._store)
+            # Engine mode: flush annotations on a background worker (the
+            # reference's off-hot-path informer-event flush pattern).
+            self.result_store = recorder = ResultStore(self._store,
+                                                       async_flush=True)
         self._sched = Scheduler(self._store, self._profile.build(),
                                 self._config, recorder=recorder)
         self._sched.start()

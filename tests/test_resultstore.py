@@ -128,3 +128,57 @@ def test_noop_without_explain_outputs():
     assert rs.pending_keys() == []
     pod = store.get("Pod", pods[0].key)
     assert FILTER_RESULT_KEY not in pod.metadata.annotations
+
+
+def test_top_k_bounds_recorded_nodes():
+    """At N > top_k the per-pod annotation records only the k best nodes
+    by weighted normalized score (hot-path O(P*N) dict blowup guard)."""
+    store = ClusterStore()
+    pods = [store.create(_pod("pk0"))]
+    ps = PluginSet([NodeUnschedulable(), NodeNumber()], {})
+    rs = ResultStore(store, flush=True, top_k=4, retry_initial_s=0.001)
+    n = 12
+    names = [f"n{j}" for j in range(n)]
+    fm = np.ones((1, 1, n), dtype=bool)
+    raw = np.arange(n, dtype=np.float32).reshape(1, 1, n)
+    norm = raw.copy()
+    rs.record_batch(pods, names, FakeDecision(fm, raw, norm), ps)
+    pod = store.get("Pod", pods[0].key)
+    sr = json.loads(pod.metadata.annotations[SCORE_RESULT_KEY])
+    # exactly the 4 highest-scoring nodes survive
+    assert set(sr) == {"n8", "n9", "n10", "n11"}
+    fr = json.loads(pod.metadata.annotations[FILTER_RESULT_KEY])
+    assert set(fr) == set(sr)
+
+
+def test_async_flush_off_hot_path():
+    """async_flush mode: record_batch returns without touching the store;
+    the worker flushes; drain() waits for it."""
+    store, pods, ps, rs, names, dec = _setup(flush=False)
+    rs_async = ResultStore(store, async_flush=True, retry_initial_s=0.001)
+    rs_async.record_batch(pods, names, dec, ps)
+    assert rs_async.drain(timeout=5.0)
+    pod = store.get("Pod", pods[0].key)
+    assert FILTER_RESULT_KEY in pod.metadata.annotations
+    assert pods[0].key not in rs_async.pending_keys()
+    rs_async.close()
+
+
+def test_top_k_prefers_feasible_nodes():
+    """Feasible nodes rank strictly above higher-scoring infeasible ones,
+    so the chosen node always appears in a bounded annotation."""
+    store = ClusterStore()
+    pods = [store.create(_pod("pf0"))]
+    ps = PluginSet([NodeUnschedulable(), NodeNumber()], {})
+    rs = ResultStore(store, flush=True, top_k=3, retry_initial_s=0.001)
+    n = 8
+    names = [f"n{j}" for j in range(n)]
+    fm = np.zeros((1, 1, n), dtype=bool)
+    fm[0, 0, :2] = True  # only n0, n1 feasible — low raw scores
+    raw = np.arange(n, dtype=np.float32).reshape(1, 1, n)
+    rs.record_batch(pods, names, FakeDecision(fm, raw, raw.copy()), ps)
+    pod = store.get("Pod", pods[0].key)
+    fr = json.loads(pod.metadata.annotations[FILTER_RESULT_KEY])
+    assert {"n0", "n1"} <= set(fr)          # all feasible nodes present
+    assert len(fr) == 3                     # one infeasible fills the slot
+    assert fr["n7"]["NodeUnschedulable"] != PASSED  # best infeasible kept
