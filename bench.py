@@ -114,7 +114,10 @@ def run_child() -> None:
     plugins = ["NodeUnschedulable", "NodeResourcesFit",
                "NodeResourcesLeastAllocated",
                "NodeResourcesBalancedAllocation"]
-    plugin_set = PluginSet([NodeUnschedulable(), NodeResourcesFit(),
+    # Fit scores LeastAllocated by default (upstream parity) — disable its
+    # score point here since LeastAllocated is listed explicitly.
+    plugin_set = PluginSet([NodeUnschedulable(),
+                            NodeResourcesFit(score_strategy=None),
                             NodeResourcesLeastAllocated(),
                             NodeResourcesBalancedAllocation()])
     detail["profile"] = plugins
@@ -240,7 +243,9 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins) -> dict:
     from minisched_tpu.service.service import SchedulerService
     from minisched_tpu.state.store import ClusterStore
 
-    profile = Profile(name="bench", plugins=plugins)
+    profile = Profile(name="bench", plugins=plugins,
+                      plugin_args={"NodeResourcesFit":
+                                   {"score_strategy": None}})
     out = {}
     for attempt in ("warmup", "measured"):
         store = ClusterStore(max_log=1000)

@@ -50,6 +50,11 @@ class NodeFeatureCache:
         # PVC key → {node row: mount count} (VolumeRestrictions RWO
         # exclusivity + NodeVolumeLimits attach counts).
         self._claims: Dict[str, Dict[int, int]] = {}
+        # Claim keys backed by a cloud driver (VolumeClaim.volume_type):
+        # they charge their per-cloud resource axis via pod_requests and
+        # must NOT consume generic attachable-volumes slots in the claim
+        # table's per-claim-per-node accounting.
+        self._typed_claims: set = set()
         # Gang membership of bound pods: group → live count, pod key →
         # group. Feeds quorum accounting (ops/gang.py): a gang's effective
         # min_count is reduced by members already running cluster-wide, the
@@ -126,10 +131,22 @@ class NodeFeatureCache:
                 # Attach slots are per-claim-per-node, not per-pod: a claim
                 # already mounted on this node costs no new slot; the slot
                 # frees only when the LAST mounting pod leaves (see
-                # _drop_claims). The stored req's volume component is
-                # zeroed — the claim table owns that axis.
+                # _drop_claims). The stored req's generic volume component
+                # is zeroed — the claim table owns that axis. Cloud-typed
+                # claims stay per-pod on their own axes (already in req).
+                # A claim's typedness is decided at its FIRST mount and is
+                # sticky for the mount epoch — charge and release must be
+                # symmetric even if later pods reference the same claim
+                # with a different volume_type.
+                ns = pod.metadata.namespace
+                for v in pod.spec.volumes:
+                    ck = f"{ns}/{v.claim_name}"
+                    if (ck not in self._claims
+                            and v.volume_type in obj_mod.CLOUD_VOLUME_AXES):
+                        self._typed_claims.add(ck)
                 newly = sum(1 for ck in claims
-                            if not self._claims.get(ck, {}).get(i))
+                            if ck not in self._typed_claims
+                            and not self._claims.get(ck, {}).get(i))
                 req[_VOL] = 0.0
                 self._feats.free[i, _VOL] -= newly
             self._bound[pod.key] = (i, req, ports, claims)
@@ -197,8 +214,9 @@ class NodeFeatureCache:
 
     def _drop_claims(self, row: int, claims: List[str]) -> int:
         """Remove one pod's claim mounts from row (caller holds the lock).
-        Returns how many claims became UNMOUNTED on this row — the number
-        of attach slots freed."""
+        Returns how many GENERIC claims became UNMOUNTED on this row — the
+        number of generic attach slots freed (cloud-typed claims are
+        charged per pod on their own axes, not via the claim table)."""
         released = 0
         for ck in claims:
             rows = self._claims.get(ck)
@@ -208,10 +226,12 @@ class NodeFeatureCache:
             if left > 0:
                 rows[row] = left
             else:
-                if rows.pop(row, None) is not None:
+                if (rows.pop(row, None) is not None
+                        and ck not in self._typed_claims):
                     released += 1
             if not rows:
                 del self._claims[ck]
+                self._typed_claims.discard(ck)
         return released
 
     CLAIM_UNUSED = obj_mod.CLAIM_UNUSED
@@ -341,8 +361,8 @@ class NodeFeatureCache:
             if row == i:
                 free -= req  # volume component is 0; claim table owns it
                 ports += p
-        free[_VOL] -= sum(1 for rows in self._claims.values()
-                          if rows.get(i))
+        free[_VOL] -= sum(1 for ck, rows in self._claims.items()
+                          if rows.get(i) and ck not in self._typed_claims)
         self._feats.free[i] = free
         self._feats.used_ports[i] = 0
         self._add_ports(i, ports)

@@ -32,6 +32,10 @@ from ..state.objects import RESOURCES, Node, Pod
 
 NUM_RESOURCES = len(RESOURCES)
 
+# Upstream NodePreferAvoidPods reads this node annotation (the rebuild
+# checks presence; upstream also matches the pod's controller ref).
+PREFER_AVOID_PODS_ANNOTATION = "scheduler.alpha.kubernetes.io/preferAvoidPods"
+
 # Taint-effect codes.
 EFFECT_NONE = 0
 EFFECT_NO_SCHEDULE = 1
@@ -173,6 +177,9 @@ class NodeFeatures(NamedTuple):
     taint_effects: np.ndarray  # (N,T) i32
     used_ports: np.ndarray     # (N,PORT) i32
     images: np.ndarray         # (N,IM) i32
+    # scheduler.alpha.kubernetes.io/preferAvoidPods annotation present
+    # (NodePreferAvoidPods score input)
+    avoid_pods: np.ndarray     # (N,) bool
     # topology domains: row k = this node's domain id under registered
     # topology key k (-1 = key absent). Slot 0 is kubernetes.io/hostname,
     # whose domain id is the node's own row; other keys hash their label
@@ -215,6 +222,10 @@ class PodFeatures(NamedTuple):
     # claim_rows[c] = node row the pod's c-th claim is currently mounted on
     # (-1 = unused/unrestricted). VolumeRestrictions' RWO exclusivity.
     claim_rows: np.ndarray     # (P,CV) i32
+    # claim_typed[c] — the c-th claim is cloud-typed (charged on its
+    # per-cloud axis, objects.CLOUD_VOLUME_AXES), so generic attach-slot
+    # logic (NodeVolumeLimits pinned-extra) must skip it.
+    claim_typed: np.ndarray    # (P,CV) bool
     # VolumeZone: required (topology key slot, domain id) from the pod's
     # bound PVs' zone labels; -1 = no zone requirement.
     zone_key: np.ndarray       # (P,) i32
@@ -304,6 +315,7 @@ def empty_node_features(n: int, cfg: EncodingConfig = DEFAULT_ENCODING) -> NodeF
         taint_effects=np.zeros((n, cfg.max_taints), dtype=np.int32),
         used_ports=np.zeros((n, cfg.max_ports), dtype=np.int32),
         images=np.zeros((n, cfg.max_images), dtype=np.int32),
+        avoid_pods=np.zeros(n, dtype=bool),
         topo_domains=np.full((cfg.max_topology_keys, n), -1, dtype=np.int32),
     )
 
@@ -352,14 +364,19 @@ def encode_node_into(feats: NodeFeatures, i: int, node: Node,
     feats.valid[i] = True
     feats.unschedulable[i] = node.spec.unschedulable
     feats.allocatable[i] = resources_vector(node.status.allocatable)
-    # Undeclared attach limit → the standard default ceiling, so the
-    # volume axis always has real capacity semantics. An EXPLICIT 0 is
+    # Undeclared attach limits → the standard default ceilings, so the
+    # volume axes always have real capacity semantics. An EXPLICIT 0 is
     # honored (a node that cannot attach volumes at all).
     if "attachable-volumes" not in node.status.allocatable:
         feats.allocatable[i, obj.RESOURCE_INDEX["attachable-volumes"]] = \
             obj.DEFAULT_ATTACHABLE_VOLUMES
+    for axis, limit in obj.DEFAULT_CLOUD_VOLUME_LIMITS.items():
+        if axis not in node.status.allocatable:
+            feats.allocatable[i, obj.RESOURCE_INDEX[axis]] = limit
     feats.name_suffix[i] = name_suffix_digit(node.metadata.name)
     feats.name_hash[i] = _h(node.metadata.name)
+    feats.avoid_pods[i] = PREFER_AVOID_PODS_ANNOTATION in \
+        node.metadata.annotations
 
     labels = list(node.metadata.labels.items())
     if len(labels) > cfg_labels and overflow is not None:
@@ -641,6 +658,7 @@ def encode_pods(pods: List[Pod], p_pad: int,
         required_node=np.zeros(P, dtype=np.int32),
         volumes_ready=np.ones(P, dtype=bool),
         claim_rows=np.full((P, cfg.max_pod_claims), -1, dtype=np.int32),
+        claim_typed=np.zeros((P, cfg.max_pod_claims), dtype=bool),
         zone_key=np.full(P, -1, dtype=np.int32),
         zone_dom=np.full(P, -1, dtype=np.int32),
         spread_group=np.full((P, C), -1, dtype=np.int32),
@@ -693,24 +711,30 @@ def encode_pods(pods: List[Pod], p_pad: int,
             if volumes_ready_fn is not None:
                 f.volumes_ready[i] = bool(volumes_ready_fn(pod))
             if volume_info_fn is not None:
-                claim_rows, zk, zd = volume_info_fn(pod)
+                claim_rows, claim_typed, zk, zd = volume_info_fn(pod)
                 # On slot overflow, PINNED rows (>= 0) must survive — they
                 # carry RWO placement constraints; unused/multi states are
                 # filter no-ops. Two distinct pinned rows correctly make
                 # the pod unschedulable (claims on different nodes).
-                ordered = sorted(claim_rows, key=lambda r: r < 0)
-                _fill_slots(f.claim_rows[i], ordered,
+                order = sorted(range(len(claim_rows)),
+                               key=lambda c: claim_rows[c] < 0)
+                _fill_slots(f.claim_rows[i],
+                            [claim_rows[c] for c in order],
                             f"pod {pod.key} volume claims", overflow)
+                _fill_slots(f.claim_typed[i],
+                            [claim_typed[c] for c in order], None, None)
                 f.zone_key[i] = zk
                 f.zone_dom[i] = zd
-                # Attach-slot charge = claims that may need a NEW
-                # attachment: pinned claims (row >= 0) cost nothing on
-                # their only feasible node; unused and multi-node shared
+                # Generic attach-slot charge = UNTYPED claims that may need
+                # a NEW attachment: pinned claims (row >= 0) cost nothing
+                # on their only feasible node; unused and multi-node shared
                 # claims charge one slot (for multi-node claims that
                 # over-charges nodes already mounting them — the safe
                 # direction; under-charging could over-commit a node).
+                # Cloud-typed claims charge their own axes via pod_requests.
                 f.requests[i, obj.RESOURCE_INDEX["attachable-volumes"]] = \
-                    sum(1 for r in claim_rows if r < 0)
+                    sum(1 for c, r in enumerate(claim_rows)
+                        if r < 0 and not claim_typed[c])
 
         ns_h = _h(pod.metadata.namespace) if pod.metadata.namespace else 0
         cons = pod.spec.topology_spread_constraints

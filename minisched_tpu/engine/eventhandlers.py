@@ -24,6 +24,8 @@ def add_all_event_handlers(sched, factory: InformerFactory) -> None:
     # --- pods: unscheduled → queue; assigned → cache accounting ---------
     def pod_add(pod):
         if not pod.spec.node_name:
+            if not sched.wants_pod(pod):
+                return  # another profile's pod (multi-profile routing)
             sched.queue.add(pod)
             if pod.spec.pod_group:
                 # A new gang member may complete a parked group's quorum

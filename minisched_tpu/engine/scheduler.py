@@ -48,11 +48,16 @@ log = logging.getLogger(__name__)
 class Scheduler:
     def __init__(self, store, plugin_set: PluginSet,
                  config: Optional[SchedulerConfig] = None,
-                 recorder=None):
+                 recorder=None, scheduler_names: Optional[Set[str]] = None):
         self.store = store
         self.plugin_set = plugin_set
         self.config = config or SchedulerConfig()
         self.recorder = recorder  # explainability hook (explain/resultstore)
+        # Multi-profile routing: when set, only pods whose
+        # spec.scheduler_name is in this set are queued here (reference
+        # KubeSchedulerProfile.SchedulerName selection); None = accept all
+        # (single-profile mode).
+        self.scheduler_names = scheduler_names
         self.cache = NodeFeatureCache()
         self.broadcaster = EventBroadcaster(store)
 
@@ -93,6 +98,8 @@ class Scheduler:
         # claim exclusivity is part of the profile.
         self._rwo_enabled = any(p.name == "VolumeRestrictions"
                                 for p in plugin_set.plugins)
+        # WFFC candidate-zone memo: pvc key → (zones, computed_at).
+        self._wffc_memo: Dict[str, tuple] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.filter_names = [p.name for p in plugin_set.filter_plugins]
@@ -108,6 +115,12 @@ class Scheduler:
             "last_batch_size": 0, "last_encode_s": 0.0,
             "last_step_s": 0.0, "last_commit_s": 0.0,
         }
+
+    def wants_pod(self, pod: Pod) -> bool:
+        """Does this scheduler's profile handle the pod? (multi-profile
+        routing by spec.scheduler_name)."""
+        return (self.scheduler_names is None
+                or pod.spec.scheduler_name in self.scheduler_names)
 
     # ---- lifecycle ------------------------------------------------------
 
@@ -349,32 +362,60 @@ class Scheduler:
 
     def _volume_state(self, pod: Pod):
         """Single store pass resolving every volume-derived encode input:
-        (ready, claim_rows, zone_key_idx, zone_dom).
+        (ready, claim_rows, claim_typed, zone_key_idx, zone_dom).
 
-        ready      — all referenced PVCs Bound (VolumeBinding input)
+        ready      — all referenced PVCs Bound (VolumeBinding input).
+                     A pending WaitForFirstConsumer claim does NOT block
+                     (upstream volumebinding late binding): the PV
+                     controller binds it after the pod schedules.
         claim_rows — per-claim current mount row (VolumeRestrictions RWO)
         zone       — required zone domain from the bound PVs' zone labels
-                     (VolumeZone). PVs in several DISTINCT zones, or a
+                     (VolumeZone); for a pending WFFC claim whose candidate
+                     PVs all live in ONE zone, that zone becomes the
+                     requirement (topology-aware late binding). Candidates
+                     spread over several zones imply most placements can
+                     bind — no constraint (the single-domain zone encoding
+                     can't express a small allowed set; documented
+                     fail-open). PVs in several DISTINCT zones, or a
                      zone key that can't be registered (topology-key
                      registry full), yield IMPOSSIBLE_DOMAIN under the
                      always-present hostname slot — fail CLOSED: no node
                      matches, the pod parks under VolumeZone rather than
                      binding somewhere its volume can't attach."""
-        from ..encode.features import pair_hash
+        from ..state.objects import CLOUD_VOLUME_AXES
 
         ready = True
         claim_rows = []
-        zone_key_idx, zone_dom = -1, -1
+        claim_typed = []
+        typed_by_key: Dict[str, bool] = {}
+        for v in pod.spec.volumes:
+            k = f"{pod.metadata.namespace}/{v.claim_name}"
+            typed_by_key[k] = (typed_by_key.get(k, False)
+                               or v.volume_type in CLOUD_VOLUME_AXES)
         zones_seen = set()
+        impossible = False
         for ck in claim_keys(pod):
             claim_rows.append(self.cache.claim_node_row(ck))
+            claim_typed.append(typed_by_key.get(ck, False))
             try:
                 pvc = self.store.get("PersistentVolumeClaim", ck)
             except NotFoundError:
                 ready = False
                 continue
             if pvc.phase != "Bound":
-                ready = False
+                if pvc.binding_mode == "WaitForFirstConsumer":
+                    # Zero candidate PVs = assume dynamic provisioning will
+                    # create one in the pod's zone after placement (the PV
+                    # controller's default mode); with provisioning off AND
+                    # no candidates the claim would pend forever — the
+                    # upstream equivalent of a class with no provisioner.
+                    zones = self._wffc_candidate_zones(pvc)
+                    if len(zones) == 1:
+                        zones_seen |= zones
+                        if len(zones_seen) > 1:
+                            impossible = True
+                else:
+                    ready = False
             if not pvc.volume_name:
                 continue
             try:
@@ -382,17 +423,46 @@ class Scheduler:
             except NotFoundError:
                 continue
             zone = pv.metadata.labels.get(self.ZONE_KEY)
-            if zone and zone not in zones_seen:
+            if zone:
                 zones_seen.add(zone)
-                idx = self.cache.registry.index_of(
-                    self.ZONE_KEY, self.cache.overflow)
-                if idx < 0 or len(zones_seen) > 1:
-                    zone_key_idx, zone_dom = 0, self.IMPOSSIBLE_DOMAIN
-                else:
-                    zone_key_idx = idx
-                    zone_dom = (pair_hash(self.ZONE_KEY, zone)
-                                % self.cache.cfg.domain_buckets)
-        return ready, claim_rows, zone_key_idx, zone_dom
+                if len(zones_seen) > 1:
+                    impossible = True
+        return (ready, claim_rows, claim_typed,
+                *self._zone_requirement(zones_seen, impossible))
+
+    def _zone_requirement(self, zones_seen, impossible):
+        """(zone_key_idx, zone_dom) for the encoder from the set of zones
+        the pod's volumes demand."""
+        from ..encode.features import pair_hash
+
+        if not zones_seen:
+            return -1, -1
+        idx = self.cache.registry.index_of(self.ZONE_KEY, self.cache.overflow)
+        if impossible or idx < 0:
+            return 0, self.IMPOSSIBLE_DOMAIN
+        (zone,) = zones_seen
+        return idx, pair_hash(self.ZONE_KEY, zone) % self.cache.cfg.domain_buckets
+
+    def _wffc_candidate_zones(self, pvc) -> Set[str]:
+        """Distinct zones of Available PVs that could satisfy a pending
+        WaitForFirstConsumer claim (class + capacity match). Memoized per
+        claim with a short TTL so a batch of pods sharing pending WFFC
+        claims doesn't rescan the PV list O(P) times on the hot path."""
+        now = time.monotonic()
+        hit = self._wffc_memo.get(pvc.key)
+        if hit is not None and now - hit[1] < 0.5:
+            return hit[0]
+        want = pvc.request.get("ephemeral-storage", 0)
+        zones: Set[str] = set()
+        for pv in self.store.list("PersistentVolume"):
+            if (pv.phase == "Available"
+                    and pv.storage_class == pvc.storage_class
+                    and pv.capacity.get("ephemeral-storage", 0) >= want):
+                zone = pv.metadata.labels.get(self.ZONE_KEY)
+                if zone:
+                    zones.add(zone)
+        self._wffc_memo[pvc.key] = (zones, now)
+        return zones
 
     # ---- permit + binding cycle ----------------------------------------
 

@@ -8,9 +8,10 @@ with the free-capacity matrix resident in VMEM:
   * grid = (P,): TPU grid steps execute sequentially on the core, so VMEM
     scratch carries the running free matrix across pods (the standard
     accumulator pattern).
-  * free is stored transposed (R, N): R=8 sublanes x N lanes is a native
-    f32 tile, the per-pod "fits" check is an 8-row AND-reduce onto (1, N),
-    and the capacity update is a lane-masked FMA — no dynamic-lane scatter.
+  * free is stored transposed (R, N): R rows (currently 9 resource axes)
+    padded up to the 8-sublane f32 tile granularity x N lanes, the per-pod
+    "fits" check is an R-row AND-reduce onto (1, N), and the capacity
+    update is a lane-masked FMA — no dynamic-lane scatter.
   * each pod's score row (1, N) streams HBM→VMEM via the pallas pipeline
     (double-buffered by the runtime); total HBM traffic ≈ the score matrix
     once (~P·N·4 bytes), vs the scan path re-materializing mask/argmax

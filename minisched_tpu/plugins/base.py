@@ -81,13 +81,22 @@ class BatchedPlugin:
         return (type(self).__module__, type(self).__qualname__)
 
     # -- capability detection
+    # Instance-level opt-outs: a plugin class may implement an extension
+    # point but disable it per instance (e.g. NodeResourcesFit with
+    # score_strategy=None, or a profile disabling one extension point of a
+    # multi-point plugin — upstream's per-point Plugins.Score.Disabled).
+    score_active: bool = True
+    filter_active: bool = True
+
     @property
     def is_filter(self) -> bool:
-        return type(self).filter is not BatchedPlugin.filter
+        return (type(self).filter is not BatchedPlugin.filter
+                and self.filter_active)
 
     @property
     def is_score(self) -> bool:
-        return type(self).score is not BatchedPlugin.score
+        return (type(self).score is not BatchedPlugin.score
+                and self.score_active)
 
     @property
     def is_permit(self) -> bool:

@@ -1,0 +1,34 @@
+"""NodePreferAvoidPods score plugin.
+
+Batched counterpart of upstream's NodePreferAvoidPods (in the k8s-1.22
+in-tree registry the reference's simulator layer wraps,
+scheduler/plugin/plugins.go:24-70): nodes carrying the
+``scheduler.alpha.kubernetes.io/preferAvoidPods`` annotation score 0 for
+workload pods, everything else scores the max. Upstream gives it weight
+10000 so it dominates other scorers — effectively a soft filter; the
+default_weight here mirrors that. (Upstream additionally scopes avoidance
+to pods owned by a ReplicationController/ReplicaSet; the rebuild's pod
+model carries no owner refs, so the annotation avoids all pods —
+documented simplification.)
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..state.events import ActionType, ClusterEvent, GVK
+from .base import BatchedPlugin
+
+
+class NodePreferAvoidPods(BatchedPlugin):
+    name = "NodePreferAvoidPods"
+    default_weight = 10000.0
+
+    def events_to_register(self):
+        return [ClusterEvent(GVK.NODE, ActionType.ADD | ActionType.UPDATE)]
+
+    def score(self, pf, nf, ctx) -> jnp.ndarray:
+        # (P,N): 100 for normal nodes, 0 for annotated ones (upstream
+        # scores {0, MaxNodeScore} the same way).
+        return jnp.broadcast_to(
+            jnp.where(nf.avoid_pods, 0.0, 100.0)[None, :],
+            (pf.valid.shape[0], nf.valid.shape[0])).astype(jnp.float32)

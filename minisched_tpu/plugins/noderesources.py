@@ -27,9 +27,31 @@ DEFAULT_SCORED_RESOURCES = ("cpu", "memory")
 
 class NodeResourcesFit(BatchedPlugin):
     """Filter: node's free resources cover the pod's requests on every
-    tracked dimension (upstream noderesources.Fit)."""
+    tracked dimension (upstream noderesources.Fit). The same plugin also
+    SCORES in upstream's default v1beta2 profile (the reference's golden
+    config lists NodeResourcesFit in Score.Enabled,
+    scheduler/scheduler_test.go:325-333); ``score_strategy`` selects the
+    scoring function (upstream ScoringStrategy): "LeastAllocated" (the
+    default), "MostAllocated", or None to disable the score point."""
 
     name = "NodeResourcesFit"
+
+    def __init__(self, score_strategy: str | None = "LeastAllocated",
+                 resources=DEFAULT_SCORED_RESOURCES):
+        self._strategy = score_strategy
+        self.score_active = score_strategy is not None
+        self._scorer = None
+        if score_strategy == "LeastAllocated":
+            self._scorer = NodeResourcesLeastAllocated(resources)
+        elif score_strategy == "MostAllocated":
+            self._scorer = NodeResourcesMostAllocated(resources)
+        elif score_strategy is not None:
+            raise ValueError(f"unknown score_strategy {score_strategy!r}")
+
+    def trace_key(self) -> tuple:
+        extra = (self._strategy,
+                 self._scorer._resources if self._scorer else ())
+        return super().trace_key() + extra
 
     def events_to_register(self):
         # Upstream: {Pod, Delete} (capacity freed) + {Node, Add|Update}.
@@ -40,6 +62,9 @@ class NodeResourcesFit(BatchedPlugin):
         # (P,1,R) <= (1,N,R) reduced over R
         return jnp.all(pf.requests[:, None, :] <= nf.free[None, :, :] + _EPS,
                        axis=2)
+
+    def score(self, pf, nf, ctx) -> jnp.ndarray:
+        return self._scorer.score(pf, nf, ctx)
 
 
 class _AllocationScorer(BatchedPlugin):

@@ -45,15 +45,20 @@ class PVController:
 
     # ---- sync loop ------------------------------------------------------
 
+    ZONE_KEY = "topology.kubernetes.io/zone"
+
     def _run(self) -> None:
+        # Pods are watched too: a WaitForFirstConsumer claim binds only
+        # once its consuming pod is scheduled (upstream late binding).
         watcher = self._store.watch(
-            kinds=["PersistentVolumeClaim", "PersistentVolume"])
+            kinds=["PersistentVolumeClaim", "PersistentVolume", "Pod"])
         self._sync_once()
         while not self._stop.is_set():
             ev = watcher.next_event(timeout=self._sync)
+            if ev is not None and ev.kind == "Pod" and not (
+                    ev.object is not None and obj.claim_keys(ev.object)):
+                continue  # volumeless pod churn: nothing to (late-)bind
             self._sync_once()
-            if ev is None:
-                continue
         watcher.stop()
 
     def _sync_once(self) -> None:
@@ -63,30 +68,65 @@ class PVController:
         except Exception:
             return
         available = [pv for pv in pvs if pv.phase == "Available"]
+        consumer_zones = None  # lazy: only listed when a WFFC claim pends
         for pvc in pvcs:
             if pvc.phase == "Bound":
                 continue
-            match = self._find_match(pvc, available)
+            zone = None
+            if pvc.binding_mode == "WaitForFirstConsumer":
+                if consumer_zones is None:
+                    consumer_zones = self._scheduled_consumer_zones()
+                if pvc.key not in consumer_zones:
+                    continue  # no scheduled consumer yet: wait
+                zone = consumer_zones[pvc.key]
+            match = self._find_match(pvc, available, zone=zone)
             if match is None and self._dynamic:
-                match = self._provision(pvc)
+                match = self._provision(pvc, zone=zone)
             if match is not None:
                 self._bind(pvc, match)
                 available = [pv for pv in available if pv.key != match.key]
 
-    def _find_match(self, pvc, available):
+    def _scheduled_consumer_zones(self):
+        """PVC key → zone of the node its scheduled consumer landed on
+        ("" when the node has no zone label)."""
+        zones = {}
+        try:
+            node_zone = {n.metadata.name: n.metadata.labels.get(self.ZONE_KEY, "")
+                         for n in self._store.list("Node")}
+            for pod in self._store.list("Pod"):
+                if not pod.spec.node_name:
+                    continue
+                for ck in obj.claim_keys(pod):
+                    zones[ck] = node_zone.get(pod.spec.node_name, "")
+        except Exception:
+            pass
+        return zones
+
+    def _find_match(self, pvc, available, zone=None):
         want = pvc.request.get("ephemeral-storage", 0)
         candidates = [
             pv for pv in available
             if pv.storage_class == pvc.storage_class
             and pv.capacity.get("ephemeral-storage", 0) >= want]
+        if zone:
+            # Late binding is topology-aware: prefer a PV in the consumer
+            # pod's zone; fall back to zoneless PVs (attachable anywhere).
+            in_zone = [pv for pv in candidates
+                       if pv.metadata.labels.get(self.ZONE_KEY) == zone]
+            candidates = in_zone or [
+                pv for pv in candidates
+                if not pv.metadata.labels.get(self.ZONE_KEY)]
         # smallest adequate volume, upstream's match heuristic
         return min(candidates,
                    key=lambda pv: pv.capacity.get("ephemeral-storage", 0),
                    default=None)
 
-    def _provision(self, pvc):
+    def _provision(self, pvc, zone=None):
+        labels = {self.ZONE_KEY: zone} if zone else {}
         pv = obj.PersistentVolume(
-            metadata=obj.ObjectMeta(name=f"pv-provisioned-{next(self._prov_seq)}"),
+            metadata=obj.ObjectMeta(
+                name=f"pv-provisioned-{next(self._prov_seq)}",
+                labels=labels),
             capacity=dict(pvc.request),
             storage_class=pvc.storage_class,
             phase="Available")

@@ -4,37 +4,67 @@ Rebuild of reference scheduler/scheduler.go: NewSchedulerService (:36),
 StartScheduler (:50-80: informer factory + event broadcaster + minisched.New
 + start informers + go Run), RestartScheduler (:40-47: shutdown + start with
 the retained config), ShutdownScheduler (:82-87), GetSchedulerConfig (:89).
+
+Multi-profile: start_scheduler also accepts a SchedulerConfiguration (or a
+list of Profiles). Each profile gets its own engine instance; pods select
+a profile with spec.scheduler_name (reference KubeSchedulerProfile
+semantics, scheduler.go:97-142). All engines share the one store — capacity
+accounting stays globally consistent because every engine's informers see
+every bind.
 """
 from __future__ import annotations
 
 import logging
-from typing import Optional
+from typing import Dict, List, Optional, Sequence, Union
 
 from ..config import SchedulerConfig
 from ..engine.scheduler import Scheduler
 from ..explain.resultstore import ResultStore
+from .config import SchedulerConfiguration
 from .defaultconfig import Profile, default_scheduler_profile
 
 log = logging.getLogger(__name__)
+
+ProfileSpec = Union[Profile, Sequence[Profile], SchedulerConfiguration, None]
 
 
 class SchedulerService:
     def __init__(self, store):
         self._store = store
-        self._sched: Optional[Scheduler] = None
-        self._profile: Optional[Profile] = None
+        self._scheds: Dict[str, Scheduler] = {}
+        self._profiles: List[Profile] = []
+        self._multi = False
         self._config: Optional[SchedulerConfig] = None
         self.result_store: Optional[ResultStore] = None
 
     @property
     def scheduler(self) -> Optional[Scheduler]:
-        return self._sched
+        """The first (or only) running engine — the single-profile API."""
+        return next(iter(self._scheds.values()), None)
 
-    def start_scheduler(self, profile: Optional[Profile] = None,
+    @property
+    def schedulers(self) -> Dict[str, Scheduler]:
+        """Profile name → engine."""
+        return dict(self._scheds)
+
+    def start_scheduler(self, profile: ProfileSpec = None,
                         config: Optional[SchedulerConfig] = None) -> Scheduler:
-        if self._sched is not None:
+        if self._scheds:
             raise RuntimeError("scheduler already running")
-        self._profile = profile or default_scheduler_profile()
+        if isinstance(profile, SchedulerConfiguration):
+            profiles, self._multi = list(profile.profiles), True
+        elif isinstance(profile, (list, tuple)):
+            profiles, self._multi = list(profile), True
+        else:
+            profiles = [profile or default_scheduler_profile()]
+            self._multi = False
+        if not profiles:
+            profiles = [default_scheduler_profile()]
+        names = [p.name for p in profiles]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate profile names: {names}")
+
+        self._profiles = profiles
         self._config = config or SchedulerConfig()
         recorder = None
         if self._config.explain:
@@ -42,27 +72,42 @@ class SchedulerService:
             # reference's off-hot-path informer-event flush pattern).
             self.result_store = recorder = ResultStore(self._store,
                                                        async_flush=True)
-        self._sched = Scheduler(self._store, self._profile.build(),
-                                self._config, recorder=recorder)
-        self._sched.start()
-        log.info("scheduler started (profile=%s)", self._profile.name)
-        return self._sched
+        # Build every PluginSet BEFORE starting any engine so a bad later
+        # profile (unknown plugin, bad args) can't leave a half-started
+        # service behind.
+        built = [(p, p.build()) for p in profiles]
+        for p, plugin_set in built:
+            # In multi-profile mode each engine only takes pods naming its
+            # profile; a single profile keeps the accept-everything legacy
+            # behavior.
+            sched = Scheduler(
+                self._store, plugin_set, self._config, recorder=recorder,
+                scheduler_names={p.name} if self._multi else None)
+            self._scheds[p.name] = sched
+            sched.start()
+        log.info("scheduler started (profiles=%s)", names)
+        return self.scheduler
 
     def shutdown_scheduler(self) -> None:
-        if self._sched is not None:
-            self._sched.shutdown()
-            self._sched = None
-            log.info("scheduler shut down")
+        for name, sched in list(self._scheds.items()):
+            sched.shutdown()
+            log.info("scheduler %s shut down", name)
+        self._scheds.clear()
 
     def restart_scheduler(self) -> Scheduler:
         """Shutdown + start with the retained profile/config (reference
         RestartScheduler scheduler.go:40-47). Queue/cache state is rebuilt
         from surviving store state, same as the reference."""
-        profile, config = self._profile, self._config
+        profiles, config, multi = self._profiles, self._config, self._multi
         self.shutdown_scheduler()
-        self._profile, self._config = None, None
-        return self.start_scheduler(profile, config)
+        self._profiles, self._config = [], None
+        spec: ProfileSpec = profiles if multi else (profiles[0] if profiles
+                                                    else None)
+        return self.start_scheduler(spec, config)
 
     def get_scheduler_profile(self) -> Optional[Profile]:
         """reference GetSchedulerConfig (scheduler.go:89-91)."""
-        return self._profile
+        return self._profiles[0] if self._profiles else None
+
+    def get_scheduler_profiles(self) -> List[Profile]:
+        return list(self._profiles)

@@ -28,13 +28,32 @@ from typing import Any, Dict, List, Optional, Tuple
 #  capacity-aware greedy assignment respect attach limits WITHIN a batch,
 #  not just across batches (SURVEY §7 batch-internal causality).
 RESOURCES: Tuple[str, ...] = ("cpu", "memory", "pods", "ephemeral-storage",
-                              "accelerator", "attachable-volumes")
+                              "accelerator", "attachable-volumes",
+                              "attachable-volumes-aws-ebs",
+                              "attachable-volumes-gce-pd",
+                              "attachable-volumes-azure-disk")
 RESOURCE_INDEX: Dict[str, int] = {r: i for i, r in enumerate(RESOURCES)}
 
 # Nodes that don't declare allocatable["attachable-volumes"] get this
 # ceiling (the common cloud attach limit upstream's per-driver plugins
 # default to).
 DEFAULT_ATTACHABLE_VOLUMES = 26.0
+
+# Per-cloud attach-slot axes (the reference wraps upstream's EBSLimits /
+# GCEPDLimits / AzureDiskLimits filters, scheduler/plugin/plugins.go:24-70;
+# defaults are upstream's DefaultMaxEBSVolumes=39, DefaultMaxGCEPDVolumes=16,
+# DefaultMaxAzureDiskVolumes=16). A pod volume with a matching volume_type
+# charges its cloud axis instead of the generic attachable-volumes axis.
+CLOUD_VOLUME_AXES: Dict[str, str] = {
+    "aws-ebs": "attachable-volumes-aws-ebs",
+    "gce-pd": "attachable-volumes-gce-pd",
+    "azure-disk": "attachable-volumes-azure-disk",
+}
+DEFAULT_CLOUD_VOLUME_LIMITS: Dict[str, float] = {
+    "attachable-volumes-aws-ebs": 39.0,
+    "attachable-volumes-gce-pd": 16.0,
+    "attachable-volumes-azure-disk": 16.0,
+}
 
 ResourceList = Dict[str, float]
 
@@ -216,9 +235,15 @@ class ContainerPort:
 
 @dataclass
 class VolumeClaim:
-    """A pod's reference to a PVC by name (pod.spec.volumes[*].pvc)."""
+    """A pod's reference to a PVC by name (pod.spec.volumes[*].pvc).
+
+    ``volume_type`` identifies the backing driver the way upstream's
+    per-cloud limit filters classify volumes (aws-ebs | gce-pd |
+    azure-disk, CLOUD_VOLUME_AXES); "" = generic, charged to the
+    attachable-volumes axis."""
 
     claim_name: str
+    volume_type: str = ""
 
 
 class PodPhase:
@@ -319,6 +344,12 @@ class PersistentVolumeClaim:
     storage_class: str = ""
     volume_name: str = ""  # bound PV name, "" if pending
     phase: str = "Pending"  # Pending | Bound
+    # Upstream StorageClass.volumeBindingMode, carried on the claim (the
+    # rebuild has no StorageClass kind): WaitForFirstConsumer claims are
+    # NOT bound by the PV controller until their pod schedules; the
+    # scheduler treats them as ready and constrains the pod to zones where
+    # a candidate PV exists (volumebinding.py WFFC path).
+    binding_mode: str = "Immediate"  # Immediate | WaitForFirstConsumer
 
     @property
     def key(self) -> str:
@@ -379,11 +410,22 @@ def to_dict(obj: Any) -> Dict[str, Any]:
 
 def pod_requests(pod: Pod) -> ResourceList:
     """Effective resource requests incl. the implicit one-pod slot and the
-    pod's volume-attachment slots."""
+    pod's volume-attachment slots. Typed volumes (VolumeClaim.volume_type)
+    charge their per-cloud axis; untyped ones the generic axis — so the
+    capacity-aware greedy assignment respects every attach limit WITHIN a
+    batch, and the per-cloud limit filters are plain axis comparisons."""
     req = dict(pod.spec.requests)
     req.setdefault("pods", 1)
     if pod.spec.volumes:
-        req.setdefault("attachable-volumes", float(len(claim_keys(pod))))
+        generic = 0
+        for v in pod.spec.volumes:
+            axis = CLOUD_VOLUME_AXES.get(v.volume_type)
+            if axis is None:
+                generic += 1
+            else:
+                req[axis] = req.get(axis, 0) + 1
+        if generic:
+            req.setdefault("attachable-volumes", float(generic))
     return req
 
 

@@ -1,0 +1,215 @@
+"""Multi-profile configuration conversion + routing.
+
+Mirrors the reference's Test_convertConfigurationForSimulator table
+(/root/reference/scheduler/scheduler_test.go:278-369, 8 cases) against the
+rebuild's conversion (service/config.py), plus an end-to-end two-profile
+scenario (pods routed by spec.scheduler_name) the reference never had
+running (its multi-profile machinery is test-only, SURVEY §0)."""
+import time
+
+import pytest
+
+from minisched_tpu.config import SchedulerConfig
+from minisched_tpu.service.config import (DEFAULT_PLUGIN_ARGS,
+                                          PluginArgs,
+                                          SchedulerConfiguration,
+                                          convert_configuration_for_simulator,
+                                          new_plugin_config, resolve_args)
+from minisched_tpu.service.defaultconfig import (DEFAULT_FILTER_PLUGINS,
+                                                 DEFAULT_SCORE_PLUGINS,
+                                                 Profile)
+from minisched_tpu.service.service import SchedulerService
+from minisched_tpu.state import objects as obj
+from minisched_tpu.state.store import ClusterStore
+
+DEFAULT_FILTERS = list(DEFAULT_FILTER_PLUGINS)
+DEFAULT_SCORES = [n for n, _ in DEFAULT_SCORE_PLUGINS]
+
+
+def _built_names(profile):
+    ps = profile.build()
+    return ([p.name for p in ps.filter_plugins],
+            [p.name for p in ps.score_plugins])
+
+
+# ---- the reference's 8 table cases --------------------------------------
+
+def test_convert_empty_configuration():
+    """case 'success with empty-configuration' + 'empty Profiles': no
+    profiles -> one default-scheduler profile with the full default sets."""
+    got = convert_configuration_for_simulator(SchedulerConfiguration())
+    assert len(got.profiles) == 1
+    prof = got.profiles[0]
+    assert prof.name == "default-scheduler"
+    filters, scores = _built_names(prof)
+    assert filters == DEFAULT_FILTERS
+    assert sorted(scores) == sorted(DEFAULT_SCORES)
+
+
+def test_convert_no_disabled_plugin():
+    """case 'success with no-disabled plugin'."""
+    got = convert_configuration_for_simulator(SchedulerConfiguration(
+        profiles=[Profile(name="default-scheduler", plugins=[])]))
+    filters, scores = _built_names(got.profiles[0])
+    assert filters == DEFAULT_FILTERS
+    assert sorted(scores) == sorted(DEFAULT_SCORES)
+
+
+def test_convert_resets_non_profile_fields():
+    """case 'changes of field other than Profiles does not affect result':
+    only Profiles survive conversion; everything else returns to defaults
+    (reference scheduler.go:126-131)."""
+    got = convert_configuration_for_simulator(SchedulerConfiguration(
+        profiles=[Profile(name="default-scheduler", plugins=[])],
+        parallelism=999, percentage_of_nodes_to_score=77))
+    assert got.parallelism == SchedulerConfiguration().parallelism
+    assert (got.percentage_of_nodes_to_score
+            == SchedulerConfiguration().percentage_of_nodes_to_score)
+
+
+def test_convert_ignores_user_enabled_lists():
+    """case 'changes of field other than Profiles.Plugins does not affect
+    result' — the converted enabled sets come from the DEFAULTS, not from
+    whatever the user listed (reference replaces Enabled wholesale,
+    plugins.go:168-180)."""
+    got = convert_configuration_for_simulator(SchedulerConfiguration(
+        profiles=[Profile(name="default-scheduler",
+                          plugins=["NodeNumber"])]))
+    filters, scores = _built_names(got.profiles[0])
+    assert filters == DEFAULT_FILTERS  # NodeNumber did not sneak in
+    assert "NodeNumber" not in scores
+
+
+def test_convert_multiple_profiles():
+    """case 'success with multiple profiles': second profile disables one
+    score plugin; first keeps full defaults."""
+    got = convert_configuration_for_simulator(SchedulerConfiguration(
+        profiles=[
+            Profile(name="default-scheduler", plugins=[]),
+            Profile(name="default-scheduler2", plugins=[],
+                    score_disabled=["NodeResourcesFit"]),
+        ]))
+    assert [p.name for p in got.profiles] == ["default-scheduler",
+                                              "default-scheduler2"]
+    _, scores1 = _built_names(got.profiles[0])
+    filters2, scores2 = _built_names(got.profiles[1])
+    assert sorted(scores1) == sorted(DEFAULT_SCORES)
+    assert "NodeResourcesFit" not in scores2
+    assert "NodeResourcesFit" in filters2  # only the score point disabled
+
+
+def test_convert_multiple_profiles_custom_pluginconfig():
+    """case 'success with multiple profiles and custom-pluginconfig':
+    per-profile args merge over the defaulted PluginConfig."""
+    got = convert_configuration_for_simulator(SchedulerConfiguration(
+        profiles=[
+            Profile(name="default-scheduler", plugins=[],
+                    plugin_args={"NodeResourcesFit":
+                                 {"score_strategy": "MostAllocated"}}),
+            Profile(name="default-scheduler2", plugins=[]),
+        ]))
+    args1 = got.profiles[0].plugin_args["NodeResourcesFit"]
+    assert args1["score_strategy"] == "MostAllocated"  # user override
+    assert args1["resources"] == ("cpu", "memory")     # default preserved
+    args2 = got.profiles[1].plugin_args["NodeResourcesFit"]
+    assert args2 == DEFAULT_PLUGIN_ARGS["NodeResourcesFit"]
+
+
+def test_convert_some_plugin_disabled():
+    """case 'success with some plugin disabled'."""
+    got = convert_configuration_for_simulator(SchedulerConfiguration(
+        profiles=[Profile(name="default-scheduler", plugins=[],
+                          score_disabled=["TaintToleration"])]))
+    _, scores = _built_names(got.profiles[0])
+    assert "TaintToleration" not in scores
+    assert sorted(scores) == sorted(n for n in DEFAULT_SCORES
+                                    if n != "TaintToleration")
+
+
+def test_convert_star_disable_keeps_user_list():
+    """Disabling '*' keeps the user's own enabled list for that point
+    (reference skips the default-replacement block, plugins.go:152-166)."""
+    got = convert_configuration_for_simulator(SchedulerConfiguration(
+        profiles=[Profile(name="default-scheduler",
+                          plugins=["NodeNumber"], score_disabled=["*"])]))
+    filters, scores = _built_names(got.profiles[0])
+    assert scores == ["NodeNumber"]
+    assert filters == DEFAULT_FILTERS  # filter point untouched
+
+
+# ---- NewPluginConfig raw/object contract --------------------------------
+
+def test_plugin_args_object_beats_raw():
+    """reference plugins.go:73-75: when Args exist in both Raw and Object,
+    Object takes precedence."""
+    pa = PluginArgs(raw='{"score_strategy": "LeastAllocated"}',
+                    object={"score_strategy": "MostAllocated"})
+    assert resolve_args(pa) == {"score_strategy": "MostAllocated"}
+    assert resolve_args('{"a": 1}') == {"a": 1}
+    assert resolve_args({"b": 2}) == {"b": 2}
+    assert resolve_args(None) == {}
+
+
+def test_new_plugin_config_merges_defaults():
+    merged = new_plugin_config(
+        {"NodeResourcesBalancedAllocation": {"resources": ("cpu",)}})
+    assert merged["NodeResourcesBalancedAllocation"]["resources"] == ("cpu",)
+    # untouched defaults survive
+    assert merged["NodeResourcesFit"]["score_strategy"] == "LeastAllocated"
+
+
+# ---- end-to-end: two profiles, routed by spec.scheduler_name ------------
+
+def _node(name, cpu=4000.0):
+    return obj.Node(metadata=obj.ObjectMeta(name=name),
+                    spec=obj.NodeSpec(),
+                    status=obj.NodeStatus(allocatable={
+                        "cpu": cpu, "memory": float(16 << 30), "pods": 110.0}))
+
+
+def _pod(name, scheduler_name):
+    return obj.Pod(metadata=obj.ObjectMeta(name=name, namespace="mp"),
+                   spec=obj.PodSpec(requests={"cpu": 100.0},
+                                    scheduler_name=scheduler_name))
+
+
+def test_two_profile_scenario_routes_pods():
+    store = ClusterStore()
+    for i in range(4):
+        store.create(_node(f"node{i}"))
+    svc = SchedulerService(store)
+    svc.start_scheduler(
+        [Profile(name="profile-a",
+                 plugins=["NodeUnschedulable", "NodeResourcesFit"]),
+         Profile(name="profile-b",
+                 plugins=["NodeUnschedulable", "NodeResourcesFit"])],
+        SchedulerConfig(max_batch_size=16))
+    try:
+        store.create(_pod("pa", "profile-a"))
+        store.create(_pod("pb", "profile-b"))
+        store.create(_pod("orphan", "no-such-profile"))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            bound = {p.metadata.name for p in store.list("Pod")
+                     if p.spec.node_name}
+            if bound >= {"pa", "pb"}:
+                break
+            time.sleep(0.05)
+        assert bound >= {"pa", "pb"}
+        # each engine scheduled exactly its own pod
+        ma = svc.schedulers["profile-a"].metrics()
+        mb = svc.schedulers["profile-b"].metrics()
+        assert ma["pods_bound"] == 1 and ma["pods_seen"] == 1
+        assert mb["pods_bound"] == 1 and mb["pods_seen"] == 1
+        # a pod naming an unknown scheduler stays pending (k8s semantics)
+        time.sleep(0.3)
+        assert not store.get("Pod", "mp/orphan").spec.node_name
+    finally:
+        svc.shutdown_scheduler()
+
+
+def test_duplicate_profile_names_rejected():
+    svc = SchedulerService(ClusterStore())
+    with pytest.raises(ValueError):
+        svc.start_scheduler([Profile(name="x", plugins=["NodeUnschedulable"]),
+                             Profile(name="x", plugins=["NodeUnschedulable"])])

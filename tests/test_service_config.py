@@ -28,16 +28,23 @@ def test_default_profile_matches_reference_live_set():
     assert [p.name for p in ps.permit_plugins] == ["NodeNumber"]
 
 
-def test_full_profile_builds_every_default_plugin():
+def test_full_profile_matches_reference_default_lists():
+    """The wrapped default sets, one-for-one (reference golden config,
+    scheduler_test.go:302-333: 15 filter plugins, 7 score plugins with
+    PodTopologySpread at weight 2)."""
     ps = full_scheduler_profile().build()
-    names = set(ps.names())
-    for expected in ("NodeUnschedulable", "NodeName", "NodeAffinity",
-                     "TaintToleration", "NodePorts", "VolumeBinding",
-                     "VolumeRestrictions", "VolumeZone", "NodeVolumeLimits",
-                     "NodeResourcesFit", "NodeResourcesLeastAllocated",
-                     "NodeResourcesBalancedAllocation", "ImageLocality",
-                     "PodTopologySpread", "InterPodAffinity"):
-        assert expected in names
+    assert [p.name for p in ps.filter_plugins] == [
+        "NodeUnschedulable", "NodeName", "TaintToleration", "NodeAffinity",
+        "NodePorts", "NodeResourcesFit", "VolumeRestrictions", "EBSLimits",
+        "GCEPDLimits", "NodeVolumeLimits", "AzureDiskLimits",
+        "VolumeBinding", "VolumeZone", "PodTopologySpread",
+        "InterPodAffinity"]
+    assert sorted(p.name for p in ps.score_plugins) == sorted([
+        "NodeResourcesBalancedAllocation", "ImageLocality",
+        "InterPodAffinity", "NodeResourcesFit", "NodeAffinity",
+        "PodTopologySpread", "TaintToleration"])
+    spread = next(p for p in ps.score_plugins if p.name == "PodTopologySpread")
+    assert ps.weight_of(spread) == 2.0
 
 
 def test_registry_lists_and_rejects_unknown():
