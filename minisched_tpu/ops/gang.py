@@ -68,7 +68,25 @@ def gang_assign(scores: jnp.ndarray, requests: jnp.ndarray,
     """
     if greedy_fn is None:
         greedy_fn = greedy_assign
-    P = scores.shape[0]
+
+    def attempt_fn(pod_ok):
+        return greedy_fn(jnp.where(pod_ok[:, None], scores, NEG),
+                         requests, free0, key)
+
+    return gang_admission(attempt_fn, group_ids, group_min)
+
+
+def gang_admission(attempt_fn, group_ids: jnp.ndarray,
+                   group_min: jnp.ndarray) -> GangResult:
+    """The evict/re-admit group-admission loop around an opaque assignment.
+
+    ``attempt_fn(pod_ok: (P,) bool) -> AssignResult`` runs the inner
+    capacity-aware assignment with non-admitted pods masked out. Separated
+    from gang_assign so the SHARDED path (parallel/sharded_assign.py) can
+    supply an attempt that works on mesh-local score shards — the
+    admission logic itself only touches (P,)/(G,) vectors, which stay
+    replicated under shard_map."""
+    P = group_ids.shape[0]
     G = group_min.shape[0]
     grouped = group_ids >= 0
     gidx = jnp.where(grouped, group_ids, 0)  # safe segment index
@@ -80,8 +98,7 @@ def gang_assign(scores: jnp.ndarray, requests: jnp.ndarray,
 
     def attempt(ok):
         pod_ok = jnp.where(grouped, ok[gidx], True)
-        res = greedy_fn(jnp.where(pod_ok[:, None], scores, NEG),
-                        requests, free0, key)
+        res = attempt_fn(pod_ok)
         placed = (res.assigned & grouped).astype(jnp.int32)
         counts = jax.ops.segment_sum(placed, gidx, num_segments=G)
         return res, ok & (counts < group_min)  # still-admitted, under quorum

@@ -52,7 +52,8 @@ _STEP_CACHE: dict = {}
 
 def build_step(plugin_set: PluginSet, *, explain: bool = False,
                cfg: EncodingConfig = DEFAULT_ENCODING,
-               pallas: Optional[bool] = None):
+               pallas: Optional[bool] = None,
+               assign_fn=None, assign_key=None):
     """Compile the scheduling step for a plugin profile.
 
     Returns jitted ``step(eb, nf, af, key) -> Decision`` where eb is an
@@ -65,12 +66,18 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
     ``pallas``: use the pallas greedy-assignment kernel (ops/pallas_select).
     None = auto: on TPU when the node axis is lane-tiled. The sharded
     builder passes False — a Mosaic kernel can't be GSPMD-partitioned.
+
+    ``assign_fn(masked_total, requests, free, group, min_count, key) ->
+    GangResult`` overrides the whole assignment stage (the sharded builder
+    supplies the shard_map chunked-gather scan,
+    parallel/sharded_assign.py); ``assign_key`` is its hashable identity
+    for the step cache.
     """
     cache_key = (
         tuple(p.trace_key() for p in plugin_set.filter_plugins),
         tuple((p.trace_key(), plugin_set.weight_of(p))
               for p in plugin_set.score_plugins),
-        explain, cfg, pallas,
+        explain, cfg, pallas, assign_key,
     )
     cached = _STEP_CACHE.get(cache_key)
     if cached is not None:
@@ -123,24 +130,33 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
                 norms.append(norm)
 
         masked_total = jnp.where(feasible, total, NEG)
-        # Trace-time choice of the inner assignment: pallas kernel on TPU
-        # (identical results to the scan — tests/test_pallas_select.py),
-        # lax.scan elsewhere. Re-evaluated per shape bucket at retrace.
-        use_pallas = pallas
-        if use_pallas is None:
-            from .pallas_select import pallas_supported
+        if assign_fn is not None:
+            # Externally-supplied assignment stage (sharded chunked-gather
+            # scan; identical results to the default path).
+            assign: GangResult = assign_fn(
+                masked_total, pf.requests, nf.free,
+                eb.gang.group, eb.gang.min_count, key)
+        else:
+            # Trace-time choice of the inner assignment: pallas kernel on
+            # TPU (identical results to the scan,
+            # tests/test_pallas_select.py), lax.scan elsewhere.
+            # Re-evaluated per shape bucket at retrace.
+            use_pallas = pallas
+            if use_pallas is None:
+                from .pallas_select import pallas_supported
 
-            use_pallas = pallas_supported(N)
-        greedy_fn = None
-        if use_pallas:
-            from .pallas_select import greedy_assign_pallas
+                use_pallas = pallas_supported(N)
+            greedy_fn = None
+            if use_pallas:
+                from .pallas_select import greedy_assign_pallas
 
-            greedy_fn = greedy_assign_pallas
-        # Gang-aware joint assignment (ops/gang.py); with no gangs in the
-        # batch this reduces to plain capacity-aware greedy assignment.
-        assign: GangResult = gang_assign(
-            masked_total, pf.requests, nf.free,
-            eb.gang.group, eb.gang.min_count, key, greedy_fn=greedy_fn)
+                greedy_fn = greedy_assign_pallas
+            # Gang-aware joint assignment (ops/gang.py); with no gangs in
+            # the batch this reduces to plain capacity-aware greedy
+            # assignment.
+            assign = gang_assign(
+                masked_total, pf.requests, nf.free,
+                eb.gang.group, eb.gang.min_count, key, greedy_fn=greedy_fn)
 
         if explain:
             filter_stack = (jnp.stack(masks) if masks

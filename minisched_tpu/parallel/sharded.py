@@ -30,10 +30,16 @@ def build_sharded_step(plugin_set: PluginSet, mesh, eb_template, nf_template,
                                             af_template)
     key_sh = NamedSharding(mesh, P())  # replicated PRNG key
 
-    # Reuse the single-chip traced computation; sharding-annotated jit lets
-    # GSPMD insert the collectives. pallas=False: a Mosaic kernel can't be
-    # GSPMD-partitioned, so the sharded path keeps the lax.scan assignment.
-    inner = build_step(plugin_set, explain=explain, pallas=False)
+    # Reuse the single-chip traced computation for the filter/score math
+    # (GSPMD inserts its collectives), but swap the assignment stage for
+    # the shard_map chunked-gather scan (sharded_assign.py) — the plain
+    # GSPMD partitioning of the P-step scan costs one cross-shard argmax
+    # collective per pod per gang attempt.
+    from .sharded_assign import make_sharded_assign
+
+    inner = build_step(plugin_set, explain=explain, pallas=False,
+                       assign_fn=make_sharded_assign(mesh),
+                       assign_key=("sharded", id(mesh)))
 
     def stepfn(eb, nf, af, key):
         return inner(eb, nf, af, key)

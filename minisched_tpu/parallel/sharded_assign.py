@@ -1,0 +1,122 @@
+"""Mesh-scalable capacity-aware assignment: chunked-gather greedy scan.
+
+The single-device assignment (ops/select.greedy_assign) is a P-step
+lax.scan; under plain GSPMD each step's N-wide argmax over node-sharded
+scores becomes its own cross-shard collective — P tiny latency-bound
+collectives per batch, multiplied again by every gang evict/re-admit
+attempt (the round-1 perf cliff, VERDICT weak #4).
+
+This module re-states the SAME computation in shard_map with collectives
+amortized over pod CHUNKS:
+
+  * the (P, N) score matrix stays sharded over the ("pod", "node") mesh —
+    the only large array; requests / free / gang vectors are replicated
+    (≤ a few MB at 50k nodes).
+  * the scan runs over P/C chunks: each chunk's (C, Nl) score block is
+    psum'd across the pod axis (only the owner row contributes) and
+    all-gathered across the node axis — TWO collectives moving C rows,
+    instead of C argmax collectives. Total bytes moved ≈ the score matrix
+    once per attempt, which is the lower bound for exact sequential-greedy
+    semantics (every pod's argmax needs the full row).
+  * inside a chunk the C-step scan is device-local on the replicated free
+    matrix, with bitwise-identical math to select.greedy_assign (same
+    tie_noise, same update order) — sharded results equal single-device
+    results exactly.
+  * gang admission (ops/gang.gang_admission) wraps the attempt INSIDE the
+    shard_map region, so evict/re-admit re-runs only re-gather score
+    chunks — no re-entry, no GSPMD repartitioning per attempt.
+
+Chunk size C divides the pod-shard size, so every chunk has exactly one
+owner row along the pod axis.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..ops.gang import GangResult, gang_admission
+from ..ops.select import NEG, seed_from_key, tie_noise
+from .mesh import NODE_AXIS, POD_AXIS
+
+
+def _chunk_size(p_local: int, target: int = 128) -> int:
+    """Largest divisor of the pod-shard size ≤ target."""
+    c = min(target, p_local)
+    while p_local % c:
+        c -= 1
+    return max(c, 1)
+
+
+def make_sharded_assign(mesh):
+    """Return assign_fn(scores, requests, free0, group_ids, group_min, key)
+    -> GangResult, the drop-in for ops/pipeline's assignment stage on a
+    ("pod", "node") mesh."""
+    pax = mesh.shape[POD_AXIS]
+
+    def assign(scores, requests, free0, group_ids, group_min, key):
+        Ptot, N = scores.shape
+        p_local = Ptot // pax
+        C = _chunk_size(p_local)
+        n_chunks = Ptot // C
+        seed = seed_from_key(key)
+
+        def local(scores_blk, requests_r, free0_r, group_ids_r,
+                  group_min_r, seed_r):
+            my_pod = jax.lax.axis_index(POD_AXIS)
+
+            def attempt_fn(pod_ok):
+                def chunk_body(free, c_idx):
+                    owner = (c_idx * C) // p_local
+                    off = (c_idx * C) % p_local
+                    blk = jax.lax.dynamic_slice(
+                        scores_blk, (off, 0), (C, scores_blk.shape[1]))
+                    # Only the owner pod-row contributes; psum with the
+                    # additive identity broadcasts its block to all rows.
+                    blk = jax.lax.psum(
+                        jnp.where(my_pod == owner, blk, 0.0), POD_AXIS)
+                    blk = jax.lax.all_gather(blk, NODE_AXIS, axis=1,
+                                             tiled=True)        # (C, N)
+
+                    def row(free, j):
+                        i = c_idx * C + j
+                        req = requests_r[i]
+                        fits = jnp.all(free >= req[None, :], axis=1)
+                        s = jnp.where(pod_ok[i] & fits, blk[j], NEG)
+                        m = jnp.max(s)
+                        ok = m > NEG
+                        noise = tie_noise(seed_r, i, N)
+                        tie = (s >= m) & fits
+                        idx = jnp.argmax(
+                            jnp.where(tie, noise, -1.0)).astype(jnp.int32)
+                        safe = jnp.where(ok, idx, 0)
+                        free = free.at[safe].add(jnp.where(ok, -req, 0.0))
+                        return free, (jnp.where(ok, idx, -1), ok)
+
+                    free, (chosen_c, ok_c) = jax.lax.scan(
+                        row, free, jnp.arange(C, dtype=jnp.int32))
+                    return free, (chosen_c, ok_c)
+
+                free_after, (chosen, assigned) = jax.lax.scan(
+                    chunk_body, free0_r,
+                    jnp.arange(n_chunks, dtype=jnp.int32))
+                from ..ops.select import AssignResult
+
+                return AssignResult(chosen=chosen.reshape(Ptot),
+                                    assigned=assigned.reshape(Ptot),
+                                    free_after=free_after)
+
+            return gang_admission(attempt_fn, group_ids_r, group_min_r)
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(POD_AXIS, NODE_AXIS), P(), P(), P(), P(), P()),
+            out_specs=GangResult(chosen=P(), assigned=P(), free_after=P(),
+                                 gang_rejected=P(), group_ok=P()),
+            check_vma=False,
+        )(scores, requests, free0, group_ids, group_min, seed)
+
+    return assign
