@@ -80,46 +80,14 @@ def run_child() -> None:
         detail["error"] = f"backend init: {type(e).__name__}: {e}"[:500]
         emit_and_exit(1)
 
+    from bench_workload import BENCH_PLUGINS, bench_plugin_set, make_workload
     from minisched_tpu.encode import NodeFeatureCache, encode_pods
     from minisched_tpu.ops import build_step
-    from minisched_tpu.plugins import (NodeResourcesBalancedAllocation,
-                                       NodeResourcesFit,
-                                       NodeResourcesLeastAllocated,
-                                       NodeUnschedulable, PluginSet)
-    from minisched_tpu.state.objects import (Node, NodeSpec, NodeStatus,
-                                             ObjectMeta, Pod, PodSpec)
     from minisched_tpu.state.store import ClusterStore
 
-    rng = np.random.default_rng(0)
-    cpu_choices = np.array([4000, 8000, 16000, 32000])
-    node_cpus = cpu_choices[rng.integers(0, len(cpu_choices), n_nodes)]
-    pod_cpus = rng.integers(1, 8, n_pods) * 250
-
-    def make_nodes():
-        return [Node(metadata=ObjectMeta(name=f"node-{i}-{i % 10}",
-                                         labels={"zone": f"z{i % 16}"}),
-                     spec=NodeSpec(unschedulable=bool(i % 97 == 0)),
-                     status=NodeStatus(allocatable={
-                         "cpu": float(node_cpus[i]),
-                         "memory": float(64 << 30), "pods": 110.0}))
-                for i in range(n_nodes)]
-
-    def make_pods():
-        return [Pod(metadata=ObjectMeta(name=f"pod-{i}-{i % 10}",
-                                        namespace="bench"),
-                    spec=PodSpec(requests={"cpu": float(pod_cpus[i]),
-                                           "memory": float(2 << 30)}))
-                for i in range(n_pods)]
-
-    plugins = ["NodeUnschedulable", "NodeResourcesFit",
-               "NodeResourcesLeastAllocated",
-               "NodeResourcesBalancedAllocation"]
-    # Fit scores LeastAllocated by default (upstream parity) — disable its
-    # score point here since LeastAllocated is listed explicitly.
-    plugin_set = PluginSet([NodeUnschedulable(),
-                            NodeResourcesFit(score_strategy=None),
-                            NodeResourcesLeastAllocated(),
-                            NodeResourcesBalancedAllocation()])
+    make_nodes, make_pods = make_workload(n_nodes, n_pods)
+    plugins = BENCH_PLUGINS
+    plugin_set = bench_plugin_set()
     detail["profile"] = plugins
 
     # ---- raw-step bench ------------------------------------------------
@@ -142,6 +110,11 @@ def run_child() -> None:
     nf, names = cache.snapshot(pad=n_pad)
     af = cache.snapshot_assigned()
 
+    # A pallas lowering/compile failure cannot cost this attempt: the
+    # auto-selected step degrades to the lax.scan assignment inside
+    # build_step (ops/pipeline.py guarded wrapper), and the explicit
+    # pallas=True comparison below records kernel breakage as
+    # detail["pallas_error"].
     t0 = time.perf_counter()
     d = step(eb, nf, af, key)
     jax.block_until_ready(d.chosen)

@@ -40,39 +40,18 @@ def main() -> None:
     n_pods = int(os.environ.get("MINISCHED_SHARDED_PODS", "2048"))
     repeats = int(os.environ.get("MINISCHED_SHARDED_REPEATS", "3"))
 
+    from bench_workload import bench_plugin_set, make_workload
     from minisched_tpu.encode import NodeFeatureCache, encode_pods
     from minisched_tpu.ops import build_step
     from minisched_tpu.parallel import (build_sharded_step, make_mesh,
                                         shard_features)
-    from minisched_tpu.plugins import (NodeResourcesBalancedAllocation,
-                                       NodeResourcesFit,
-                                       NodeResourcesLeastAllocated,
-                                       NodeUnschedulable, PluginSet)
-    from minisched_tpu.state.objects import (Node, NodeSpec, NodeStatus,
-                                             ObjectMeta, Pod, PodSpec)
 
-    rng = np.random.default_rng(0)
+    make_nodes, make_pods = make_workload(n_nodes, n_pods)
     cache = NodeFeatureCache(capacity=n_nodes)
-    cpu_choices = np.array([4000, 8000, 16000, 32000])
-    node_cpus = cpu_choices[rng.integers(0, len(cpu_choices), n_nodes)]
-    for i in range(n_nodes):
-        cache.upsert_node(Node(
-            metadata=ObjectMeta(name=f"node-{i}-{i % 10}",
-                                labels={"zone": f"z{i % 16}"}),
-            spec=NodeSpec(unschedulable=bool(i % 97 == 0)),
-            status=NodeStatus(allocatable={
-                "cpu": float(node_cpus[i]), "memory": float(64 << 30),
-                "pods": 110.0})))
-    pod_cpus = rng.integers(1, 8, n_pods) * 250
-    pods = [Pod(metadata=ObjectMeta(name=f"pod-{i}-{i % 10}", namespace="b"),
-                spec=PodSpec(requests={"cpu": float(pod_cpus[i]),
-                                       "memory": float(2 << 30)}))
-            for i in range(n_pods)]
-
-    plugin_set = PluginSet([NodeUnschedulable(),
-                            NodeResourcesFit(score_strategy=None),
-                            NodeResourcesLeastAllocated(),
-                            NodeResourcesBalancedAllocation()])
+    for node in make_nodes():
+        cache.upsert_node(node)
+    pods = make_pods()
+    plugin_set = bench_plugin_set()
     eb = encode_pods(pods, n_pods, registry=cache.registry)
     nf, _names = cache.snapshot(pad=n_nodes)
     af = cache.snapshot_assigned()

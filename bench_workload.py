@@ -1,0 +1,61 @@
+"""Shared synthetic-cluster recipe for bench.py and bench_sharded.py.
+
+One definition of the benchmark workload (node capacity mix, pod request
+mix, plugin profile) so the single-device, engine-through, and sharded
+numbers stay comparable — two drifting copies would silently break the
+parity bars both scripts report against.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_workload(n_nodes: int, n_pods: int, seed: int = 0):
+    """Return (make_nodes, make_pods) thunks for the standard workload:
+    heterogeneous node CPU (4-32 cores), ~1% unschedulable nodes, 16
+    zones; pods request 0.25-1.75 cores + 2 GiB."""
+    from minisched_tpu.state.objects import (Node, NodeSpec, NodeStatus,
+                                             ObjectMeta, Pod, PodSpec)
+
+    rng = np.random.default_rng(seed)
+    cpu_choices = np.array([4000, 8000, 16000, 32000])
+    node_cpus = cpu_choices[rng.integers(0, len(cpu_choices), n_nodes)]
+    pod_cpus = rng.integers(1, 8, n_pods) * 250
+
+    def make_nodes():
+        return [Node(metadata=ObjectMeta(name=f"node-{i}-{i % 10}",
+                                         labels={"zone": f"z{i % 16}"}),
+                     spec=NodeSpec(unschedulable=bool(i % 97 == 0)),
+                     status=NodeStatus(allocatable={
+                         "cpu": float(node_cpus[i]),
+                         "memory": float(64 << 30), "pods": 110.0}))
+                for i in range(n_nodes)]
+
+    def make_pods():
+        return [Pod(metadata=ObjectMeta(name=f"pod-{i}-{i % 10}",
+                                        namespace="bench"),
+                    spec=PodSpec(requests={"cpu": float(pod_cpus[i]),
+                                           "memory": float(2 << 30)}))
+                for i in range(n_pods)]
+
+    return make_nodes, make_pods
+
+
+BENCH_PLUGINS = ["NodeUnschedulable", "NodeResourcesFit",
+                 "NodeResourcesLeastAllocated",
+                 "NodeResourcesBalancedAllocation"]
+
+
+def bench_plugin_set():
+    """The benchmark profile as a constructed PluginSet. Fit scores
+    LeastAllocated by default (upstream parity) — its score point is
+    disabled here since LeastAllocated is listed explicitly."""
+    from minisched_tpu.plugins import (NodeResourcesBalancedAllocation,
+                                       NodeResourcesFit,
+                                       NodeResourcesLeastAllocated,
+                                       NodeUnschedulable, PluginSet)
+
+    return PluginSet([NodeUnschedulable(),
+                      NodeResourcesFit(score_strategy=None),
+                      NodeResourcesLeastAllocated(),
+                      NodeResourcesBalancedAllocation()])

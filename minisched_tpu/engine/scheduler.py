@@ -45,6 +45,105 @@ from .waitingpod import WaitingPod
 log = logging.getLogger(__name__)
 
 
+def arbitrate_rwo(batch: List[QueuedPodInfo], assigned, chosen,
+                  vol_memo: Dict[str, tuple]):
+    """In-batch RWO arbitration → (revoked pod indices, parked gang keys).
+
+    The VolumeRestrictions filter pins pods to a claim's existing mount
+    node, but an UNUSED claim shared by several pods in one batch could be
+    jointly assigned to different nodes. Walk assignments in priority
+    order; the first surviving pod pins each unused claim, later pods
+    choosing a different node are revoked and retried (next cycle sees the
+    pinned claim — sequential RWO semantics without splitting gangs out of
+    the batch).
+
+    "Unused" is judged from the ENCODE-time claim rows the filter itself
+    evaluated (``vol_memo``: pod key → ``_volume_state`` tuple), not a
+    second live cache read: an informer event mounting a claim between
+    encode and commit would make a live read skip arbitration and let two
+    batch pods bind the same RWO claim to different nodes.
+
+    A pin is only binding while its owner survives arbitration: a pinner
+    revoked later (gang atomicity over another claim) must not keep
+    revoking claim-mates against a placement that never commits. Two
+    stages:
+
+    1. an optimistic fixed-point loop where only surviving pods pin
+       (revoked pods are re-checked against live pins each pass, so a pod
+       stays revoked only while a live pin justifies it) — this rescues
+       spuriously-revoked pods;
+    2. a monotone safety closure (pins from survivors, conflicts only ADD
+       revocations, repeated until stable) — at a converged stage-1
+       fixpoint it is a no-op, and in the pathological non-converged case
+       it restores the invariant that no two committed pods bind one
+       claim to two nodes.
+    """
+    from ..state.objects import CLAIM_UNUSED
+
+    parked_gangs: Set[str] = set()  # intra-gang conflicts: unsatisfiable
+
+    def unused_claims(pod: Pod):
+        st = vol_memo.get(pod.key)
+        if st is None:
+            # No encode-time record (a pod without volumes has no claims
+            # either) — nothing to arbitrate.
+            return []
+        return [ck for ck, r in zip(claim_keys(pod), st[1])
+                if r == CLAIM_UNUSED]
+
+    def scan(dead: Set[int], monotone: bool) -> Set[int]:
+        """One arbitration pass. Pods in ``dead`` never pin; they are
+        still checked against live pins unless ``monotone`` (where dead is
+        sticky and needs no re-justification). Returns the revocation set
+        implied by live pins."""
+        claim_pin: Dict[str, tuple] = {}  # ck → (row, pinner's gang)
+        conflicted: Set[int] = set()
+        for i, qpi in enumerate(batch):
+            if not assigned[i] or (monotone and i in dead):
+                continue
+            row = int(chosen[i])
+            gk = gang_key(qpi.pod)
+            alive = i not in dead and not (gk and gk in parked_gangs)
+            for ck in unused_claims(qpi.pod):
+                pin = claim_pin.get(ck)
+                if pin is None:
+                    if alive:
+                        claim_pin[ck] = (row, gk)
+                elif pin[0] != row:
+                    conflicted.add(i)
+                    if gk and gk == pin[1]:
+                        # The conflict is INSIDE one gang: its members
+                        # demand the claim on different nodes; retrying
+                        # reproduces it forever — park the gang
+                        # (terminal, sticky).
+                        parked_gangs.add(gk)
+                    break
+        # Gang atomicity: revoking one member revokes its whole gang —
+        # peers binding at sub-quorum is the partial-allocation deadlock
+        # gang scheduling exists to prevent.
+        gangs = {gang_key(batch[i].pod) for i in conflicted
+                 if batch[i].pod.spec.pod_group} | parked_gangs
+        out = set(conflicted)
+        if gangs:
+            for i, qpi in enumerate(batch):
+                if assigned[i] and gang_key(qpi.pod) in gangs:
+                    out.add(i)
+        return out
+
+    revoked: Set[int] = set()
+    for _ in range(8):  # stage 1: rescue loop
+        new_revoked = scan(revoked, monotone=False)
+        if new_revoked == revoked:
+            break
+        revoked = new_revoked
+    while True:  # stage 2: safety closure (monotone, terminates)
+        grown = revoked | scan(revoked, monotone=True)
+        if grown == revoked:
+            break
+        revoked = grown
+    return revoked, parked_gangs
+
+
 class Scheduler:
     def __init__(self, store, plugin_set: PluginSet,
                  config: Optional[SchedulerConfig] = None,
@@ -229,47 +328,9 @@ class Scheduler:
         if self.recorder is not None:
             self.recorder.record_batch(pods, names, decision, self.plugin_set)
 
-        # In-batch RWO arbitration: the filter pins pods to a claim's
-        # existing mount node, but an UNUSED claim shared by several pods
-        # in this batch could be jointly assigned to different nodes. Walk
-        # assignments in priority order; the first pod pins each unused
-        # claim, later pods choosing a different node are revoked and
-        # retried (next cycle sees the pinned claim — sequential RWO
-        # semantics without splitting gangs out of the batch).
-        claim_pin: Dict[str, tuple] = {}  # ck → (node row, pinner's gang)
-        revoked: Set[int] = set()
-        parked_gangs: Set[str] = set()  # intra-gang conflicts: unsatisfiable
-        if self._rwo_enabled:
-            for i, qpi in enumerate(batch):
-                if assigned[i]:
-                    row = int(chosen[i])
-                    gk = gang_key(qpi.pod)
-                    for ck in claim_keys(qpi.pod):
-                        if self.cache.claim_node_row(ck) != \
-                                NodeFeatureCache.CLAIM_UNUSED:
-                            continue
-                        pin = claim_pin.get(ck)
-                        if pin is None:
-                            claim_pin[ck] = (row, gk)
-                        elif pin[0] != row:
-                            revoked.add(i)
-                            if gk and gk == pin[1]:
-                                # The conflict is INSIDE one gang: its
-                                # members demand the claim on different
-                                # nodes, so retrying reproduces it forever
-                                # — park the gang instead.
-                                parked_gangs.add(gk)
-                            break
-        if revoked:
-            # Gang atomicity: revoking one member must revoke its whole
-            # gang — peers binding at sub-quorum is the partial-allocation
-            # deadlock gang scheduling exists to prevent.
-            gangs = {gang_key(batch[i].pod) for i in revoked
-                     if batch[i].pod.spec.pod_group}
-            if gangs:
-                for i, qpi in enumerate(batch):
-                    if assigned[i] and gang_key(qpi.pod) in gangs:
-                        revoked.add(i)
+        revoked, parked_gangs = (
+            arbitrate_rwo(batch, assigned, chosen, vol_memo)
+            if self._rwo_enabled else (set(), set()))
         for i in revoked:
             if gang_key(batch[i].pod) in parked_gangs:
                 self._handle_failure(
@@ -285,6 +346,18 @@ class Scheduler:
         to_bind: List[tuple] = []  # permit-free (qpi, node_name) pairs
         for i, qpi in enumerate(batch):
             if i in revoked:
+                continue
+            gk = gang_key(qpi.pod)
+            if gk and gk in parked_gangs:
+                # Unassigned members of a parked gang would otherwise fall
+                # through to the retryable BATCH_CAPACITY path and thrash
+                # one extra cycle before being gang-rejected — park the
+                # whole gang in one cycle (assigned members are already in
+                # ``revoked`` via gang atomicity).
+                self._handle_failure(
+                    qpi, {COSCHEDULING},
+                    "gang members demand the same RWO claim on different "
+                    "nodes", retryable=False)
                 continue
             if assigned[i]:
                 node_name = names[int(chosen[i])]

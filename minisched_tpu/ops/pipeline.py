@@ -73,6 +73,10 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
     parallel/sharded_assign.py); ``assign_key`` is its hashable identity
     for the step cache.
     """
+    if assign_fn is not None and assign_key is None:
+        # Without an explicit identity the cache would collide with the
+        # default-assignment step and silently drop the custom stage.
+        assign_key = assign_fn
     cache_key = (
         tuple(p.trace_key() for p in plugin_set.filter_plugins),
         tuple((p.trace_key(), plugin_set.weight_of(p))
@@ -184,8 +188,40 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
         )
 
     jitted = jax.jit(step)
-    _STEP_CACHE[cache_key] = jitted
-    return jitted
+    if pallas is not None or assign_fn is not None:
+        # An EXPLICIT pallas choice must fail loudly (bench.py's
+        # pallas-vs-scan comparison depends on it to surface kernel
+        # breakage); only the auto-selected path degrades.
+        _STEP_CACHE[cache_key] = jitted
+        return jitted
+
+    # pallas=None may auto-select the pallas kernel at trace time. A
+    # lowering/compile failure on an unexpected toolchain must degrade to
+    # the lax.scan assignment (identical results), not poison every
+    # scheduling cycle — and the fallback lives HERE so every consumer
+    # (engine, bench, graft entry) inherits it, not just one call site.
+    # Cost of the broad catch: a non-pallas first-call error pays one
+    # doomed scan-step retrace before propagating.
+    state = {"fn": jitted, "fell_back": False}
+
+    def guarded(eb, nf, af, key):
+        try:
+            return state["fn"](eb, nf, af, key)
+        except Exception:
+            if state["fell_back"]:
+                raise
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "scheduling step failed (pallas path?); retrying with the "
+                "lax.scan assignment")
+            state["fn"] = build_step(plugin_set, explain=explain, cfg=cfg,
+                                     pallas=False)
+            state["fell_back"] = True
+            return state["fn"](eb, nf, af, key)
+
+    _STEP_CACHE[cache_key] = guarded
+    return guarded
 
 
 def max_normalize_100(scores: jnp.ndarray, feasible: jnp.ndarray) -> jnp.ndarray:

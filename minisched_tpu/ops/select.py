@@ -56,7 +56,11 @@ def tie_noise_from_cols(seed: jnp.ndarray, i: jnp.ndarray,
     has no 1D iota)."""
     x = fmix32(cols * jnp.uint32(_COL_MULT) + seed
                + i.astype(jnp.uint32) * jnp.uint32(GOLDEN))
-    return (x >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+    # x>>8 < 2^24, so the detour through int32 is lossless — and required:
+    # Mosaic has no uint32→float32 cast, and this definition must stay
+    # bitwise identical between the scan path and the pallas kernel.
+    return ((x >> 8).astype(jnp.int32).astype(jnp.float32)
+            * jnp.float32(1.0 / (1 << 24)))
 
 
 def tie_noise(seed: jnp.ndarray, i: jnp.ndarray, n: int) -> jnp.ndarray:

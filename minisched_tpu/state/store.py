@@ -103,6 +103,16 @@ class ClusterStore:
 
     # ---- CRUD -----------------------------------------------------------
 
+    # Copy discipline (the client-go contract, one copy per mutation):
+    # the store keeps its own clone of every written object; watch events
+    # and the informer's initial list SHARE those stored snapshots —
+    # stored objects are replacement-only, so a snapshot never mutates
+    # after publication, but consumers must treat event objects as
+    # READ-ONLY (exactly client-go's shared-informer rule; engine/
+    # pvcontroller mutate only fresh get() copies). get()/list() still
+    # return private deep copies the caller may freely mutate. Mutators
+    # return the caller's own (rv-stamped) object, not a third clone.
+
     def create(self, o: Any) -> Any:
         kind = kind_of(o)
         with self._cond:
@@ -115,9 +125,9 @@ class ClusterStore:
                 o.metadata.creation_timestamp = time.time()
             stored = deepcopy_obj(o)
             self._objects[kind][key] = stored
-            self._append(WatchEvent(EventType.ADDED, kind, deepcopy_obj(stored),
+            self._append(WatchEvent(EventType.ADDED, kind, stored,
                                     None, self._rv))
-            return deepcopy_obj(stored)
+            return o
 
     def get(self, kind: str, key: str) -> Any:
         # Stored objects are replacement-only (update/bind deep-copy before
@@ -145,6 +155,16 @@ class ClusterStore:
             old = self._objects[kind].get(key)
             if old is None:
                 raise NotFoundError(f"{kind} {key!r} not found")
+            if old is o:
+                # The caller is holding the published snapshot itself (a
+                # watch-event object) — stamping rv into it would corrupt
+                # the already-delivered event and make the MODIFIED event's
+                # old/new alias one object. Enforce the read-only contract:
+                # mutate a get()/list() copy instead.
+                raise ValueError(
+                    f"update({kind} {key!r}) called with the stored "
+                    "snapshot itself; watch/list_and_watch objects are "
+                    "read-only — mutate a get() copy")
             if check_version and o.metadata.resource_version != old.metadata.resource_version:
                 raise ConflictError(
                     f"{kind} {key!r}: stale resource_version "
@@ -153,9 +173,9 @@ class ClusterStore:
             o.metadata.resource_version = self._rv
             stored = deepcopy_obj(o)
             self._objects[kind][key] = stored
-            self._append(WatchEvent(EventType.MODIFIED, kind, deepcopy_obj(stored),
-                                    deepcopy_obj(old), self._rv))
-            return deepcopy_obj(stored)
+            self._append(WatchEvent(EventType.MODIFIED, kind, stored,
+                                    old, self._rv))
+            return o
 
     def delete(self, kind: str, key: str) -> None:
         with self._cond:
@@ -163,8 +183,8 @@ class ClusterStore:
             if old is None:
                 raise NotFoundError(f"{kind} {key!r} not found")
             self._rv += 1
-            self._append(WatchEvent(EventType.DELETED, kind, deepcopy_obj(old),
-                                    deepcopy_obj(old), self._rv))
+            self._append(WatchEvent(EventType.DELETED, kind, old,
+                                    old, self._rv))
 
     # ---- Typed conveniences --------------------------------------------
 
@@ -236,12 +256,15 @@ class ClusterStore:
     def list_and_watch(self, kinds: Optional[List[str]] = None):
         """Atomic LIST + WATCH: the watcher's cursor is the exact version the
         lists were taken at, so no event is missed or delivered twice
-        (client-go reflector's list-then-watch-from-listRV contract)."""
+        (client-go reflector's list-then-watch-from-listRV contract).
+
+        The returned lists SHARE the stored snapshots (read-only, like the
+        watch events they are delivered alongside) — a 50k-node initial
+        sync must not clone the whole cluster before the first cycle."""
         with self._cond:
-            refs = {k: list(self._objects[k].values())
-                    for k in (kinds or self.KINDS)}
+            lists = {k: list(self._objects[k].values())
+                     for k in (kinds or self.KINDS)}
             watcher = Watcher(self, kinds, self._rv)
-        lists = {k: [deepcopy_obj(o) for o in v] for k, v in refs.items()}
         return lists, watcher
 
     def resource_version(self) -> int:

@@ -29,7 +29,6 @@ def enforce_cpu_only() -> bool:
     import dataclasses
 
     import jax
-    import jax._src.xla_bridge as _xb
 
     def _refuse(name):
         def factory(*a, **k):
@@ -38,10 +37,19 @@ def enforce_cpu_only() -> bool:
         return factory
 
     # Keep registry keys (xb.known_platforms() feeds pallas' lowering
-    # registration); only the factory callable is neutered.
-    for name, reg in list(_xb._backend_factories.items()):
-        if name != "cpu":
-            _xb._backend_factories[name] = dataclasses.replace(
-                reg, factory=_refuse(name), fail_quietly=True)
+    # registration); only the factory callable is neutered. This pokes
+    # private jax internals, so degrade gracefully when a jax upgrade
+    # renames them: jax_platforms=cpu alone still prevents CPU entrypoints
+    # from SELECTING a remote backend — the internals surgery only adds
+    # "cannot even initialize one" hardening on top.
+    try:
+        import jax._src.xla_bridge as _xb
+
+        for name, reg in list(_xb._backend_factories.items()):
+            if name != "cpu":
+                _xb._backend_factories[name] = dataclasses.replace(
+                    reg, factory=_refuse(name), fail_quietly=True)
+    except Exception:  # pragma: no cover - depends on jax version
+        pass
     jax.config.update("jax_platforms", "cpu")
     return True

@@ -300,6 +300,117 @@ def test_duplicate_claim_entries_attach_once():
     assert cache._feats.free[r, vol] == 5.0
 
 
+def _arb_batch(*specs):
+    """Build (batch, assigned, chosen, vol_memo) for arbitrate_rwo from
+    (name, node_row_or_None, gang, claims) tuples; every claim is UNUSED
+    at encode time."""
+    import numpy as np
+
+    from minisched_tpu.engine.queue import QueuedPodInfo
+    from minisched_tpu.state.objects import CLAIM_UNUSED, claim_keys
+
+    batch, rows = [], []
+    vol_memo = {}
+    for name, row, gang, claims in specs:
+        spec = obj.PodSpec(
+            requests={"cpu": 100},
+            volumes=[obj.VolumeClaim(claim_name=c) for c in claims])
+        if gang:
+            spec.pod_group, spec.pod_group_min = gang, 1
+        pod = obj.Pod(metadata=obj.ObjectMeta(name=name, namespace="d"),
+                      spec=spec)
+        batch.append(QueuedPodInfo(pod=pod))
+        rows.append(-1 if row is None else row)
+        vol_memo[pod.key] = (True, [CLAIM_UNUSED] * len(claim_keys(pod)))
+    assigned = np.array([r >= 0 for r in rows])
+    chosen = np.array([max(r, 0) for r in rows])
+    return batch, assigned, chosen, vol_memo
+
+
+def test_arbitrate_rwo_basic_conflict_and_pin():
+    """Second pod choosing a different node for a shared unused claim is
+    revoked; same-node sharers and unrelated claims are untouched."""
+    from minisched_tpu.engine.scheduler import arbitrate_rwo
+
+    batch, a, c, memo = _arb_batch(
+        ("p0", 1, None, ["x"]),   # pins x@1
+        ("p1", 2, None, ["x"]),   # conflict → revoked
+        ("p2", 1, None, ["x"]),   # same node → fine
+        ("p3", 3, None, ["y"]))   # unrelated claim → fine
+    revoked, parked = arbitrate_rwo(batch, a, c, memo)
+    assert revoked == {1} and not parked
+
+
+def test_arbitrate_rwo_rescues_victims_of_revoked_pinner():
+    """ADVICE r1: a pod revoked only by a pin whose owner is itself
+    revoked (gang atomicity over another claim) must be rescued — and the
+    rescued pod becomes the new pinner for later conflicts."""
+    from minisched_tpu.engine.scheduler import arbitrate_rwo
+
+    batch, a, c, memo = _arb_batch(
+        ("hi", 1, None, ["a"]),      # pins a@1
+        ("g1", 2, "G", ["a"]),       # conflicts on a → gang G revoked
+        ("g2", 2, "G", ["b"]),       # pinned b@2 — but dies with its gang
+        ("low", 3, None, ["b"]))     # b@3 conflicted with g2's pin only
+    revoked, parked = arbitrate_rwo(batch, a, c, memo)
+    # g1+g2 revoked (gang atomicity); low is RESCUED: its only conflict
+    # was against g2's never-committing pin.
+    assert revoked == {1, 2} and not parked
+
+
+def test_arbitrate_rwo_rescued_pod_pins_for_later_pods():
+    """After a rescue, the survivor's pin governs later same-claim pods —
+    the closure must still revoke a genuinely conflicting straggler."""
+    from minisched_tpu.engine.scheduler import arbitrate_rwo
+
+    batch, a, c, memo = _arb_batch(
+        ("hi", 1, None, ["a"]),      # pins a@1
+        ("g1", 2, "G", ["a"]),       # conflict → gang G revoked
+        ("g2", 2, "G", ["b"]),       # transient pin b@2
+        ("mid", 3, None, ["b"]),     # rescued → pins b@3
+        ("tail", 4, None, ["b"]))    # conflicts with the RESCUED pin b@3
+    revoked, parked = arbitrate_rwo(batch, a, c, memo)
+    assert revoked == {1, 2, 4} and not parked
+
+
+def test_arbitrate_rwo_intra_gang_conflict_parks_gang():
+    """Gang members demanding one claim on different nodes can never
+    succeed — the gang parks (terminal) instead of retrying forever."""
+    from minisched_tpu.engine.scheduler import arbitrate_rwo
+
+    batch, a, c, memo = _arb_batch(
+        ("g1", 1, "G", ["x"]),
+        ("g2", 2, "G", ["x"]),       # same gang, different node, same claim
+        ("bystander", 5, None, ["y"]))
+    revoked, parked = arbitrate_rwo(batch, a, c, memo)
+    assert parked == {"d/G"} and revoked == {0, 1}  # gang keys are ns-scoped
+
+
+def test_arbitrate_rwo_no_two_survivors_share_claim_differently():
+    """Safety invariant under a cascade: whatever the rescue loop does,
+    committed pods never bind one claim to two nodes."""
+    from minisched_tpu.engine.scheduler import arbitrate_rwo
+
+    # Adversarial mix: chained claims across two gangs plus loners.
+    batch, a, c, memo = _arb_batch(
+        ("p0", 1, None, ["a"]),
+        ("g1", 2, "G", ["a", "b"]),
+        ("g2", 3, "G", ["c"]),
+        ("h1", 3, "H", ["b", "c"]),
+        ("h2", 4, "H", ["d"]),
+        ("p5", 5, None, ["d", "a"]),
+        ("p6", 1, None, ["a", "d"]))
+    revoked, parked = arbitrate_rwo(batch, a, c, memo)
+    from minisched_tpu.state.objects import claim_keys
+    survivors = [i for i in range(len(batch)) if i not in revoked]
+    placed = {}
+    for i in survivors:
+        for ck in claim_keys(batch[i].pod):
+            prev = placed.setdefault(ck, int(c[i]))
+            assert prev == int(c[i]), (
+                f"claim {ck} bound to rows {prev} and {int(c[i])}")
+
+
 def test_volume_capacity_respected_within_one_batch(cluster):
     """Volumes are a resource axis, so the capacity-aware greedy assignment
     must not over-commit attach slots even when all pods arrive in ONE
