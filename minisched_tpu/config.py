@@ -23,6 +23,12 @@ class SchedulerConfig:
     """Engine tuning knobs."""
 
     max_batch_size: int = 1024       # pods per scheduling step
+    # Batch formation window (s): after the first pod arrives, keep
+    # gathering until max_batch_size or this much time passes. 0 = pop
+    # immediately (lowest latency); bursty arrival benefits from a small
+    # window (full deterministic batches → stable pad buckets, no
+    # mid-burst recompiles).
+    batch_window_s: float = 0.0
     pod_bucket_min: int = 16         # bucket ladder minimum (pad P)
     node_bucket_min: int = 16        # bucket ladder minimum (pad N)
     backoff_initial_s: float = 1.0   # reference queue.go:218-221

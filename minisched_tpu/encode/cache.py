@@ -121,60 +121,81 @@ class NodeFeatureCache:
         accounts a still-pending pod onto its selected node without
         mutating (or copying) the queued object."""
         with self._lock:
-            i = self._index.get(node_name or pod.spec.node_name)
-            if i is None or pod.key in self._bound:
-                return
-            req = F.resources_vector(pod_requests(pod))
-            ports = [p.host_port for p in pod.spec.ports if p.host_port]
-            claims = claim_keys(pod)
-            if claims:
-                # Attach slots are per-claim-per-node, not per-pod: a claim
-                # already mounted on this node costs no new slot; the slot
-                # frees only when the LAST mounting pod leaves (see
-                # _drop_claims). The stored req's generic volume component
-                # is zeroed — the claim table owns that axis. Cloud-typed
-                # claims stay per-pod on their own axes (already in req).
-                # A claim's typedness is decided at its FIRST mount and is
-                # sticky for the mount epoch — charge and release must be
-                # symmetric even if later pods reference the same claim
-                # with a different volume_type.
-                ns = pod.metadata.namespace
-                for v in pod.spec.volumes:
-                    ck = f"{ns}/{v.claim_name}"
-                    if (ck not in self._claims
-                            and v.volume_type in obj_mod.CLOUD_VOLUME_AXES):
-                        self._typed_claims.add(ck)
-                newly = sum(1 for ck in claims
-                            if ck not in self._typed_claims
-                            and not self._claims.get(ck, {}).get(i))
-                req[_VOL] = 0.0
-                self._feats.free[i, _VOL] -= newly
-            self._bound[pod.key] = (i, req, ports, claims)
-            self._feats.free[i] -= req
-            self._add_ports(i, ports)
-            for ck in claims:
-                rows = self._claims.setdefault(ck, {})
-                rows[i] = rows.get(i, 0) + 1
-            group = gang_key(pod)
-            if group:
-                self._key_gang[pod.key] = group
-                self._gang_bound[group] = self._gang_bound.get(group, 0) + 1
-
-            a = self._alloc_assigned_row()
-            self._a_row[pod.key] = a
-            self._assigned.valid[a] = True
-            self._assigned.node_row[a] = i
-            self._assigned.ns_hash[a] = (F._h(pod.metadata.namespace)
-                                         if pod.metadata.namespace else 0)
-            self._assigned.label_pairs[a] = 0
-            labels = list(pod.metadata.labels.items())
-            if len(labels) > self.cfg.max_labels:
-                self.overflow.append(
-                    f"assigned pod {pod.key} labels: {len(labels)} > "
-                    f"{self.cfg.max_labels} slots")
-            for j, (k, v) in enumerate(labels[:self.cfg.max_labels]):
-                self._assigned.label_pairs[a, j] = F.pair_hash(k, v)
+            self._account_bind_locked(pod, node_name)
             self.version += 1
+
+    def account_bind_bulk(self, items, req_rows=None) -> None:
+        """Assume a whole batch in one lock acquisition: ``items`` is a
+        list of (pod, node_name). ``req_rows`` optionally supplies the
+        encoder's request rows (encode.PodFeatures.requests) so the
+        dominant per-pod cost — rebuilding the request vector — is skipped.
+        Only volume-free pods may reuse their encoded row: for pods with
+        volumes the encoder folds unused-claim attach slots into the row,
+        which bind accounting must instead route through the claim table."""
+        with self._lock:
+            for k, (pod, node_name) in enumerate(items):
+                req = None
+                if req_rows is not None and not pod.spec.volumes:
+                    req = np.array(req_rows[k], dtype=np.float32)
+                self._account_bind_locked(pod, node_name, req)
+            self.version += 1
+
+    def _account_bind_locked(self, pod: Pod, node_name: str = "",
+                             req: Optional[np.ndarray] = None) -> None:
+        i = self._index.get(node_name or pod.spec.node_name)
+        if i is None or pod.key in self._bound:
+            return
+        if req is None:
+            req = F.resources_vector(pod_requests(pod))
+        ports = [p.host_port for p in pod.spec.ports if p.host_port]
+        claims = claim_keys(pod)
+        if claims:
+            # Attach slots are per-claim-per-node, not per-pod: a claim
+            # already mounted on this node costs no new slot; the slot
+            # frees only when the LAST mounting pod leaves (see
+            # _drop_claims). The stored req's generic volume component
+            # is zeroed — the claim table owns that axis. Cloud-typed
+            # claims stay per-pod on their own axes (already in req).
+            # A claim's typedness is decided at its FIRST mount and is
+            # sticky for the mount epoch — charge and release must be
+            # symmetric even if later pods reference the same claim
+            # with a different volume_type.
+            ns = pod.metadata.namespace
+            for v in pod.spec.volumes:
+                ck = f"{ns}/{v.claim_name}"
+                if (ck not in self._claims
+                        and v.volume_type in obj_mod.CLOUD_VOLUME_AXES):
+                    self._typed_claims.add(ck)
+            newly = sum(1 for ck in claims
+                        if ck not in self._typed_claims
+                        and not self._claims.get(ck, {}).get(i))
+            req[_VOL] = 0.0
+            self._feats.free[i, _VOL] -= newly
+        self._bound[pod.key] = (i, req, ports, claims)
+        self._feats.free[i] -= req
+        self._add_ports(i, ports)
+        for ck in claims:
+            rows = self._claims.setdefault(ck, {})
+            rows[i] = rows.get(i, 0) + 1
+        group = gang_key(pod)
+        if group:
+            self._key_gang[pod.key] = group
+            self._gang_bound[group] = self._gang_bound.get(group, 0) + 1
+
+        a = self._alloc_assigned_row()
+        self._a_row[pod.key] = a
+        self._assigned.valid[a] = True
+        self._assigned.node_row[a] = i
+        self._assigned.ns_hash[a] = (F._h(pod.metadata.namespace)
+                                     if pod.metadata.namespace else 0)
+        self._assigned.label_pairs[a] = 0
+        labels = list(pod.metadata.labels.items())
+        if len(labels) > self.cfg.max_labels:
+            self.overflow.append(
+                f"assigned pod {pod.key} labels: {len(labels)} > "
+                f"{self.cfg.max_labels} slots")
+        for j, (k, v) in enumerate(labels[:self.cfg.max_labels]):
+            self._assigned.label_pairs[a, j] = F.pair_hash(k, v)
 
     def account_unbind(self, pod_key: str) -> None:
         """Bound pod deleted/unbound: return its requests to the node."""

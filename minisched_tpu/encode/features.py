@@ -21,6 +21,7 @@ SURVEY §7 "dynamic shapes").
 """
 from __future__ import annotations
 
+import functools
 import zlib
 from dataclasses import dataclass
 from typing import Dict, List, NamedTuple, Optional, Tuple
@@ -95,14 +96,19 @@ HOSTNAME_KEY = "kubernetes.io/hostname"
 DEFAULT_ENCODING = EncodingConfig()
 
 
+@functools.lru_cache(maxsize=1 << 16)
 def _h(s: str) -> int:
-    """Deterministic 32-bit string hash, never the 0 sentinel."""
+    """Deterministic 32-bit string hash, never the 0 sentinel. Memoized:
+    label keys/values repeat massively across a cluster (50k nodes share a
+    handful of zone labels), so encoding cost is dominated by dictionary
+    hits, not crc32 + encode."""
     v = zlib.crc32(s.encode()) & 0xFFFFFFFF
     v = v if v != 0 else 1
     # map to int32 range
     return v - (1 << 32) if v >= (1 << 31) else v
 
 
+@functools.lru_cache(maxsize=1 << 16)
 def pair_hash(key: str, value: str) -> int:
     return _h(f"{key}={value}")
 

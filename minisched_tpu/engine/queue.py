@@ -210,11 +210,19 @@ class SchedulingQueue:
 
     # ---- consumer -------------------------------------------------------
 
-    def pop_batch(self, max_n: int, timeout: Optional[float] = None
-                  ) -> List[QueuedPodInfo]:
+    def pop_batch(self, max_n: int, timeout: Optional[float] = None,
+                  gather_window: float = 0.0) -> List[QueuedPodInfo]:
         """Block until activeQ is non-empty (condvar — fixes the busy-wait at
         reference queue.go:84-92), then pop up to max_n pods ordered by
-        descending priority (stable FIFO within a priority)."""
+        descending priority (stable FIFO within a priority).
+
+        ``gather_window``: after the first pod arrives, keep gathering up
+        to that many seconds (or until max_n pods are queued) before
+        popping. An arrival burst otherwise fragments into partial batches
+        whose differing pad buckets each pay an XLA compile; a small
+        window makes batch formation deterministic and full-sized. 0
+        preserves pop-immediately semantics (the latency-sensitive
+        default)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             while self._active_live == 0 and not self._closed:
@@ -227,6 +235,21 @@ class SchedulingQueue:
                     self._cond.wait(1.0)
             if self._closed:
                 return []
+            if gather_window > 0:
+                # Gather until FULL or the window expires — deliberately
+                # no arrival-idle heuristic: informer stalls (gen-2 GC
+                # over a 60k-object cluster pauses every thread for
+                # 100ms+) masquerade as end-of-burst and split off tiny
+                # straggler batches with their own cold pad buckets.
+                # Callers size the window as the burst-latency budget.
+                gather_end = time.monotonic() + gather_window
+                while self._active_live < max_n and not self._closed:
+                    remaining = gather_end - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                if self._closed:
+                    return []
             live = [q for q in self._active if not q.gone]
             live.sort(key=lambda q: -q.pod.spec.priority)
             batch, self._active = live[:max_n], live[max_n:]

@@ -202,19 +202,27 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
     # (engine, bench, graft entry) inherits it, not just one call site.
     # Cost of the broad catch: a non-pallas first-call error pays one
     # doomed scan-step retrace before propagating.
-    state = {"fn": jitted, "fell_back": False}
+    state = {"fn": jitted, "fell_back": False, "succeeded": False}
 
     def guarded(eb, nf, af, key):
         try:
-            return state["fn"](eb, nf, af, key)
+            out = state["fn"](eb, nf, af, key)
+            state["succeeded"] = True
+            return out
         except Exception:
-            if state["fell_back"]:
+            # Only a step that has NEVER run falls back — that's the
+            # lowering/compile-failure case this guard exists for. Once
+            # the pallas path has produced a batch, an exception is a
+            # transient runtime error (preempted chip, HBM pressure):
+            # latching onto the ~11x slower scan for the process
+            # lifetime would be the wrong trade — propagate instead.
+            if state["fell_back"] or state["succeeded"]:
                 raise
             import logging
 
             logging.getLogger(__name__).exception(
-                "scheduling step failed (pallas path?); retrying with the "
-                "lax.scan assignment")
+                "scheduling step failed on first call (pallas lowering?); "
+                "retrying with the lax.scan assignment")
             state["fn"] = build_step(plugin_set, explain=explain, cfg=cfg,
                                      pallas=False)
             state["fell_back"] = True
