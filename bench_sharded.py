@@ -93,6 +93,21 @@ def main() -> None:
     out["ratio_sharded_vs_single"] = round(
         out["sharded_step_s"] / max(out["single_device_s"], 1e-9), 2)
     out["scheduled"] = int(np.asarray(ds.assigned).sum())
+
+    # auction mode under plain GSPMD (BASELINE config 5): parallel bidding
+    # rounds — one collective per round instead of per pod.
+    step_a = build_sharded_step(plugin_set, mesh, eb, nf, af,
+                                assignment="auction")
+    da = step_a(eb_d, nf_d, af_d, key)
+    jax.block_until_ready(da.chosen)
+    t = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        da = step_a(eb_d, nf_d, af_d, key)
+        jax.block_until_ready(da.chosen)
+        t.append(time.perf_counter() - t0)
+    out["sharded_auction_s"] = round(min(t), 4)
+    out["auction_scheduled"] = int(np.asarray(da.assigned).sum())
     print(json.dumps(out))
 
 

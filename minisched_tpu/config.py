@@ -34,6 +34,11 @@ class SchedulerConfig:
     backoff_initial_s: float = 1.0   # reference queue.go:218-221
     backoff_max_s: float = 10.0
     explain: bool = False            # return full per-plugin matrices
+    # Host-selection strategy: "greedy" (priority-faithful sequential
+    # semantics; scan or pallas kernel) or "auction" (parallel bidding
+    # rounds, aggregate-score-seeking — ops/auction.py docstring lists
+    # the semantic deviations).
+    assignment: str = "greedy"
     seed: int = 0                    # PRNG seed for tie-breaking parity
     bind_workers: int = 16           # async binding-cycle pool size
     platform: str = ""               # "" = whatever jax picks; or cpu/tpu

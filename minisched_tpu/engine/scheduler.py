@@ -186,7 +186,8 @@ class Scheduler:
         self.informer_factory = InformerFactory(store)
         eventhandlers.add_all_event_handlers(self, self.informer_factory)
 
-        self._step = build_step(plugin_set, explain=self.config.explain)
+        self._step = build_step(plugin_set, explain=self.config.explain,
+                                assignment=self.config.assignment)
         self._key = jax.random.PRNGKey(self.config.seed)
         self._step_counter = 0
         self.waiting_pods: Dict[str, WaitingPod] = {}

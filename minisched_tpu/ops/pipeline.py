@@ -53,6 +53,7 @@ _STEP_CACHE: dict = {}
 def build_step(plugin_set: PluginSet, *, explain: bool = False,
                cfg: EncodingConfig = DEFAULT_ENCODING,
                pallas: Optional[bool] = None,
+               assignment: str = "greedy",
                assign_fn=None, assign_key=None):
     """Compile the scheduling step for a plugin profile.
 
@@ -67,12 +68,21 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
     None = auto: on TPU when the node axis is lane-tiled. The sharded
     builder passes False — a Mosaic kernel can't be GSPMD-partitioned.
 
+    ``assignment``: "greedy" (default; priority-faithful sequential
+    semantics, scan or pallas) or "auction" (ops/auction.py — parallel
+    bidding rounds, aggregate-score-seeking, GSPMD-friendly; see its
+    module docstring for the semantic deviations).
+
     ``assign_fn(masked_total, requests, free, group, min_count, key) ->
     GangResult`` overrides the whole assignment stage (the sharded builder
     supplies the shard_map chunked-gather scan,
     parallel/sharded_assign.py); ``assign_key`` is its hashable identity
     for the step cache.
     """
+    if assignment not in ("greedy", "auction"):
+        raise ValueError(
+            f"unknown assignment strategy {assignment!r}; "
+            "expected 'greedy' or 'auction'")
     if assign_fn is not None and assign_key is None:
         # Without an explicit identity the cache would collide with the
         # default-assignment step and silently drop the custom stage.
@@ -81,7 +91,7 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
         tuple(p.trace_key() for p in plugin_set.filter_plugins),
         tuple((p.trace_key(), plugin_set.weight_of(p))
               for p in plugin_set.score_plugins),
-        explain, cfg, pallas, assign_key,
+        explain, cfg, pallas, assignment, assign_key,
     )
     cached = _STEP_CACHE.get(cache_key)
     if cached is not None:
@@ -141,20 +151,25 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
                 masked_total, pf.requests, nf.free,
                 eb.gang.group, eb.gang.min_count, key)
         else:
-            # Trace-time choice of the inner assignment: pallas kernel on
-            # TPU (identical results to the scan,
-            # tests/test_pallas_select.py), lax.scan elsewhere.
+            # Trace-time choice of the inner assignment: auction mode if
+            # configured; else pallas kernel on TPU (identical results to
+            # the scan, tests/test_pallas_select.py), lax.scan elsewhere.
             # Re-evaluated per shape bucket at retrace.
-            use_pallas = pallas
-            if use_pallas is None:
-                from .pallas_select import pallas_supported
-
-                use_pallas = pallas_supported(N)
             greedy_fn = None
-            if use_pallas:
-                from .pallas_select import greedy_assign_pallas
+            if assignment == "auction":
+                from .auction import auction_assign
 
-                greedy_fn = greedy_assign_pallas
+                greedy_fn = auction_assign
+            else:
+                use_pallas = pallas
+                if use_pallas is None:
+                    from .pallas_select import pallas_supported
+
+                    use_pallas = pallas_supported(N)
+                if use_pallas:
+                    from .pallas_select import greedy_assign_pallas
+
+                    greedy_fn = greedy_assign_pallas
             # Gang-aware joint assignment (ops/gang.py); with no gangs in
             # the batch this reduces to plain capacity-aware greedy
             # assignment.
@@ -188,10 +203,11 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
         )
 
     jitted = jax.jit(step)
-    if pallas is not None or assign_fn is not None:
+    if pallas is not None or assign_fn is not None or assignment != "greedy":
         # An EXPLICIT pallas choice must fail loudly (bench.py's
         # pallas-vs-scan comparison depends on it to surface kernel
-        # breakage); only the auto-selected path degrades.
+        # breakage); only the auto-selected pallas path degrades. Auction
+        # mode never auto-selects the kernel, so it has nothing to guard.
         _STEP_CACHE[cache_key] = jitted
         return jitted
 
@@ -202,21 +218,27 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
     # (engine, bench, graft entry) inherits it, not just one call site.
     # Cost of the broad catch: a non-pallas first-call error pays one
     # doomed scan-step retrace before propagating.
-    state = {"fn": jitted, "fell_back": False, "succeeded": False}
+    state = {"fn": jitted, "fell_back": False, "ok_shapes": set()}
 
     def guarded(eb, nf, af, key):
+        # Success is tracked PER SHAPE BUCKET: each bucket retraces (and
+        # may pick the pallas kernel for the first time, e.g. when node
+        # growth crosses the lane-tile threshold), so an any-success latch
+        # would wrongly disable the fallback exactly where a fresh
+        # lowering can first fail.
+        shape = (eb.pf.valid.shape[0], nf.valid.shape[0])
         try:
             out = state["fn"](eb, nf, af, key)
-            state["succeeded"] = True
+            state["ok_shapes"].add(shape)
             return out
         except Exception:
-            # Only a step that has NEVER run falls back — that's the
+            # Only a bucket that has NEVER run falls back — that's the
             # lowering/compile-failure case this guard exists for. Once
-            # the pallas path has produced a batch, an exception is a
+            # this bucket has produced a batch, an exception is a
             # transient runtime error (preempted chip, HBM pressure):
             # latching onto the ~11x slower scan for the process
             # lifetime would be the wrong trade — propagate instead.
-            if state["fell_back"] or state["succeeded"]:
+            if state["fell_back"] or shape in state["ok_shapes"]:
                 raise
             import logging
 

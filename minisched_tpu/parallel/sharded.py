@@ -19,27 +19,37 @@ from .mesh import NODE_AXIS, POD_AXIS, feature_shardings
 
 
 def build_sharded_step(plugin_set: PluginSet, mesh, eb_template, nf_template,
-                       af_template, *, explain: bool = False):
+                       af_template, *, explain: bool = False,
+                       assignment: str = "greedy"):
     """Compile the scheduling step with mesh shardings.
 
     The templates supply leaf ranks for the sharding specs (any correctly-
     shaped EncodedBatch / NodeFeatures / AssignedPodFeatures). Returns
     ``step(eb, nf, af, key) -> Decision`` with inputs auto-partitioned.
+
+    ``assignment="auction"`` keeps the auction's parallel bidding rounds
+    under plain GSPMD — every round is dense (P,N)/(P,) math that
+    partitions over the mesh with one collective per round, which is the
+    whole point of the mode (ops/auction.py).
     """
     eb_sh, nf_sh, af_sh = feature_shardings(mesh, eb_template, nf_template,
                                             af_template)
     key_sh = NamedSharding(mesh, P())  # replicated PRNG key
 
-    # Reuse the single-chip traced computation for the filter/score math
-    # (GSPMD inserts its collectives), but swap the assignment stage for
-    # the shard_map chunked-gather scan (sharded_assign.py) — the plain
-    # GSPMD partitioning of the P-step scan costs one cross-shard argmax
-    # collective per pod per gang attempt.
-    from .sharded_assign import make_sharded_assign
+    if assignment == "auction":
+        inner = build_step(plugin_set, explain=explain, pallas=False,
+                           assignment="auction")
+    else:
+        # Reuse the single-chip traced computation for the filter/score
+        # math (GSPMD inserts its collectives), but swap the assignment
+        # stage for the shard_map chunked-gather scan (sharded_assign.py)
+        # — the plain GSPMD partitioning of the P-step scan costs one
+        # cross-shard argmax collective per pod per gang attempt.
+        from .sharded_assign import make_sharded_assign
 
-    inner = build_step(plugin_set, explain=explain, pallas=False,
-                       assign_fn=make_sharded_assign(mesh),
-                       assign_key=("sharded", id(mesh)))
+        inner = build_step(plugin_set, explain=explain, pallas=False,
+                           assign_fn=make_sharded_assign(mesh),
+                           assign_key=("sharded", id(mesh)))
 
     def stepfn(eb, nf, af, key):
         return inner(eb, nf, af, key)

@@ -1,0 +1,155 @@
+"""Auction assignment (ops/auction.py — BASELINE config 5's batched
+Hungarian/auction mode): capacity safety, convergence, contention
+resolution, gang composition, and engine integration."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from minisched_tpu.ops.auction import auction_assign
+from minisched_tpu.ops.gang import gang_assign
+from minisched_tpu.ops.select import NEG, greedy_assign
+
+
+def rand_instance(P, N, R=4, seed=0, infeasible_frac=0.2,
+                  cap_lo=2, cap_hi=6):
+    rng = np.random.default_rng(seed)
+    scores = rng.random((P, N)).astype(np.float32) * 100.0
+    scores[rng.random((P, N)) < infeasible_frac] = float(NEG)
+    requests = (rng.integers(1, 4, (P, R)) * 100).astype(np.float32)
+    free = (rng.integers(cap_lo, cap_hi, (N, R)) * 300).astype(np.float32)
+    return (jnp.array(scores), jnp.array(requests), jnp.array(free))
+
+
+def check_valid(scores, requests, free0, res):
+    """Assignment invariants shared by every mode: only feasible pairs,
+    capacity never violated, free_after consistent."""
+    chosen = np.asarray(res.chosen)
+    assigned = np.asarray(res.assigned)
+    s, req, f0 = map(np.asarray, (scores, requests, free0))
+    used = np.zeros_like(f0)
+    for i in np.flatnonzero(assigned):
+        assert s[i, chosen[i]] > float(NEG), f"pod {i} on infeasible node"
+        used[chosen[i]] += req[i]
+    assert (f0 - used >= -1e-3).all(), "capacity over-committed"
+    np.testing.assert_allclose(np.asarray(res.free_after), f0 - used,
+                               rtol=0, atol=1e-3)
+
+
+def test_auction_assigns_all_when_capacity_abundant():
+    scores, req, free = rand_instance(64, 256, seed=1)
+    res = auction_assign(scores, req, free, jax.random.PRNGKey(0))
+    check_valid(scores, req, free, res)
+    # every pod has ~80% feasible nodes and capacity is plentiful
+    assert int(np.asarray(res.assigned).sum()) == 64
+
+
+def test_auction_capacity_contention_never_overcommits():
+    # 32 pods, 4 nodes, each node fits ~3 pods on the binding axis
+    rng = np.random.default_rng(2)
+    scores = jnp.array(rng.random((32, 4)).astype(np.float32) * 10)
+    req = jnp.array(np.full((32, 2), 100.0, np.float32))
+    free = jnp.array(np.full((4, 2), 350.0, np.float32))
+    res = auction_assign(scores, req, free, jax.random.PRNGKey(1))
+    check_valid(scores, req, free, res)
+    assert int(np.asarray(res.assigned).sum()) == 12  # 4 nodes x 3 slots
+
+
+def test_auction_deterministic_in_key():
+    scores, req, free = rand_instance(48, 32, seed=3)
+    a = auction_assign(scores, req, free, jax.random.PRNGKey(7))
+    b = auction_assign(scores, req, free, jax.random.PRNGKey(7))
+    assert np.array_equal(np.asarray(a.chosen), np.asarray(b.chosen))
+
+
+def test_auction_matches_greedy_assignment_count():
+    """Auction and greedy may pick different nodes, but on instances with
+    per-pod-disjoint contention both must schedule the same number."""
+    scores, req, free = rand_instance(128, 512, seed=4)
+    g = greedy_assign(scores, req, free, jax.random.PRNGKey(0))
+    a = auction_assign(scores, req, free, jax.random.PRNGKey(0))
+    check_valid(scores, req, free, a)
+    assert (int(np.asarray(a.assigned).sum())
+            == int(np.asarray(g.assigned).sum()) == 128)
+
+
+def test_auction_prefers_higher_aggregate_score_under_contention():
+    """The showcase case: one contended node where greedy's priority
+    order strands the second pod, auction routes around it.
+
+    pod0 (higher priority row) : nodeA 10.0, nodeB 9.0
+    pod1                       : nodeA 12.0 only
+    Greedy gives A to pod0 (its own best) -> pod1 unassigned (total 10).
+    Auction: pod1's 12.0 bid deterministically beats pod0's 10.0 in round
+    one; pod0 is priced off A within two rounds and lands on B (total 21).
+    """
+    scores = jnp.array([[10.0, 9.0], [12.0, float(NEG)]], jnp.float32)
+    req = jnp.array([[100.0], [100.0]], jnp.float32)
+    free = jnp.array([[100.0], [100.0]], jnp.float32)  # one pod per node
+    g = greedy_assign(scores, req, free, jax.random.PRNGKey(0))
+    assert int(np.asarray(g.assigned).sum()) == 1  # greedy strands pod1
+    a = auction_assign(scores, req, free, jax.random.PRNGKey(0))
+    chosen = np.asarray(a.chosen)
+    assert int(np.asarray(a.assigned).sum()) == 2
+    assert chosen[0] == 1 and chosen[1] == 0
+
+
+def test_auction_composes_with_gang_admission():
+    """gang_assign(greedy_fn=auction_assign): a gang that cannot meet
+    quorum is rejected whole; ungrouped pods are unaffected."""
+    P, N = 6, 4
+    scores = jnp.full((P, N), 5.0, jnp.float32)
+    req = jnp.full((P, 1), 100.0, jnp.float32)
+    free = jnp.full((N, 1), 100.0, jnp.float32)  # 4 slots for 6 pods
+    # gang of 3 (ids 0) needs all 3; 3 loners (id -1)
+    group = jnp.array([0, 0, 0, -1, -1, -1], jnp.int32)
+    gmin = jnp.array([3], jnp.int32)
+    res = gang_assign(scores, req, free, group, gmin,
+                      jax.random.PRNGKey(0), greedy_fn=auction_assign)
+    assigned = np.asarray(res.assigned)
+    rejected = np.asarray(res.gang_rejected)
+    if bool(np.asarray(res.group_ok)[0]):
+        assert assigned[:3].all()  # whole gang in
+    else:
+        assert not assigned[:3].any() and rejected[:3].all()
+    # loners always fit (>=1 slot left in either branch)
+    assert assigned[3:].sum() >= 1
+    # never over-committed
+    used = sum(1 for i in range(P) if assigned[i])
+    assert used <= N
+
+
+def test_auction_engine_end_to_end():
+    """SchedulerConfig(assignment='auction') drives the real engine."""
+    from minisched_tpu.config import SchedulerConfig
+    from minisched_tpu.scenario import Cluster
+    from minisched_tpu.service.defaultconfig import Profile
+
+    c = Cluster()
+    try:
+        c.start(profile=Profile(plugins=["NodeUnschedulable",
+                                         "NodeResourcesFit"]),
+                config=SchedulerConfig(assignment="auction",
+                                       backoff_initial_s=0.05,
+                                       backoff_max_s=0.2),
+                with_pv_controller=False)
+        for i in range(4):
+            c.create_node(f"au-n{i}", cpu=1000)
+        for i in range(8):
+            c.create_pod(f"au-p{i}", cpu=400)  # 2 per node fit
+        bound = 0
+        import time
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            pods = [c.get_pod(f"au-p{i}") for i in range(8)]
+            bound = sum(1 for p in pods if p.spec.node_name)
+            if bound == 8:
+                break
+            time.sleep(0.05)
+        assert bound == 8
+        per_node = {}
+        for p in pods:
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+        assert max(per_node.values()) <= 2  # capacity respected
+    finally:
+        c.shutdown()

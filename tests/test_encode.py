@@ -90,6 +90,46 @@ def test_bind_accounting_and_unbind():
     assert 8080 not in nf.used_ports[row]
 
 
+def test_account_bind_bulk_matches_sequential():
+    """The bulk assume path (one lock, encoder request rows reused) must
+    leave the cache in exactly the state the per-pod path produces —
+    including volume-bearing pods, which take the claim-table slow path."""
+    from minisched_tpu.state.objects import VolumeClaim
+
+    def build(pods, bulk):
+        c = NodeFeatureCache()
+        for i in range(4):
+            c.upsert_node(node(f"n{i}", cpu=10_000))
+        if bulk:
+            eb = encode_pods(pods, 16, registry=c.registry)
+            items = [(p, f"n{i % 4}") for i, p in enumerate(pods)]
+            c.account_bind_bulk(items, req_rows=eb.pf.requests[:len(pods)])
+        else:
+            for i, p in enumerate(pods):
+                c.account_bind(p, node_name=f"n{i % 4}")
+        return c
+
+    pods = [pod(f"b{i}", cpu=100 + i * 10) for i in range(6)]
+    pods[2].spec.ports = [ContainerPort(host_port=9000)]
+    pods[3].spec.volumes = [VolumeClaim(claim_name="cl-a")]
+    pods[4].spec.volumes = [VolumeClaim(claim_name="cl-a")]
+    pods[5].spec.pod_group, pods[5].spec.pod_group_min = "gg", 1
+
+    seq, blk = build(pods, bulk=False), build(pods, bulk=True)
+    nf_s, _ = seq.snapshot()
+    nf_b, _ = blk.snapshot()
+    assert np.array_equal(nf_s.free, nf_b.free)
+    assert np.array_equal(nf_s.used_ports, nf_b.used_ports)
+    assert seq.claim_node_row("default/cl-a") == blk.claim_node_row("default/cl-a")
+    assert seq.gang_bound_count("default/gg") == blk.gang_bound_count("default/gg")
+    # unbind symmetry: releasing every pod restores full capacity both ways
+    for c in (seq, blk):
+        for p in pods:
+            c.account_unbind(p.key)
+        nf, _ = c.snapshot()
+        assert np.array_equal(nf.free, nf.allocatable[: nf.free.shape[0]])
+
+
 def test_node_update_recomputes_free_with_bound_pods():
     c = NodeFeatureCache()
     c.upsert_node(node("n1", cpu=1000))

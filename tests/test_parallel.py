@@ -58,6 +58,27 @@ def test_sharded_step_matches_single_chip(eight_devices):
                                np.asarray(sharded.free_after), rtol=1e-6)
 
 
+def test_sharded_auction_matches_single_chip(eight_devices):
+    """Auction mode under plain GSPMD must equal the single-device
+    auction bit-for-bit (same prices, same rounds, same winners)."""
+    mesh = make_mesh(eight_devices)
+    eb, nf, af, names = make_inputs()
+    ps = PluginSet([NodeUnschedulable(), NodeNumber()])
+    key = jax.random.PRNGKey(11)
+
+    single = build_step(ps, assignment="auction")(eb, nf, af, key)
+    sharded_step = build_sharded_step(ps, mesh, eb, nf, af,
+                                      assignment="auction")
+    eb_d, nf_d, af_d = shard_features(mesh, eb, nf, af)
+    sharded = sharded_step(eb_d, nf_d, af_d, key)
+
+    np.testing.assert_array_equal(np.asarray(single.chosen),
+                                  np.asarray(sharded.chosen))
+    np.testing.assert_array_equal(np.asarray(single.assigned),
+                                  np.asarray(sharded.assigned))
+    assert np.asarray(single.assigned).sum() > 0
+
+
 def test_sharded_capacity_causality(eight_devices):
     # the scan's carried free matrix must stay correct across shards
     mesh = make_mesh(eight_devices)

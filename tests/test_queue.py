@@ -42,6 +42,47 @@ def test_pop_batch_priority_order():
     q.close()
 
 
+def test_gather_window_waits_for_full_batch():
+    """With a gather window, a trickling burst forms ONE full batch: the
+    pop returns the moment max_n pods are queued, not at first arrival."""
+    q = make_queue()
+    def feed():
+        for i in range(6):
+            time.sleep(0.03)
+            q.add(pod(f"g{i}"))
+    t = threading.Thread(target=feed)
+    t.start()
+    t0 = time.monotonic()
+    batch = q.pop_batch(6, timeout=5, gather_window=5.0)
+    took = time.monotonic() - t0
+    t.join()
+    assert len(batch) == 6
+    assert took < 2.0, "gather must end at max_n, not at window expiry"
+    q.close()
+
+
+def test_gather_window_expires_on_partial_batch():
+    """The window caps gathering: fewer than max_n pods still pop once it
+    elapses."""
+    q = make_queue()
+    q.add(pod("only"))
+    t0 = time.monotonic()
+    batch = q.pop_batch(10, timeout=5, gather_window=0.2)
+    took = time.monotonic() - t0
+    assert [b.pod.metadata.name for b in batch] == ["only"]
+    assert 0.15 <= took < 2.0
+    q.close()
+
+
+def test_gather_window_zero_pops_immediately():
+    q = make_queue()
+    q.add(pod("now"))
+    t0 = time.monotonic()
+    assert len(q.pop_batch(10, timeout=5)) == 1
+    assert time.monotonic() - t0 < 0.1
+    q.close()
+
+
 def test_pop_batch_respects_max():
     q = make_queue()
     for i in range(5):
