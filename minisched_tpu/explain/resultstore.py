@@ -8,13 +8,14 @@ reference annotation/annotation.go:5-9), retried with exponential backoff
 (reference store.go:120-131 → util/retry.go:18), then evicted from memory
 (store.go:134,236-238).
 
-Hot-path cost: ``record_batch`` only stores references to the step's
-explain-mode output arrays plus a per-pod top-k column selection — O(P·k)
-— and defers all JSON/dict building to flush time. Flushing itself runs
-either synchronously (``flush=True``, the test/table mode), on a background
-worker (``async_flush=True``, the engine mode — the analog of the
-reference flushing on informer events off the scheduling thread,
-store.go:60-68), or manually (``flush_pod``).
+Hot-path cost: in async mode (``async_flush=True``, the engine mode — the
+analog of the reference flushing on informer events off the scheduling
+thread, store.go:60-68) ``record_batch`` ONLY enqueues the step's output
+references; device readback, the per-pod top-k selection, dict building,
+and the annotation writes all happen on the worker. A two-batch
+backpressure semaphore bounds how many steps' explain arrays can stay
+pinned awaiting ingestion. Synchronous mode (``flush=True``, the
+test/table mode) ingests and flushes inline.
 
 Bounding: at ``top_k`` (default 128) the per-pod annotation records only
 the k best nodes by weighted normalized score (all nodes when N ≤ k) —
@@ -71,6 +72,15 @@ class ResultStore:
         self._retry_steps = retry_steps
         self._worker: Optional[threading.Thread] = None
         self._q: Optional[queue_mod.Queue] = None
+        # At most 2 un-ingested batches: their explain-mode device arrays
+        # stay pinned until the worker reads them back, and an unbounded
+        # backlog of (F/S,P,N) stacks would eat HBM at 50k nodes.
+        self._inflight = threading.Semaphore(2)
+        self._closed = False
+        # Keys enqueued but not yet ingested — without this, queued
+        # batches would be invisible to pending_keys() and the shutdown
+        # "unflushed results" warning would under-report.
+        self._queued_keys: set = set()
         if async_flush:
             self._q = queue_mod.Queue()
             self._worker = threading.Thread(target=self._flush_loop,
@@ -81,11 +91,36 @@ class ResultStore:
     # ---- recording (called by the engine after each step) ---------------
 
     def record_batch(self, pods, names, decision, plugin_set) -> None:
+        """Hot-path entry. Async mode: enqueue-only (the worker does
+        readback/top-k/flush); sync mode: ingest and flush inline."""
+        if (decision.filter_masks.shape[0] == 0
+                and decision.raw_scores.shape[0] == 0):
+            return  # engine compiled with explain=False
+        if self._q is not None:
+            # Bounded, interruptible backpressure: a worker wedged in
+            # flush retries must not park the scheduling thread forever,
+            # and a close() must release producers (results are
+            # best-effort at shutdown, like the reference's broadcaster).
+            while not self._closed:
+                if self._inflight.acquire(timeout=0.5):
+                    if self._closed:
+                        self._inflight.release()
+                        return
+                    with self._lock:
+                        self._queued_keys.update(p.key for p in pods)
+                    self._q.put((pods, names, decision, plugin_set))
+                    return
+            return  # closed: drop
+        keys = self._ingest(pods, names, decision, plugin_set)
+        if self._flush:
+            for k in keys:
+                self.flush_pod(k)
+
+    def _ingest(self, pods, names, decision, plugin_set) -> List[str]:
+        """Device readback + top-k selection + record registration."""
         filter_masks = np.asarray(decision.filter_masks)   # (F,P,N)
         raw = np.asarray(decision.raw_scores)              # (S,P,N)
         norm = np.asarray(decision.norm_scores)            # (S,P,N)
-        if filter_masks.shape[0] == 0 and raw.shape[0] == 0:
-            return  # engine compiled with explain=False
         fnames = [p.name for p in plugin_set.filter_plugins]
         snames = [p.name for p in plugin_set.score_plugins]
         weights = [plugin_set.weight_of(p) for p in plugin_set.score_plugins]
@@ -124,13 +159,9 @@ class ResultStore:
         with self._lock:
             for i, pod in enumerate(pods):
                 self._results[pod.key] = (batch, i)
+                self._queued_keys.discard(pod.key)
                 keys.append(pod.key)
-        if self._q is not None:
-            for k in keys:
-                self._q.put(k)
-        elif self._flush:
-            for k in keys:
-                self.flush_pod(k)
+        return keys
 
     # ---- flushing (reference addSchedulingResultToPod store.go:90-135) --
 
@@ -178,7 +209,11 @@ class ResultStore:
             pod.metadata.annotations[FINAL_SCORE_RESULT_KEY] = json.dumps(
                 data["finalscore"], sort_keys=True)
             try:
-                self._cluster.update(pod)
+                # CAS: the flusher races the binder (record happens before
+                # the async bulk bind) — an unversioned write here could
+                # clobber a fresh binding with this stale copy. On
+                # conflict, retry re-reads the bound pod and annotates it.
+                self._cluster.update(pod, check_version=True)
                 return True
             except (ConflictError, NotFoundError):
                 return False
@@ -205,13 +240,23 @@ class ResultStore:
 
     def _flush_loop(self) -> None:
         while True:
-            key = self._q.get()
+            item = self._q.get()
             try:
-                if key is None:
+                if item is None:
                     return
-                self.flush_pod(key)
+                pods, names, decision, plugin_set = item
+                try:
+                    keys = self._ingest(pods, names, decision, plugin_set)
+                finally:
+                    self._inflight.release()
+                # Ingest copied everything to host — drop the references
+                # so the step's device arrays aren't pinned through the
+                # (long) per-pod flush phase.
+                del item, pods, decision
+                for k in keys:
+                    self.flush_pod(k)
             except Exception:
-                log.exception("async flush of %s failed", key)
+                log.exception("async explain ingest/flush failed")
             finally:
                 self._q.task_done()
 
@@ -227,13 +272,17 @@ class ResultStore:
         return True
 
     def close(self) -> None:
+        self._closed = True
         if self._q is not None:
             self._q.put(None)
 
     def delete_data(self, key: str) -> None:
         with self._lock:
             self._results.pop(key, None)
+            self._queued_keys.discard(key)
 
     def pending_keys(self) -> List[str]:
+        """Everything not yet flushed: ingested results AND batches still
+        waiting in the worker queue."""
         with self._lock:
-            return list(self._results)
+            return list(self._results) + list(self._queued_keys)

@@ -68,6 +68,39 @@ def test_record_and_flush_writes_all_three_annotations():
     assert rs.pending_keys() == []
 
 
+def test_flush_race_with_binder_cannot_clobber_binding():
+    """The flusher reads the pod, the binder binds it, the flusher writes
+    its stale copy: without CAS the annotation write would silently UNBIND
+    the pod. The versioned update must conflict and the retry must
+    annotate the bound pod."""
+    store, pods, ps, rs, names, dec = _setup(flush=False)
+    store.create(obj.Node(metadata=obj.ObjectMeta(name="race-n")))
+
+    class RacingStore:
+        """Interposes one bind between the flusher's get and update."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.raced = False
+
+        def get(self, kind, key):
+            out = self.inner.get(kind, key)
+            if kind == "Pod" and not self.raced:
+                self.raced = True
+                self.inner.bind_pod(key, "race-n")
+            return out
+
+        def update(self, o, **kw):
+            return self.inner.update(o, **kw)
+
+    rs._cluster = RacingStore(store)
+    rs.record_batch(pods, names, dec, ps)
+    assert rs.flush_pod(pods[0].key)
+    final = store.get("Pod", pods[0].key)
+    assert final.spec.node_name == "race-n", "flush clobbered the binding"
+    assert FILTER_RESULT_KEY in final.metadata.annotations
+
+
 def test_weight_applied_to_final_score():
     store, pods, ps, rs, names, dec = _setup(weights={"NodeNumber": 3.0})
     rs.record_batch(pods, names, dec, ps)
