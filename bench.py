@@ -195,6 +195,19 @@ def run_child() -> None:
             detail["pallas_equals_scan"] = "skipped (platform/tiling)"
     except Exception as e:
         detail["pallas_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # ---- auction assignment mode (BASELINE config 5) -------------------
+    try:
+        a_step = build_step(plugin_set, explain=False, assignment="auction")
+        da = a_step(eb, nf, af, key)
+        jax.block_until_ready(da.chosen)
+        t0 = time.perf_counter()
+        da = a_step(eb, nf, af, key)
+        jax.block_until_ready(da.chosen)
+        detail["device_s_auction"] = round(time.perf_counter() - t0, 4)
+        detail["auction_scheduled"] = int(np.asarray(da.assigned).sum())
+    except Exception as e:
+        detail["auction_error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(result))
     sys.stdout.flush()
 

@@ -77,10 +77,12 @@ class ResultStore:
         # backlog of (F/S,P,N) stacks would eat HBM at 50k nodes.
         self._inflight = threading.Semaphore(2)
         self._closed = False
-        # Keys enqueued but not yet ingested — without this, queued
-        # batches would be invisible to pending_keys() and the shutdown
-        # "unflushed results" warning would under-report.
-        self._queued_keys: set = set()
+        # Key → count of enqueued-but-not-ingested batches containing it
+        # (a pod retried across batches can sit in the queue twice).
+        # Without this, queued batches would be invisible to
+        # pending_keys() and the shutdown "unflushed results" warning
+        # would under-report.
+        self._queued_keys: Dict[str, int] = {}
         if async_flush:
             self._q = queue_mod.Queue()
             self._worker = threading.Thread(target=self._flush_loop,
@@ -97,20 +99,29 @@ class ResultStore:
                 and decision.raw_scores.shape[0] == 0):
             return  # engine compiled with explain=False
         if self._q is not None:
-            # Bounded, interruptible backpressure: a worker wedged in
-            # flush retries must not park the scheduling thread forever,
-            # and a close() must release producers (results are
-            # best-effort at shutdown, like the reference's broadcaster).
-            while not self._closed:
+            # Bounded backpressure: scheduling does not depend on the
+            # recorder, so a worker wedged in flush retries gets a few
+            # seconds of grace and then this batch's results are DROPPED
+            # (logged) — observability is best-effort, stalling the
+            # scheduling loop for it would invert the priorities. close()
+            # releases waiting producers immediately.
+            deadline = time.monotonic() + 5.0
+            while not self._closed and time.monotonic() < deadline:
                 if self._inflight.acquire(timeout=0.5):
                     if self._closed:
                         self._inflight.release()
                         return
                     with self._lock:
-                        self._queued_keys.update(p.key for p in pods)
+                        for p in pods:
+                            self._queued_keys[p.key] = (
+                                self._queued_keys.get(p.key, 0) + 1)
                     self._q.put((pods, names, decision, plugin_set))
                     return
-            return  # closed: drop
+            if not self._closed:
+                log.warning(
+                    "explain recorder backlogged; dropping results for "
+                    "%d pods", len(pods))
+            return
         keys = self._ingest(pods, names, decision, plugin_set)
         if self._flush:
             for k in keys:
@@ -159,7 +170,6 @@ class ResultStore:
         with self._lock:
             for i, pod in enumerate(pods):
                 self._results[pod.key] = (batch, i)
-                self._queued_keys.discard(pod.key)
                 keys.append(pod.key)
         return keys
 
@@ -249,6 +259,16 @@ class ResultStore:
                     keys = self._ingest(pods, names, decision, plugin_set)
                 finally:
                     self._inflight.release()
+                    # Pair exactly with the enqueue-side increments — on
+                    # ingest failure too, else pending_keys() reports
+                    # phantom unflushable pods forever.
+                    with self._lock:
+                        for p in pods:
+                            n = self._queued_keys.get(p.key, 0) - 1
+                            if n > 0:
+                                self._queued_keys[p.key] = n
+                            else:
+                                self._queued_keys.pop(p.key, None)
                 # Ingest copied everything to host — drop the references
                 # so the step's device arrays aren't pinned through the
                 # (long) per-pod flush phase.
@@ -279,10 +299,11 @@ class ResultStore:
     def delete_data(self, key: str) -> None:
         with self._lock:
             self._results.pop(key, None)
-            self._queued_keys.discard(key)
+            self._queued_keys.pop(key, None)
 
     def pending_keys(self) -> List[str]:
         """Everything not yet flushed: ingested results AND batches still
-        waiting in the worker queue."""
+        waiting in the worker queue (deduplicated)."""
         with self._lock:
-            return list(self._results) + list(self._queued_keys)
+            return list(dict.fromkeys(
+                list(self._results) + list(self._queued_keys)))

@@ -123,6 +123,9 @@ class Cluster:
     def delete_pod(self, name: str, namespace: str = "default") -> None:
         self.store.delete("Pod", f"{namespace}/{name}")
 
+    def delete_node(self, name: str) -> None:
+        self.store.delete("Node", name)
+
     # ---- assertions ----------------------------------------------------
 
     def wait_for_pod_bound(self, name: str, namespace: str = "default",
