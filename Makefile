@@ -9,7 +9,7 @@ PY ?= python
 # a wedged tunnel can't hang backend init.
 CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: test start bench dryrun
+.PHONY: test start bench bench_sharded dryrun
 
 # Unit + integration suite on a virtual 8-device CPU mesh.
 test:
@@ -24,6 +24,11 @@ start:
 # accelerator jax picks. MINISCHED_BENCH_{NODES,PODS,REPEATS} override.
 bench:
 	$(PY) bench.py
+
+# Sharded-step benchmark on the virtual 8-device CPU mesh (greedy chunked
+# scan vs single device vs auction). MINISCHED_SHARDED_{NODES,PODS} override.
+bench_sharded:
+	$(PY) bench_sharded.py
 
 # Compile-check the flagship single-chip step and the multi-chip sharded
 # step on an 8-device virtual mesh.

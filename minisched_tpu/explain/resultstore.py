@@ -297,9 +297,13 @@ class ResultStore:
             self._q.put(None)
 
     def delete_data(self, key: str) -> None:
+        # Only _results is purged: _queued_keys counts are owned by the
+        # enqueue/worker pairing — popping here would make the worker's
+        # later decrement steal a NEWER queued batch's count. A queued
+        # record for a deleted pod flushes as a harmless no-op
+        # (flush_pod → NotFound → evict).
         with self._lock:
             self._results.pop(key, None)
-            self._queued_keys.pop(key, None)
 
     def pending_keys(self) -> List[str]:
         """Everything not yet flushed: ingested results AND batches still

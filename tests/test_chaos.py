@@ -133,10 +133,22 @@ def test_chaos_churn_preserves_invariants():
         c.create_pod("ch-after", cpu=100)
         c.wait_for_pod_bound("ch-after", timeout=30)
 
-        # Invariant 4: watch log stayed rv-contiguous (no lost events for
-        # a fresh replay of current state).
+        # Invariant 4: after all the churn, a fresh atomic list+watch
+        # replays a state snapshot consistent with list() — and live
+        # events taken at that cursor are strictly rv-ordered. (Loss of
+        # historical events is not detectable post-hoc; ordering of NEW
+        # events is.)
         lists, w = c.store.list_and_watch()
         assert len(lists["Pod"]) == len(pods) + 1
+        c.create_pod("ch-order-1", cpu=10)
+        c.create_pod("ch-order-2", cpu=10)
+        rvs = []
+        deadline = time.monotonic() + 5
+        while len(rvs) < 2 and time.monotonic() < deadline:
+            ev = w.next_event(timeout=0.2)
+            if ev is not None and ev.kind == "Pod":
+                rvs.append(ev.resource_version)
+        assert rvs[:2] == sorted(rvs[:2]) and len(set(rvs[:2])) == 2
     finally:
         c.shutdown()
 
