@@ -465,7 +465,12 @@ def main() -> None:
     timeout_s = float(os.environ.get("MINISCHED_BENCH_TIMEOUT", "900"))
     attempts = {}
 
-    if not _probe_accelerator():
+    # Probe only when the ambient attempt would actually touch an
+    # accelerator: a run already pinned to cpu strips the tunnel hook
+    # inside the child and must not be failed by a wedged tunnel the
+    # probe (which runs with the ambient env) would trip over.
+    if (os.environ.get("JAX_PLATFORMS", "") != "cpu"
+            and not _probe_accelerator()):
         attempts["ambient"] = "accelerator probe failed/hung (wedged tunnel?)"
         parsed, diag = None, attempts["ambient"]
     else:

@@ -163,6 +163,14 @@ class Cluster:
         if pod.spec.node_name:
             raise AssertionError(
                 f"pod {namespace}/{name} unexpectedly bound to {pod.spec.node_name}")
+        if not pod.status.unschedulable_plugins:
+            # Judged on the RE-FETCHED pod (an attempt landing just past
+            # the wait deadline still counts) — and fail HERE with the
+            # real story rather than letting a silent timeout surface as
+            # a baffling empty unschedulable_plugins assert downstream.
+            raise AssertionError(
+                f"pod {namespace}/{name}: no scheduling attempt recorded "
+                f"within {timeout}s (phase={pod.status.phase})")
         return pod
 
 

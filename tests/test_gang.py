@@ -189,7 +189,7 @@ def test_gang_parks_until_capacity_arrives(cluster):
         cluster.create_pod(f"h{i}x", spec=_gang_pod_spec("batchjob", 3))
     # Whole gang must park under Coscheduling — none may bind.
     for i in range(3):
-        pending = cluster.wait_for_pod_pending(f"h{i}x", timeout=5)
+        pending = cluster.wait_for_pod_pending(f"h{i}x", timeout=30)
         assert "Coscheduling" in pending.status.unschedulable_plugins
     # Capacity arrives → gang revives and binds atomically.
     cluster.create_node("bigB", cpu=1000)
@@ -204,7 +204,7 @@ def test_gang_waits_for_quorum_then_member_arrival_completes_it(cluster):
     cluster.create_pod("m1x", spec=_gang_pod_spec("trio", 3))
     # Two of three members: must park, not bind.
     for name in ("m0x", "m1x"):
-        pending = cluster.wait_for_pod_pending(name, timeout=5)
+        pending = cluster.wait_for_pod_pending(name, timeout=30)
         assert "Coscheduling" in pending.status.unschedulable_plugins
     # Third member arrives → pod-add event revives the parked mates.
     cluster.create_pod("m2x", spec=_gang_pod_spec("trio", 3))
@@ -243,7 +243,7 @@ def test_gangs_are_namespace_scoped(cluster):
         cluster.wait_for_pod_bound(f"n1p{i}x", namespace="ns1", timeout=10)
     # ns2's lone member: quorum 3, zero ns2 members running → must park.
     cluster.create_pod("n2p0x", namespace="ns2", spec=_gang_pod_spec("job", 3))
-    pending = cluster.wait_for_pod_pending("n2p0x", namespace="ns2", timeout=5)
+    pending = cluster.wait_for_pod_pending("n2p0x", namespace="ns2", timeout=30)
     assert "Coscheduling" in pending.status.unschedulable_plugins
 
 
@@ -271,7 +271,7 @@ def test_node_removal_releases_gang_credit(cluster):
     cluster.delete_pod("d2x")
     cluster.create_node("smallF", cpu=1000)
     cluster.create_pod("d0y", spec=_gang_pod_spec("dj", 3))
-    pending = cluster.wait_for_pod_pending("d0y", timeout=5)
+    pending = cluster.wait_for_pod_pending("d0y", timeout=30)
     assert "Coscheduling" in pending.status.unschedulable_plugins
 
 
@@ -285,5 +285,5 @@ def test_gang_does_not_starve_ungrouped_pods(cluster):
     bound = cluster.wait_for_pod_bound("solo1x", timeout=10)
     assert bound.spec.node_name == "workerC"
     for i in range(3):
-        pending = cluster.wait_for_pod_pending(f"q{i}x", timeout=5)
+        pending = cluster.wait_for_pod_pending(f"q{i}x", timeout=30)
         assert "Coscheduling" in pending.status.unschedulable_plugins

@@ -67,7 +67,7 @@ def test_ebs_limits_filter_blocks_over_limit_node(cluster):
     # EBSLimits.
     cluster.create_pod("ebs-p2",
                        spec=_typed_vol_spec("e2", volume_type="aws-ebs"))
-    pending = cluster.wait_for_pod_pending("ebs-p2", timeout=5)
+    pending = cluster.wait_for_pod_pending("ebs-p2", timeout=30)
     assert "EBSLimits" in pending.status.unschedulable_plugins
     # Freeing the first pod's slot revives it.
     cluster.delete_pod("ebs-p1")
@@ -172,5 +172,5 @@ def test_immediate_pending_claim_still_blocks(cluster):
     cluster.create_node("imm-node")
     cluster.create_pvc("imm-claim", phase="Pending")
     cluster.create_pod("imm-p1", spec=_typed_vol_spec("imm-claim"))
-    pending = cluster.wait_for_pod_pending("imm-p1", timeout=5)
+    pending = cluster.wait_for_pod_pending("imm-p1", timeout=30)
     assert "VolumeBinding" in pending.status.unschedulable_plugins

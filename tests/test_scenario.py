@@ -31,7 +31,7 @@ def test_readme_scenario(cluster):
         cluster.create_node(f"node{i}", unschedulable=True)
     cluster.create_pod("pod1", cpu=100)
 
-    pending = cluster.wait_for_pod_pending("pod1", timeout=10)
+    pending = cluster.wait_for_pod_pending("pod1", timeout=30)
     assert pending.status.unschedulable_plugins == ["NodeUnschedulable"]
     assert pending.spec.node_name == ""
 
@@ -102,7 +102,7 @@ def test_pod_deleted_while_pending(cluster):
     cluster.start(config=fast_config())
     cluster.create_node("full", unschedulable=True)
     cluster.create_pod("doomed", cpu=100)
-    cluster.wait_for_pod_pending("doomed", timeout=10)
+    cluster.wait_for_pod_pending("doomed", timeout=30)
     cluster.delete_pod("doomed")
     # a new pod with the same name must be schedulable after a node appears
     cluster.create_node("open0")
@@ -117,7 +117,7 @@ def test_restart_scheduler_resumes(cluster):
     cluster.start(config=fast_config())
     cluster.create_node("blocked", unschedulable=True)
     cluster.create_pod("waiting1", cpu=100)
-    cluster.wait_for_pod_pending("waiting1", timeout=10)
+    cluster.wait_for_pod_pending("waiting1", timeout=30)
 
     cluster.service.restart_scheduler()
     cluster.create_node("rescue1")

@@ -55,7 +55,7 @@ def test_volume_restrictions_releases_on_pod_delete(cluster):
     cluster.create_node("vrr-node2")
     # Same claim, but its node is full → pinned and unschedulable.
     cluster.create_pod("vrr-p2", spec=_vol_spec("claim-c"))
-    pending = cluster.wait_for_pod_pending("vrr-p2", timeout=5)
+    pending = cluster.wait_for_pod_pending("vrr-p2", timeout=30)
     assert pending.status.unschedulable_plugins  # recorded an attempt
     # Deleting the holder frees the claim; the pod-delete event revives.
     cluster.delete_pod("vrr-p1")
@@ -106,7 +106,7 @@ def test_node_volume_limits_filters_and_attributes(cluster):
     cluster.wait_for_pod_bound("nvl-p1", timeout=30)
     # Headroom is 0 now; the next volume-using pod parks with attribution.
     cluster.create_pod("nvl-p2", spec=_vol_spec("c3"))
-    pending = cluster.wait_for_pod_pending("nvl-p2", timeout=5)
+    pending = cluster.wait_for_pod_pending("nvl-p2", timeout=30)
     assert "NodeVolumeLimits" in pending.status.unschedulable_plugins
     # Volume-free pods are unaffected.
     cluster.create_pod("nvl-free")
@@ -159,7 +159,7 @@ def test_shared_claim_does_not_double_charge_attach_slot(cluster):
     assert cluster.wait_for_pod_bound("dc-p2", timeout=10).spec.node_name == "dc-node"
     # A pod with a NEW claim needs a new slot → filtered out.
     cluster.create_pod("dc-p3", spec=_vol_spec("claim-other"))
-    pending = cluster.wait_for_pod_pending("dc-p3", timeout=5)
+    pending = cluster.wait_for_pod_pending("dc-p3", timeout=30)
     assert "NodeVolumeLimits" in pending.status.unschedulable_plugins
 
 
