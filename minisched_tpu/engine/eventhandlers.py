@@ -57,8 +57,32 @@ def add_all_event_handlers(sched, factory: InformerFactory) -> None:
         else:
             sched.queue.delete(pod)
 
+    def pod_add_many(pods):
+        """Bulk form of pod_add for arrival bursts: queue the unscheduled
+        pods in one queue transaction, account bound ones, and coalesce
+        the per-pod requeue signals into one move call (move_all is
+        idempotent over the same event, so one call per burst is
+        equivalent to one per pod)."""
+        unscheduled, move = [], False
+        for pod in pods:
+            if not pod.spec.node_name:
+                if not sched.wants_pod(pod):
+                    continue
+                unscheduled.append(pod)
+                if pod.spec.pod_group:
+                    move = True
+            else:
+                sched.cache.account_bind(pod)
+                move = True
+        if unscheduled:
+            sched.queue.add_many(unscheduled)
+        if move:
+            sched.queue.move_all_to_active_or_backoff(
+                ClusterEvent(GVK.POD, ActionType.ADD))
+
     factory.add_handlers("Pod", ResourceEventHandlers(
-        on_add=pod_add, on_update=pod_update, on_delete=pod_delete))
+        on_add=pod_add, on_update=pod_update, on_delete=pod_delete,
+        on_add_many=pod_add_many))
 
     # --- nodes: feature cache + requeue gating --------------------------
     def node_add(node):

@@ -639,9 +639,9 @@ class Scheduler:
             [(qpi.pod.key, node_name) for qpi, node_name in items]))
         with self._metrics_lock:
             self._metrics["pods_bound"] += len(bound_keys)
+        self.queue.forget_many(bound_keys)
         for qpi, node_name in items:
             if qpi.pod.key in bound_keys:
-                self.queue.forget(qpi.pod.key)
                 self.broadcaster.scheduled(qpi.pod, node_name)
             else:
                 self._bind_failed(qpi, node_name, "skipped by bulk commit")

@@ -108,6 +108,11 @@ class Cluster:
                       spec=spec)
         return self.store.create(pod)
 
+    def create_objects(self, objs: list) -> list:
+        """Bulk submission: one store transaction for a burst of objects
+        (scenario analog of a big workload apply; see store.create_many)."""
+        return self.store.create_many(objs)
+
     def get_pod(self, name: str, namespace: str = "default") -> obj.Pod:
         return self.store.get("Pod", f"{namespace}/{name}")
 

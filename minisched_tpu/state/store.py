@@ -76,6 +76,30 @@ class Watcher:
                     self._store._cond.wait(1.0)
         return None
 
+    def next_events(self, max_n: int,
+                    timeout: Optional[float] = None) -> List[WatchEvent]:
+        """Up to ``max_n`` matching events in ONE lock acquisition (the
+        per-event ``next_event`` loop costs a condvar round-trip per event —
+        a 10k-object burst is 10k acquisitions a batch drain collapses to a
+        handful). Blocks like ``next_event`` until at least one event
+        matches, the timeout lapses (→ []), or the watcher stops."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._store._cond:
+            while not self._stopped.is_set():
+                evs, scanned_to = self._store._drain_after(
+                    self._cursor, self._kinds, max_n)
+                self._cursor = scanned_to
+                if evs:
+                    return evs
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return []
+                    self._store._cond.wait(remaining)
+                else:
+                    self._store._cond.wait(1.0)
+        return []
+
     def __iter__(self) -> Iterator[WatchEvent]:
         while not self._stopped.is_set():
             ev = self.next_event(timeout=0.1)
@@ -128,6 +152,35 @@ class ClusterStore:
             self._append(WatchEvent(EventType.ADDED, kind, stored,
                                     None, self._rv))
             return o
+
+    def create_many(self, objs: List[Any]) -> List[Any]:
+        """Bulk create: one lock acquisition and one watcher wake-up for a
+        whole burst of objects (a 10k-pod workload submission is 10k lock
+        round-trips + 10k condvar broadcasts on the per-object path; the
+        watch log stays rv-contiguous either way). All-or-nothing on name
+        collisions: the duplicate check runs for the entire batch before
+        the first mutation, so a failed call leaves no partial state."""
+        objs = list(objs)  # two passes below — an iterator must not exhaust
+        now = time.time()
+        with self._cond:
+            seen = set()
+            for o in objs:
+                kind, key = kind_of(o), o.key
+                if key in self._objects[kind] or (kind, key) in seen:
+                    raise AlreadyExistsError(f"{kind} {key!r} already exists")
+                seen.add((kind, key))
+            for o in objs:
+                kind = kind_of(o)
+                self._rv += 1
+                o.metadata.resource_version = self._rv
+                if not o.metadata.creation_timestamp:
+                    o.metadata.creation_timestamp = now
+                stored = deepcopy_obj(o)
+                self._objects[kind][o.key] = stored
+                self._append(WatchEvent(EventType.ADDED, kind, stored,
+                                        None, self._rv), notify=False)
+            self._cond.notify_all()
+        return objs
 
     def get(self, kind: str, key: str) -> Any:
         # Stored objects are replacement-only (update/bind deep-copy before
@@ -271,13 +324,14 @@ class ClusterStore:
         with self._cond:
             return self._rv
 
-    def _append(self, ev: WatchEvent) -> None:
+    def _append(self, ev: WatchEvent, notify: bool = True) -> None:
         self._log.append(ev)
         if len(self._log) > self._max_log:
             drop = len(self._log) - self._max_log
             self._log_base = self._log[drop - 1].resource_version
             del self._log[:drop]
-        self._cond.notify_all()
+        if notify:
+            self._cond.notify_all()
 
     def _next_after(self, rv: int, kinds: Optional[set]):
         """Return (first matching event after rv, cursor to advance to).
@@ -295,6 +349,24 @@ class ClusterStore:
             if kinds is None or ev.kind in kinds:
                 return ev, ev.resource_version
         return None, self._rv
+
+    def _drain_after(self, rv: int, kinds: Optional[set], max_n: int):
+        """Batch form of _next_after: (up to max_n matching events, cursor).
+        The cursor lands on the last MATCHING event consumed (or the log
+        end when under max_n), so unconsumed matches are never skipped."""
+        if rv < self._log_base:
+            raise ValueError(
+                f"watch cursor {rv} fell behind retained log (base "
+                f"{self._log_base}); re-list and restart the watch")
+        out: List[WatchEvent] = []
+        cursor = self._rv
+        for ev in self._log[rv - self._log_base:]:
+            if kinds is None or ev.kind in kinds:
+                out.append(ev)
+                if len(out) >= max_n:
+                    cursor = ev.resource_version
+                    break
+        return out, cursor
 
     # ---- Snapshot / restore (etcd durability analog) -------------------
 

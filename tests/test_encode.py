@@ -110,6 +110,9 @@ def test_account_bind_bulk_matches_sequential():
         return c
 
     pods = [pod(f"b{i}", cpu=100 + i * 10) for i in range(6)]
+    pods[0].metadata.labels = {"app": "web", "tier": "a"}
+    pods[1].metadata.labels = {"app": "web", "tier": "a"}  # shared signature
+    pods[1].metadata.namespace = "other"  # distinct ns, same label signature
     pods[2].spec.ports = [ContainerPort(host_port=9000)]
     pods[3].spec.volumes = [VolumeClaim(claim_name="cl-a")]
     pods[4].spec.volumes = [VolumeClaim(claim_name="cl-a")]
@@ -122,6 +125,16 @@ def test_account_bind_bulk_matches_sequential():
     assert np.array_equal(nf_s.used_ports, nf_b.used_ports)
     assert seq.claim_node_row("default/cl-a") == blk.claim_node_row("default/cl-a")
     assert seq.gang_bound_count("default/gg") == blk.gang_bound_count("default/gg")
+    # assigned-pod corpus parity (fast path fills ns_hash/label_pairs via
+    # memoized rows): compare per-pod rows, which may sit at different
+    # physical indices between the two allocation orders
+    af_s, af_b = seq.snapshot_assigned(), blk.snapshot_assigned()
+
+    def rows(c, af):
+        return {k: (af.node_row[a], af.ns_hash[a], tuple(af.label_pairs[a]))
+                for k, a in c._a_row.items()}
+
+    assert rows(seq, af_s) == rows(blk, af_b)
     # unbind symmetry: releasing every pod restores full capacity both ways
     for c in (seq, blk):
         for p in pods:

@@ -83,6 +83,30 @@ def test_many_pods_spread_capacity(cluster):
     assert all(v == 2 for v in counts.values()), counts
 
 
+def test_bulk_workload_submission(cluster):
+    """A whole workload applied as one store transaction via the scenario
+    facade (Cluster.create_objects → store.create_many): the burst flows
+    through the bulk informer/queue path and every pod binds."""
+    from minisched_tpu.state import objects as obj
+
+    cluster.start(config=fast_config(max_batch_size=64, batch_window_s=0.2))
+    cluster.create_objects([
+        obj.Node(metadata=obj.ObjectMeta(name=f"bw-n{i}"),
+                 spec=obj.NodeSpec(),
+                 status=obj.NodeStatus(allocatable={
+                     "cpu": 1000, "memory": 8 << 30, "pods": 110}))
+        for i in range(4)])
+    cluster.create_objects([
+        obj.Pod(metadata=obj.ObjectMeta(name=f"bw-p{i}", namespace="default",
+                                        labels={"app": "burst"}),
+                spec=obj.PodSpec(requests={"cpu": 100}))
+        for i in range(32)])
+    for i in range(32):
+        cluster.wait_for_pod_bound(f"bw-p{i}", timeout=15)
+    nodes_used = {p.spec.node_name for p in cluster.list_pods()}
+    assert nodes_used <= {f"bw-n{i}" for i in range(4)}
+
+
 def test_capacity_exhausted_then_node_added(cluster):
     cluster.start(config=fast_config())
     cluster.create_node("tiny0", cpu=100)

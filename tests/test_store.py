@@ -156,3 +156,39 @@ def test_snapshot_restore_roundtrip(tmp_path):
     # restored store keeps working
     s2.create(make_node("n2"))
     assert s2.count("Node") == 2
+
+
+def test_create_many_bulk_semantics():
+    """Bulk create matches per-object create: rv-contiguous watch log,
+    ADDED events for every object, atomic duplicate rejection."""
+    store = ClusterStore()
+    w = store.watch(kinds=["Pod"])
+    pods = [make_pod(f"p{i}") for i in range(50)]
+    store.create_many(pods)
+    evs = w.next_events(100, timeout=1.0)
+    assert [e.object.metadata.name for e in evs] == [f"p{i}" for i in range(50)]
+    rvs = [e.resource_version for e in evs]
+    assert rvs == list(range(rvs[0], rvs[0] + 50))
+    assert store.count("Pod") == 50
+
+    # duplicate anywhere in the batch → nothing from the batch lands
+    with pytest.raises(AlreadyExistsError):
+        store.create_many([make_pod("q1"), make_pod("p3")])
+    assert store.count("Pod") == 50
+    with pytest.raises(AlreadyExistsError):  # intra-batch duplicate too
+        store.create_many([make_pod("r1"), make_pod("r1")])
+    assert store.count("Pod") == 50
+
+
+def test_next_events_batch_drain():
+    """next_events returns up to max_n matching events per call and never
+    skips matches past the cap; kind filtering advances the cursor."""
+    store = ClusterStore()
+    w = store.watch(kinds=["Pod"])
+    store.create(make_node("n1"))  # filtered out
+    store.create_many([make_pod(f"p{i}") for i in range(7)])
+    first = w.next_events(3, timeout=1.0)
+    assert [e.object.metadata.name for e in first] == ["p0", "p1", "p2"]
+    rest = w.next_events(100, timeout=1.0)
+    assert [e.object.metadata.name for e in rest] == ["p3", "p4", "p5", "p6"]
+    assert w.next_events(10, timeout=0.05) == []
