@@ -140,8 +140,12 @@ class NodeFeatureCache:
         deployment shares one label signature, so the per-pod Python work
         collapses to dict inserts)."""
         with self._lock:
+            # Private copy (np.array, not asarray): rows of ``reqs`` are
+            # stored in _bound as views, so the backing array must be
+            # owned here — a caller-held buffer later mutated/reused would
+            # otherwise silently corrupt unbind accounting.
             reqs = (None if req_rows is None
-                    else np.asarray(req_rows, dtype=np.float32))
+                    else np.array(req_rows, dtype=np.float32, copy=True))
             fast: List[tuple] = []  # (request row k, node row i, pod)
             batch_seen: set = set()  # in-batch duplicate keys: sequential
             # accounting early-returns on the second occurrence (it is

@@ -17,6 +17,10 @@ from typing import Any, Callable, Dict, List, Optional
 
 from .store import ClusterStore, EventType, WatchEvent
 
+import logging
+
+log = logging.getLogger(__name__)
+
 
 @dataclass
 class ResourceEventHandlers:
@@ -105,9 +109,7 @@ class InformerFactory:
                 # which queue/cache consumers do via keyed dedupe). Deletions
                 # that happened in the gap cannot be synthesized without a
                 # local cache; surface that loudly.
-                import logging
-
-                logging.getLogger(__name__).error(
+                log.error(
                     "informer fell behind watch log; re-listing and "
                     "redelivering adds (deletes in the gap are lost)")
                 initial, self._watcher = self.store.list_and_watch(
@@ -137,9 +139,6 @@ class InformerFactory:
         handlers get one on_add_many call, the rest one on_add each."""
         if not objs:
             return
-        import logging
-
-        log = logging.getLogger(__name__)
 
         def safe_filter(flt, o) -> bool:
             try:
@@ -195,7 +194,5 @@ class InformerFactory:
                 elif ev.type == EventType.DELETED and h.on_delete:
                     h.on_delete(ev.object)
             except Exception:  # handler errors must not kill the pump
-                import logging
-
-                logging.getLogger(__name__).exception(
+                log.exception(
                     "informer handler failed for %s %s", ev.type, ev.kind)
