@@ -333,20 +333,47 @@ def run_child() -> None:
             detail["config4_scheduled"] = int(np.asarray(d4.assigned).sum())
     except Exception as e:
         detail["config4_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(result))
+    sys.stdout.flush()
+
+    # ---- sustained multi-batch engine throughput ----------------------
+    # Same workload, but the engine chews it in ~5 back-to-back cycles
+    # (batch_size = n_pods/5): the steady-state serving number — pad
+    # bucket reuse, carried assume accounting, queue churn between
+    # batches — vs the one-shot burst above.
+    try:
+        if in_budget("stream_pods_per_sec"):
+            # Short gather window: a partial straggler batch (remainder,
+            # or a capacity-requeue) must not stall its cycle for the
+            # burst-mode 15s window.
+            detail.update(engine_bench(
+                n_nodes, n_pods, make_nodes, make_pods, plugins,
+                batch_size=max(256, n_pods // 5), prefix="stream",
+                window_s=0.25))
+    except Exception as e:
+        detail["stream_error"] = f"{type(e).__name__}: {e}"[:300]
 
     emit_and_exit(0)
 
 
-def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins) -> dict:
+def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
+                 batch_size=None, prefix="engine", window_s=15.0) -> dict:
     """Schedule the same workload through the REAL engine: store + informers
     + queue + batched cycle + bulk bind; throughput from scheduler.metrics().
     Two passes — the first eats XLA compiles for the engine's pad buckets,
-    the second (fresh store, warm step cache) is the measurement."""
+    the second (fresh store, warm step cache) is the measurement.
+
+    ``batch_size`` < n_pods turns the single-burst measurement into a
+    SUSTAINED multi-batch one: the engine chews through the same workload
+    in n_pods/batch_size back-to-back cycles (pad bucket reused, assume
+    accounting carried across batches) — the steady-state serving number
+    rather than the one-shot burst number. Output keys take ``prefix``."""
     from minisched_tpu.config import SchedulerConfig
     from minisched_tpu.service.defaultconfig import Profile
     from minisched_tpu.service.service import SchedulerService
     from minisched_tpu.state.store import ClusterStore
 
+    batch_size = batch_size or n_pods
     profile = Profile(name="bench", plugins=plugins,
                       plugin_args={"NodeResourcesFit":
                                    {"score_strategy": None}})
@@ -364,8 +391,8 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins) -> dict:
         # fresh XLA compile. Gathering terminates exactly when all
         # n_pods are queued; the window is only the stall-tolerant cap.
         sched = svc.start_scheduler(
-            profile, SchedulerConfig(max_batch_size=n_pods,
-                                     batch_window_s=15.0))
+            profile, SchedulerConfig(max_batch_size=batch_size,
+                                     batch_window_s=window_s))
         # Cold-start boundary: the scheduler has synced the 50k-node
         # cluster; everything after this point is steady-state serving.
         # engine_total_s includes this bootstrap, engine_sched_s (the
@@ -399,22 +426,26 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins) -> dict:
             # Warm-up couldn't bind everything inside the deadline; the
             # measured pass would only repeat that. Report the warm-up
             # pass (marked) instead of burning a second deadline.
-            return {"engine_bound": bound, "engine_batches": int(m["batches"]),
-                    "engine_total_s": round(total_s, 4),
-                    "engine_note": "warmup pass reported; did not converge"}
+            return {f"{prefix}_bound": bound,
+                    f"{prefix}_batches": int(m["batches"]),
+                    f"{prefix}_total_s": round(total_s, 4),
+                    f"{prefix}_note":
+                        "warmup pass reported; did not converge"}
         if attempt == "measured":
             out = {
-                "engine_bound": bound,
-                "engine_total_s": round(total_s, 4),
-                "engine_sync_s": round(sync_s, 4),
-                "engine_sched_s": round(sched_s, 4),
-                "engine_pods_per_sec": round(bound / max(sched_s, 1e-9), 1),
-                "engine_batches": int(m["batches"]),
-                "engine_batch_sizes": m.get("batch_sizes", []),
-                "engine_encode_s": round(m["encode_s_total"], 4),
-                "engine_step_s": round(m["step_s_total"], 4),
-                "engine_commit_s": round(m["commit_s_total"], 4),
-                "engine_bind_conflicts": int(m["bind_conflicts"]),
+                f"{prefix}_bound": bound,
+                f"{prefix}_total_s": round(total_s, 4),
+                f"{prefix}_sync_s": round(sync_s, 4),
+                f"{prefix}_sched_s": round(sched_s, 4),
+                f"{prefix}_pods_per_sec": round(bound / max(sched_s, 1e-9), 1),
+                f"{prefix}_batches": int(m["batches"]),
+                f"{prefix}_batch_sizes": m.get("batch_sizes", []),
+                f"{prefix}_encode_s": round(m["encode_s_total"], 4),
+                f"{prefix}_step_s": round(m["step_s_total"], 4),
+                f"{prefix}_step_dispatch_s":
+                    round(m["step_dispatch_s_total"], 4),
+                f"{prefix}_commit_s": round(m["commit_s_total"], 4),
+                f"{prefix}_bind_conflicts": int(m["bind_conflicts"]),
             }
     return out
 

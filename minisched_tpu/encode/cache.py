@@ -64,6 +64,12 @@ class NodeFeatureCache:
         self._key_gang: Dict[str, str] = {}
         self.overflow: List[str] = []  # encoding-slot overflow reports
         self.version = 0  # bumped on every mutation (cheap staleness check)
+        # Bumped only when STATIC node features change (node add/update/
+        # remove, topology-domain refresh) — NOT on bind/unbind accounting,
+        # which touches only free/used_ports. Consumers keying a
+        # device-resident copy of the static feature leaves on this avoid
+        # re-uploading ~tens of MB of unchanged matrices every batch.
+        self.static_version = 0
         # topology keys shared with pod encoding; new registrations trigger
         # a domain-table refresh at the next snapshot
         self.registry = registry or TopologyKeyRegistry(cfg)
@@ -77,7 +83,14 @@ class NodeFeatureCache:
 
     # ---- node lifecycle -------------------------------------------------
 
-    def upsert_node(self, node: Node) -> None:
+    def upsert_node(self, node: Node, bound_pods=()) -> None:
+        """Encode (or re-encode) a node row. ``bound_pods``: pods to
+        account onto the row INSIDE the same lock hold — for node
+        re-creation, where pods of the previous incarnation are still
+        bound to the name in the store. Accounting them after a separate
+        upsert would leave a window in which a concurrent snapshot sees
+        the recreated node at full free capacity and a batch over-commits
+        it; snapshot takes this lock, so atomicity follows."""
         with self._lock:
             i = self._index.get(node.metadata.name)
             if i is None:
@@ -88,13 +101,21 @@ class NodeFeatureCache:
             F.encode_node_into(self._feats, i, node, self.overflow)
             F.compute_topo_domains_row(self._feats, i, self.registry, self.cfg)
             self._recompute_free_row(i)
+            for pod in bound_pods:
+                self._account_bind_locked(pod, node.metadata.name)
             self.version += 1
+            self.static_version += 1
 
-    def remove_node(self, name: str) -> None:
+    def remove_node(self, name: str) -> List[str]:
+        """Drop a node row. Returns the keys of bound pods whose accounting
+        was dropped with it — the caller decides their fate (the engine
+        remembers them: if a SAME-NAMED node reappears while they are
+        still bound in the store, their capacity must be re-accounted onto
+        the new row, or the recreated node silently over-commits)."""
         with self._lock:
             i = self._index.pop(name, None)
             if i is None:
-                return
+                return []
             F.clear_node_row(self._feats, i)
             self._names[i] = None
             self._free_rows.append(i)
@@ -111,6 +132,8 @@ class NodeFeatureCache:
                     self._a_free.append(a)
                 self._drop_gang_member(k)
             self.version += 1
+            self.static_version += 1
+            return gone
 
     # ---- pod accounting -------------------------------------------------
 
@@ -344,6 +367,10 @@ class NodeFeatureCache:
 
     # ---- snapshot -------------------------------------------------------
 
+    # NodeFeatures leaves written by bind/unbind accounting; everything
+    # else changes only with static_version.
+    DYNAMIC_NF_FIELDS = ("free", "used_ports")
+
     def snapshot(self, pad: Optional[int] = None) -> Tuple[NodeFeatures, List[Optional[str]]]:
         """Copy of the feature arrays padded to ``pad`` (default: bucketed
         capacity), plus the row→name mapping (None = empty row).
@@ -351,32 +378,62 @@ class NodeFeatureCache:
         ``pad`` may be smaller than capacity when every row beyond it is
         empty (e.g. capacity doubled to 64k for 50k nodes; a 51200 pad
         avoids wasting 30% of the matrices on padding)."""
+        feats, names, _sv = self.snapshot_versioned(pad)
+        return feats, names
+
+    def snapshot_versioned(self, pad: Optional[int] = None,
+                           known_static=None):
+        """``snapshot`` that also returns the static version OBSERVED UNDER
+        THE SNAPSHOT LOCK — the topology refresh performed here may itself
+        bump it, so a version read before the call can be stale while the
+        arrays are fresh (a consumer keying device-resident static leaves
+        on the early read would then serve old leaves deterministically
+        whenever a batch registers a new topology key).
+
+        ``known_static``: the (static_version, pad) key the caller already
+        holds device copies for. When it matches, the static leaves are
+        returned as ``None`` instead of host copies — the caller replaces
+        them anyway, and skipping them drops ~tens of MB of memcpy from
+        every steady-state batch. Returns (feats, names, static_version).
+        """
         with self._lock:
             self._refresh_topology_locked()
+            sv = self.static_version
             n = self._capacity
             target = pad if pad is not None else bucket_for(n)
             f = self._feats
-            # topo_domains is (K, N) — its node axis is axis 1.
-            if target < n:
-                if f.valid[target:].any():
+            skip = (lambda name: known_static == (sv, target)
+                    and name not in self.DYNAMIC_NF_FIELDS)
+
+            if target <= n:
+                if target < n and f.valid[target:].any():
                     raise ValueError(
-                        f"pad {target} < capacity {n} with live rows beyond it")
+                        f"pad {target} < capacity {n} with live rows "
+                        "beyond it")
+                # topo_domains is (K, N) — its node axis is axis 1.
                 feats = NodeFeatures(*(
-                    a[:, :target].copy() if name == "topo_domains"
-                    else a[:target].copy()
+                    None if skip(name)
+                    else (a[:, :target].copy() if name == "topo_domains"
+                          else a[:target].copy())
                     for name, a in zip(f._fields, f)))
-                return feats, list(self._names[:target])
-            if target == n:
-                feats = NodeFeatures(*(a.copy() for a in f))
+                names = list(self._names[:target])
             else:
+                # Grow-pad: copy into empty features so padding rows keep
+                # the empty defaults (e.g. topo_domains -1 = "no domain").
                 empty = F.empty_node_features(target, self.cfg)
+                leaves = []
                 for name, a, e in zip(f._fields, f, empty):
+                    if skip(name):
+                        leaves.append(None)
+                        continue
                     if name == "topo_domains":
                         e[:, :n] = a
                     else:
                         e[:n] = a
-                feats = empty
-            return feats, list(self._names) + [None] * (target - n)
+                    leaves.append(e)
+                feats = NodeFeatures(*leaves)
+                names = list(self._names) + [None] * (target - n)
+            return feats, names, sv
 
     def snapshot_assigned(self, pad: Optional[int] = None) -> AssignedPodFeatures:
         """Copy of the assigned-pod corpus padded/truncated like snapshot()."""
@@ -447,6 +504,7 @@ class NodeFeatureCache:
         for name, i in self._index.items():
             F.compute_topo_domains_row(self._feats, i, self.registry, self.cfg)
         self._topo_version = self.registry.version
+        self.static_version += 1
 
     def _recompute_free_row(self, i: int) -> None:
         free = self._feats.allocatable[i].copy()

@@ -50,7 +50,8 @@ def add_all_event_handlers(sched, factory: InformerFactory) -> None:
 
     def pod_delete(pod):
         if pod.spec.node_name:
-            sched.cache.account_unbind(pod.key)
+            # releases accounting AND prunes any orphaned-bind record
+            sched.on_bound_pod_deleted(pod)
             # freed capacity may make parked pods schedulable
             sched.queue.move_all_to_active_or_backoff(
                 ClusterEvent(GVK.POD, ActionType.DELETE))
@@ -86,7 +87,9 @@ def add_all_event_handlers(sched, factory: InformerFactory) -> None:
 
     # --- nodes: feature cache + requeue gating --------------------------
     def node_add(node):
-        sched.cache.upsert_node(node)
+        # on_node_added also re-adopts pods still bound to a previous
+        # same-named incarnation (capacity correctness on node recreate).
+        sched.on_node_added(node)
         sched.queue.move_all_to_active_or_backoff(
             ClusterEvent(GVK.NODE, ActionType.ADD))
 
@@ -97,7 +100,7 @@ def add_all_event_handlers(sched, factory: InformerFactory) -> None:
         sched.queue.move_all_to_active_or_backoff(ev)
 
     def node_delete(node):
-        sched.cache.remove_node(node.metadata.name)
+        sched.on_node_removed(node.metadata.name)
         sched.queue.move_all_to_active_or_backoff(
             ClusterEvent(GVK.NODE, ActionType.DELETE))
 

@@ -31,6 +31,7 @@ import numpy as np
 from ..config import SchedulerConfig
 from ..encode import NodeFeatureCache, encode_pods
 from ..encode.cache import bucket_for
+from ..encode.features import NodeFeatures
 from ..errors import ConflictError, NotFoundError
 from ..ops.pipeline import Decision, build_step
 from ..plugins.base import PluginSet
@@ -203,6 +204,15 @@ class Scheduler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.filter_names = [p.name for p in plugin_set.filter_plugins]
+        # Device-resident static node features, keyed on
+        # (cache.static_version, pad) — see _with_device_static. Touched
+        # only by the scheduling thread.
+        self._nf_static_device = None
+        # node name → pod keys whose bind accounting was dropped when that
+        # node was removed (see on_node_added/on_node_removed; pruned by
+        # on_bound_pod_deleted). Touched only on the informer dispatch
+        # thread.
+        self._orphaned_binds: Dict[str, Set[str]] = {}
         # Timing/counter metrics (beyond the reference's klog-only
         # observability, SURVEY §5): cumulative sums + last-batch values,
         # guarded by a dedicated lock (read from any thread).
@@ -211,7 +221,7 @@ class Scheduler:
             "batches": 0, "pods_seen": 0, "pods_assigned": 0,
             "pods_failed": 0, "pods_bound": 0, "bind_conflicts": 0,
             "encode_s_total": 0.0, "step_s_total": 0.0,
-            "commit_s_total": 0.0,
+            "step_dispatch_s_total": 0.0, "commit_s_total": 0.0,
             "last_batch_size": 0, "last_encode_s": 0.0,
             "last_step_s": 0.0, "last_commit_s": 0.0,
         }
@@ -313,13 +323,24 @@ class Scheduler:
                          volumes_ready_fn=lambda p: vol_state(p)[0],
                          gang_bound_fn=self.cache.gang_bound_count,
                          volume_info_fn=lambda p: vol_state(p)[1:])
-        nf, names = self.cache.snapshot()
+        # Versioned snapshot: the static version is observed under the
+        # snapshot lock (the snapshot's own topology refresh can bump it),
+        # and the cache skips host copies of static leaves we already hold
+        # on device (known_static hit).
+        cached = self._nf_static_device
+        nf, names, static_v = self.cache.snapshot_versioned(
+            known_static=cached[0] if cached else None)
         af = self.cache.snapshot_assigned()
+        nf = self._with_device_static(nf, static_v)
         t_encode = time.perf_counter()
 
         self._step_counter += 1
         key = jax.random.fold_in(self._key, self._step_counter)
         decision: Decision = self._step(eb, nf, af, key)
+        # Dispatch returns before the device finishes (jax async); the
+        # first np.asarray below blocks. Splitting the two reveals whether
+        # step time is host→device feeding or device compute.
+        t_dispatch = time.perf_counter()
 
         chosen = np.asarray(decision.chosen)
         assigned = np.asarray(decision.assigned)
@@ -432,6 +453,7 @@ class Scheduler:
             m["pods_failed"] += len(batch) - n_assigned
             m["encode_s_total"] += t_encode - t0
             m["step_s_total"] += t_step - t_encode
+            m["step_dispatch_s_total"] += t_dispatch - t_encode
             m["commit_s_total"] += t_commit - t_step
             m["last_batch_size"] = len(batch)
             sizes = m.setdefault("batch_sizes", [])
@@ -441,6 +463,74 @@ class Scheduler:
             m["last_step_s"] = t_step - t_encode
             m["last_commit_s"] = t_commit - t_step
         return decision
+
+    # ---- node lifecycle (informer thread) -------------------------------
+
+    def on_node_added(self, node) -> None:
+        """Node appeared: encode it, and RE-ADOPT any pods still bound (in
+        the store) to a previous same-named incarnation. Their accounting
+        was dropped with the old row; without re-adoption the recreated
+        node starts at full free capacity while the store still charges
+        those pods to its name — every new bind then over-commits it.
+        Adoption happens inside the cache's upsert lock hold, so no
+        snapshot can observe the row before its pods are accounted. A pod
+        deleted between the store read here and the upsert is cleaned up
+        by its own DELETE event: this thread dispatches it afterwards and
+        account_unbind reverses the adoption."""
+        name = node.metadata.name
+        adopt = []
+        for key in self._orphaned_binds.pop(name, ()):
+            try:
+                pod = self.store.get("Pod", key)
+            except NotFoundError:
+                continue  # deleted while the node was gone
+            if pod.spec.node_name == name:
+                adopt.append(pod)
+        self.cache.upsert_node(node, bound_pods=adopt)
+
+    def on_node_removed(self, name: str) -> None:
+        """Node deleted: drop its row, remembering which bound pods lost
+        their accounting so a same-named re-add can restore them."""
+        gone = self.cache.remove_node(name)
+        if gone:
+            self._orphaned_binds.setdefault(name, set()).update(gone)
+
+    def on_bound_pod_deleted(self, pod) -> None:
+        """A bound pod vanished: release accounting, and prune any orphan
+        record (its node may never come back — without pruning,
+        _orphaned_binds grows monotonically under name-churning node
+        workloads)."""
+        self.cache.account_unbind(pod.key)
+        orphans = self._orphaned_binds.get(pod.spec.node_name)
+        if orphans is not None:
+            orphans.discard(pod.key)
+            if not orphans:
+                del self._orphaned_binds[pod.spec.node_name]
+
+    # NodeFeatures leaves that change only on node events / topology
+    # refresh — everything except the bind-accounting columns.
+    _STATIC_NF_FIELDS = tuple(f for f in NodeFeatures._fields
+                              if f not in ("free", "used_ports"))
+
+    def _with_device_static(self, nf, static_version: int):
+        """Swap the static node-feature leaves for device-resident copies
+        cached per (static_version, pad). The per-batch host→device
+        transfer then carries only free/used_ports (~a few MB) instead of
+        the full ~tens-of-MB snapshot — on a remote-TPU tunnel the full
+        upload is a fixed cost of every engine step.
+
+        On a cache hit the snapshot's static leaves are None (the cache
+        elided their host copies — snapshot_versioned(known_static=...));
+        on a miss they are real arrays to upload. The leaves can never be
+        None on a miss: the cache elides only when the caller-supplied key
+        equals the key computed here."""
+        key = (static_version, nf.free.shape[0])
+        cached = self._nf_static_device
+        if cached is None or cached[0] != key:
+            leaves = {name: jax.device_put(getattr(nf, name))
+                      for name in self._STATIC_NF_FIELDS}
+            self._nf_static_device = cached = (key, leaves)
+        return nf._replace(**cached[1])
 
     def metrics(self) -> Dict[str, float]:
         """Cumulative and last-batch scheduling metrics plus current queue

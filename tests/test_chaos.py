@@ -139,16 +139,22 @@ def test_chaos_churn_preserves_invariants():
         # historical events is not detectable post-hoc; ordering of NEW
         # events is.)
         lists, w = c.store.list_and_watch()
-        assert len(lists["Pod"]) == len(pods) + 1
+        assert len(lists["Pod"]) == len(pods) + 1, (
+            f"list_and_watch saw {len(lists['Pod'])} pods, expected "
+            f"{len(pods) + 1} (prior list + ch-after)")
         c.create_pod("ch-order-1", cpu=10)
         c.create_pod("ch-order-2", cpu=10)
         rvs = []
-        deadline = time.monotonic() + 5
+        deadline = time.monotonic() + 15
         while len(rvs) < 2 and time.monotonic() < deadline:
             ev = w.next_event(timeout=0.2)
             if ev is not None and ev.kind == "Pod":
                 rvs.append(ev.resource_version)
-        assert rvs[:2] == sorted(rvs[:2]) and len(set(rvs[:2])) == 2
+        assert len(rvs) >= 2, (
+            f"watcher delivered only {rvs} within the deadline "
+            "(ch-order-1/2 events missing)")
+        assert rvs[:2] == sorted(rvs[:2]) and len(set(rvs[:2])) == 2, (
+            f"live events out of rv order: {rvs[:2]}")
     finally:
         c.shutdown()
 

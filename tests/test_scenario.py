@@ -258,3 +258,42 @@ def test_pv_controller_binds_claims(cluster):
     assert wait_until(
         lambda: cluster.store.get("PersistentVolumeClaim", "default/claim2").phase == "Bound",
         timeout=3)
+
+
+def test_node_recreate_readopts_bound_pods(cluster):
+    """A node deleted and recreated under the same name must NOT offer
+    full capacity again while pods from its previous incarnation are
+    still bound to that name in the store (the chaos-suite over-commit:
+    cache accounting was dropped at delete and never restored)."""
+    cluster.start(config=fast_config(max_batch_size=16, batch_window_s=0.0))
+    cluster.create_node("rc-n", cpu=300)  # fits 3 pods of 100
+    for i in range(3):
+        cluster.create_pod(f"rc-a{i}", cpu=100)
+    for i in range(3):
+        cluster.wait_for_pod_bound(f"rc-a{i}", timeout=15)
+
+    import time
+
+    cluster.delete_node("rc-n")
+    wait_until(lambda: cluster.service.scheduler.cache.row_of("rc-n") is None,
+               timeout=10)
+    cluster.create_node("rc-n", cpu=300)  # same name, fresh allocatable
+
+    # The recreated node is FULL (3 × 100 still bound to the name):
+    # a fresh pod must pend, not over-commit.
+    cluster.create_pod("rc-late", cpu=100)
+    time.sleep(1.0)
+    p = cluster.get_pod("rc-late")
+    assert not p.spec.node_name, (
+        f"rc-late bound to {p.spec.node_name} — recreated node "
+        "over-committed (bound incarnation-1 pods not re-adopted)")
+
+    # Deleting one incarnation-1 pod frees a slot; rc-late then binds.
+    cluster.delete_pod("rc-a0")
+    cluster.wait_for_pod_bound("rc-late", timeout=15)
+
+    # Store-level invariant: total bound requests ≤ allocatable.
+    used = sum(pp.spec.requests.get("cpu", 0)
+               for pp in cluster.list_pods()
+               if pp.spec.node_name == "rc-n")
+    assert used <= 300
