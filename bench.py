@@ -291,10 +291,14 @@ def run_child() -> None:
                 Affinity, LabelSelector, PodAffinity, PodAffinityTerm,
                 TopologySpreadConstraint, WeightedPodAffinityTerm)
 
+            # Full BASELINE config-4 shape. Fits one v5e chip only because
+            # the step evaluates pod CHUNKS above the pipeline's memory
+            # threshold (single-pass spread/affinity temps need ~25.5G HBM
+            # vs 15.75G available, measured).
             c4_nodes = int(os.environ.get("MINISCHED_BENCH_C4_NODES",
-                                          str(min(n_nodes, 10000))))
+                                          str(n_nodes)))
             c4_pods = int(os.environ.get("MINISCHED_BENCH_C4_PODS",
-                                         str(min(n_pods, 2000))))
+                                         str(n_pods)))
             detail["config4_shape"] = [c4_nodes, c4_pods]
             c4_make_nodes, c4_make_pods = make_workload(c4_nodes, c4_pods)
             cache4 = NodeFeatureCache(capacity=c4_nodes)

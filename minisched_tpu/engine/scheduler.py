@@ -508,9 +508,11 @@ class Scheduler:
                 del self._orphaned_binds[pod.spec.node_name]
 
     # NodeFeatures leaves that change only on node events / topology
-    # refresh — everything except the bind-accounting columns.
-    _STATIC_NF_FIELDS = tuple(f for f in NodeFeatures._fields
-                              if f not in ("free", "used_ports"))
+    # refresh — derived from the cache's authoritative dynamic list so the
+    # two sides of the elision protocol can never disagree.
+    _STATIC_NF_FIELDS = tuple(
+        f for f in NodeFeatures._fields
+        if f not in NodeFeatureCache.DYNAMIC_NF_FIELDS)
 
     def _with_device_static(self, nf, static_version: int):
         """Swap the static node-feature leaves for device-resident copies

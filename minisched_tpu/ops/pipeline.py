@@ -39,7 +39,9 @@ class Decision(NamedTuple):
     gang_rejected: jnp.ndarray    # (P,) bool — pod's gang missed quorum
     feasible_counts: jnp.ndarray  # (P,) i32 nodes passing all filters
     reject_counts: jnp.ndarray    # (F,P) i32 nodes rejected per filter plugin
-    total_scores: jnp.ndarray     # (P,N) f32 weighted sum (NEG on infeasible)
+    total_scores: jnp.ndarray     # explain: (P,N) f32 weighted sum (NEG on
+    #   infeasible); else (0,N) placeholder — nothing on the scheduling
+    #   path reads it, and a P×N output buffer is HBM the big configs need
     free_after: jnp.ndarray       # (N,R) f32
     # explain mode only (else zero-size placeholders):
     filter_masks: jnp.ndarray     # (F,P,N) bool per-plugin pass mask
@@ -48,6 +50,14 @@ class Decision(NamedTuple):
 
 
 _STEP_CACHE: dict = {}
+
+# Chunked-evaluation thresholds (see the memory-regime comment in step):
+# chunk the filter/score stage when the (P,N) f32 matrix exceeds
+# _CHUNK_WHEN_BYTES, targeting chunks of ~_CHUNK_TARGET_BYTES. Module-level
+# so tests can force the chunked path at small shapes.
+_CHUNK_WHEN_BYTES = 1 << 30
+_CHUNK_TARGET_BYTES = 256 << 20
+_CHUNK_MIN_PODS = 128
 
 
 def build_step(plugin_set: PluginSet, *, explain: bool = False,
@@ -107,7 +117,6 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
         pf = eb.pf
         P = pf.valid.shape[0]
         N = nf.valid.shape[0]
-        valid_pair = pf.valid[:, None] & nf.valid[None, :]
 
         # Shared cycle state (reference CycleState / RunPreScorePlugins):
         # computed once, consumed by any plugin that declared a need.
@@ -122,28 +131,71 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             ctx["na_req_match"] = group_required_match(eb.naf, nf)
             ctx["na_pref_score"] = group_preferred_score(eb.naf, nf)
 
-        masks = [p.filter(pf, nf, ctx) for p in filters]
-        feasible = valid_pair
-        for m in masks:
-            feasible = feasible & m
-        feasible_counts = feasible.sum(axis=1).astype(jnp.int32)
-        if masks:
-            reject_counts = jnp.stack(
-                [(valid_pair & ~m).sum(axis=1).astype(jnp.int32) for m in masks])
+        def evaluate(pf_sub):
+            """Filters + scores for a pod sub-batch against the full node
+            axis → (masked_total, feasible_counts, reject_counts (F,C),
+            explain lists). Every plugin op is pod-row-wise (normalize
+            reduces over axis=1 only), so a sub-batch result equals the
+            corresponding rows of the full-batch result."""
+            valid_pair = pf_sub.valid[:, None] & nf.valid[None, :]
+            # One pass over filters: each (C,N) mask contributes its
+            # reject count and the running AND, then dies — outside
+            # explain mode no list holds all F masks live at once.
+            feasible = valid_pair
+            rc: List[jnp.ndarray] = []
+            masks: List[jnp.ndarray] = []
+            for p in filters:
+                m = p.filter(pf_sub, nf, ctx)
+                rc.append((valid_pair & ~m).sum(axis=1).astype(jnp.int32))
+                feasible = feasible & m
+                if explain:
+                    masks.append(m)
+            feasible_counts = feasible.sum(axis=1).astype(jnp.int32)
+            reject_counts = (jnp.stack(rc) if rc else
+                             jnp.zeros((0, pf_sub.valid.shape[0]),
+                                       dtype=jnp.int32))
+
+            total = jnp.zeros_like(valid_pair, dtype=jnp.float32)
+            raws, norms = [], []
+            for p, w in zip(scorers, weights):
+                raw = p.score(pf_sub, nf, ctx).astype(jnp.float32)
+                norm = p.normalize(raw, feasible).astype(jnp.float32)
+                total = total + w * norm
+                if explain:
+                    raws.append(raw)
+                    norms.append(norm)
+            return (jnp.where(feasible, total, NEG), feasible_counts,
+                    reject_counts, masks, raws, norms)
+
+        # Memory regime: the per-slot topology/affinity math materializes
+        # several (P,N) f32 temps at once; at config-4 shapes (16k pods ×
+        # 65k nodes) that blows HBM (measured 26.5G vs 15.75G). Above a
+        # size threshold, evaluate pod CHUNKS under lax.map so only one
+        # chunk's temps are live while the (P,N) score matrix accumulates
+        # — semantics are unchanged (row-wise ops), the assignment stage
+        # still sees the full matrix. Explain mode needs the full stacks
+        # (and is host-bound anyway); the sharded builder manages memory
+        # by partitioning instead.
+        chunkable = (assign_fn is None and not explain
+                     and P * N * 4 > _CHUNK_WHEN_BYTES)
+        if chunkable:
+            # Halve only through even values: C = P / 2^k always divides P
+            # exactly (an odd division step would break the reshape below
+            # for non-power-of-two pod pads).
+            C = P
+            while (C > _CHUNK_MIN_PODS and C % 2 == 0
+                   and C * N * 4 > _CHUNK_TARGET_BYTES):
+                C //= 2
+            pf_chunks = jax.tree_util.tree_map(
+                lambda a: a.reshape((P // C, C) + a.shape[1:]), pf)
+            mt, fc, rcs, _, _, _ = jax.lax.map(evaluate, pf_chunks)
+            masked_total = mt.reshape(P, N)
+            feasible_counts = fc.reshape(P)
+            reject_counts = rcs.transpose(1, 0, 2).reshape(-1, P)
+            masks, raws, norms = [], [], []
         else:
-            reject_counts = jnp.zeros((0, P), dtype=jnp.int32)
-
-        total = jnp.zeros((P, N), dtype=jnp.float32)
-        raws, norms = [], []
-        for p, w in zip(scorers, weights):
-            raw = p.score(pf, nf, ctx).astype(jnp.float32)
-            norm = p.normalize(raw, feasible).astype(jnp.float32)
-            total = total + w * norm
-            if explain:
-                raws.append(raw)
-                norms.append(norm)
-
-        masked_total = jnp.where(feasible, total, NEG)
+            (masked_total, feasible_counts, reject_counts,
+             masks, raws, norms) = evaluate(pf)
         if assign_fn is not None:
             # Externally-supplied assignment stage (sharded chunked-gather
             # scan; identical results to the default path).
@@ -195,7 +247,11 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             gang_rejected=assign.gang_rejected,
             feasible_counts=feasible_counts,
             reject_counts=reject_counts,
-            total_scores=masked_total,
+            # The (P,N) score matrix is an explain-mode output: nothing on
+            # the scheduling path reads it back, and materializing it as a
+            # program output costs a P×N f32 buffer (4.3GB at 16k×65k).
+            total_scores=(masked_total if explain
+                          else jnp.zeros((0, N), dtype=jnp.float32)),
             free_after=assign.free_after,
             filter_masks=filter_stack,
             raw_scores=raw_stack,

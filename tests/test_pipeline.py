@@ -123,3 +123,56 @@ def test_padding_rows_never_chosen():
     assert names[int(d.chosen[0])] == "n1"
     # padded pod rows unassigned
     assert not np.asarray(d.assigned[1:]).any()
+
+
+def test_chunked_evaluation_matches_unchunked(monkeypatch):
+    """The pod-chunked filter/score path (memory regime for config-4
+    shapes) must be bitwise-identical to single-pass evaluation — forced
+    on at tiny shapes by lowering the module thresholds."""
+    from minisched_tpu.ops import pipeline as pl
+    from minisched_tpu.plugins import (InterPodAffinity, NodeResourcesFit,
+                                       PodTopologySpread)
+    from minisched_tpu.state.objects import (LabelSelector,
+                                             TopologySpreadConstraint)
+
+    c = NodeFeatureCache()
+    for i in range(12):
+        c.upsert_node(node(f"zn{i}", cpu=4000,
+                           labels={"topology.kubernetes.io/zone": f"z{i % 3}"}))
+    nf, names = c.snapshot()
+    pods = []
+    for i in range(8):
+        p = pod(f"cp{i}", cpu=100 + 50 * (i % 2))
+        p.metadata.labels = {"app": "chunk"}
+        p.spec.topology_spread_constraints = [TopologySpreadConstraint(
+            max_skew=1, topology_key="topology.kubernetes.io/zone",
+            when_unsatisfiable="ScheduleAnyway",
+            label_selector=LabelSelector(match_labels={"app": "chunk"}))]
+        pods.append(p)
+    eb = encode_pods(pods, 8, registry=c.registry)
+    af = c.snapshot_assigned()
+    plugins = [NodeUnschedulable(), NodeResourcesFit(score_strategy=None),
+               PodTopologySpread(), InterPodAffinity()]
+    key = jax.random.PRNGKey(3)
+
+    def decide(forced):
+        pl._STEP_CACHE.clear()  # thresholds are baked in at trace time
+        if forced:
+            monkeypatch.setattr(pl, "_CHUNK_WHEN_BYTES", 0)
+            monkeypatch.setattr(pl, "_CHUNK_TARGET_BYTES", 2 * 16 * 4)
+            monkeypatch.setattr(pl, "_CHUNK_MIN_PODS", 2)
+        else:
+            monkeypatch.setattr(pl, "_CHUNK_WHEN_BYTES", 1 << 30)
+        step = build_step(PluginSet(plugins), explain=False)
+        return step(eb, nf, af, key)
+
+    base, chunked = decide(False), decide(True)
+    pl._STEP_CACHE.clear()  # don't leak tiny-chunk steps to other tests
+    assert np.array_equal(np.asarray(base.chosen), np.asarray(chunked.chosen))
+    assert np.array_equal(np.asarray(base.assigned), np.asarray(chunked.assigned))
+    assert np.array_equal(np.asarray(base.feasible_counts),
+                          np.asarray(chunked.feasible_counts))
+    assert np.array_equal(np.asarray(base.reject_counts),
+                          np.asarray(chunked.reject_counts))
+    assert np.allclose(np.asarray(base.free_after),
+                       np.asarray(chunked.free_after))

@@ -275,8 +275,9 @@ def test_node_recreate_readopts_bound_pods(cluster):
     import time
 
     cluster.delete_node("rc-n")
-    wait_until(lambda: cluster.service.scheduler.cache.row_of("rc-n") is None,
-               timeout=10)
+    assert wait_until(
+        lambda: cluster.service.scheduler.cache.row_of("rc-n") is None,
+        timeout=10), "node-delete event never reached the feature cache"
     cluster.create_node("rc-n", cpu=300)  # same name, fresh allocatable
 
     # The recreated node is FULL (3 × 100 still bound to the name):
