@@ -356,12 +356,37 @@ def run_child() -> None:
                 window_s=0.25))
     except Exception as e:
         detail["stream_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(result))
+    sys.stdout.flush()
+
+    # ---- explain-mode overhead -----------------------------------------
+    # Same engine run at 1k nodes with and without the explainability
+    # recorder (off-thread ingest, top-k annotations): the per-decision
+    # observability must stay a small tax, not a second workload.
+    try:
+        if in_budget("explain_overhead_pct"):
+            xn, xp = min(n_nodes, 1000), min(n_pods, 1000)
+            x_nodes, x_pods = make_workload(xn, xp)
+            base = engine_bench(xn, xp, x_nodes, x_pods, plugins,
+                                prefix="xbase")
+            expl = engine_bench(xn, xp, x_nodes, x_pods, plugins,
+                                prefix="xexpl", explain=True)
+            s0 = base.get("xbase_sched_s")
+            s1 = expl.get("xexpl_sched_s")
+            detail["explain_base_sched_s"] = s0
+            detail["explain_sched_s"] = s1
+            if s0 and s1:
+                detail["explain_overhead_pct"] = round(
+                    100.0 * (s1 - s0) / s0, 1)
+    except Exception as e:
+        detail["explain_error"] = f"{type(e).__name__}: {e}"[:300]
 
     emit_and_exit(0)
 
 
 def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
-                 batch_size=None, prefix="engine", window_s=15.0) -> dict:
+                 batch_size=None, prefix="engine", window_s=15.0,
+                 explain=False) -> dict:
     """Schedule the same workload through the REAL engine: store + informers
     + queue + batched cycle + bulk bind; throughput from scheduler.metrics().
     Two passes — the first eats XLA compiles for the engine's pad buckets,
@@ -396,7 +421,8 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
         # n_pods are queued; the window is only the stall-tolerant cap.
         sched = svc.start_scheduler(
             profile, SchedulerConfig(max_batch_size=batch_size,
-                                     batch_window_s=window_s))
+                                     batch_window_s=window_s,
+                                     explain=explain))
         # Cold-start boundary: the scheduler has synced the 50k-node
         # cluster; everything after this point is steady-state serving.
         # engine_total_s includes this bootstrap, engine_sched_s (the

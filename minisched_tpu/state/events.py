@@ -122,28 +122,51 @@ class EventBroadcaster:
 
     def _sink_loop(self) -> None:
         import logging
+        import queue as _queue
+
+        def build(item):
+            involved, reason, message, type_, namespace = item
+            # Name derives from the store-global uid so events never
+            # collide across broadcaster instances or snapshot restores.
+            meta = obj.ObjectMeta(namespace=namespace)
+            meta.name = f"evt-{meta.uid}-{reason.lower()}"
+            return obj.Event(metadata=meta, type=type_, reason=reason,
+                             message=message, involved_object=involved,
+                             source=self._source)
 
         while True:
-            item = self._q.get()
+            # Drain bursts: a 10k-bind batch enqueues 10k events; committing
+            # them one create at a time is 10k store-lock round-trips of
+            # background GIL churn against the scheduling thread. Batch up
+            # to 512 per commit (one lock, one watcher wake-up).
+            items = [self._q.get()]
             try:
-                if item is self._SENTINEL:
-                    return
-                involved, reason, message, type_, namespace = item
-                # Name derives from the store-global uid so events never
-                # collide across broadcaster instances or snapshot restores.
-                meta = obj.ObjectMeta(namespace=namespace)
-                meta.name = f"evt-{meta.uid}-{reason.lower()}"
-                ev = obj.Event(metadata=meta, type=type_, reason=reason,
-                               message=message, involved_object=involved,
-                               source=self._source)
-                try:
-                    self._store.create(ev)
-                except Exception:  # events are best-effort, like upstream
-                    logging.getLogger(__name__).warning(
-                        "dropped event %s for %s", reason, involved,
-                        exc_info=True)
+                while len(items) < 512:
+                    items.append(self._q.get_nowait())
+            except _queue.Empty:
+                pass
+            stop = self._SENTINEL in items
+            batch = [i for i in items if i is not self._SENTINEL]
+            try:
+                if batch:
+                    try:
+                        self._store.create_many([build(i) for i in batch])
+                    except Exception:
+                        # create_many is all-or-nothing (and build() may
+                        # fail on one item): fall back to per-item commits
+                        # so one bad event drops only itself, as the
+                        # pre-batching path did.
+                        for i in batch:
+                            try:
+                                self._store.create(build(i))
+                            except Exception:  # best-effort, like upstream
+                                logging.getLogger(__name__).warning(
+                                    "dropped event %r", i, exc_info=True)
             finally:
-                self._q.task_done()
+                for _ in items:
+                    self._q.task_done()
+            if stop:
+                return
 
     def flush(self, timeout: float = 5.0) -> bool:
         """Wait until every event enqueued so far has been committed."""
