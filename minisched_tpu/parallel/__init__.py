@@ -1,2 +1,2 @@
-from .mesh import make_mesh, shard_features  # noqa: F401
+from .mesh import make_hybrid_mesh, make_mesh, shard_features  # noqa: F401
 from .sharded import build_sharded_step  # noqa: F401

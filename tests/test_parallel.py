@@ -95,3 +95,29 @@ def test_sharded_capacity_causality(eight_devices):
     chosen = np.asarray(d.chosen)
     assert np.asarray(d.assigned).all()
     assert len(set(chosen.tolist())) == 16  # no double-booked node
+
+
+def test_hybrid_mesh_single_process_and_step(eight_devices):
+    """make_hybrid_mesh in a single process degrades to the standard
+    ("pod","node") mesh, and the sharded step compiled over it matches the
+    single-chip step exactly — the same program that on a real multi-host
+    slice puts the pod axis on DCN and the node axis on ICI."""
+    from minisched_tpu.parallel import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(devices=eight_devices)
+    assert mesh.axis_names == ("pod", "node")
+    assert mesh.devices.shape == (2, 4)
+
+    eb, nf, af, names = make_inputs()
+    ps = PluginSet([NodeUnschedulable(), NodeNumber()])
+    key = jax.random.PRNGKey(7)
+    single = build_step(ps)(eb, nf, af, key)
+    step = build_sharded_step(ps, mesh, eb, nf, af)
+    eb_d, nf_d, af_d = shard_features(mesh, eb, nf, af)
+    sharded = step(eb_d, nf_d, af_d, key)
+    np.testing.assert_array_equal(np.asarray(single.chosen),
+                                  np.asarray(sharded.chosen))
+
+    # explicit pod axis override still honored
+    mesh4 = make_hybrid_mesh(pod_axis_size=4, devices=eight_devices)
+    assert mesh4.devices.shape == (4, 2)
