@@ -78,6 +78,7 @@ def run_child() -> None:
 
         detail["platform"] = jax.devices()[0].platform
         detail["device"] = str(jax.devices()[0])
+        detail["host_cores"] = os.cpu_count()
     except Exception as e:  # backend init failed → no numbers possible
         detail["error"] = f"backend init: {type(e).__name__}: {e}"[:500]
         emit_and_exit(1)
@@ -475,6 +476,7 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                 f"{prefix}_step_dispatch_s":
                     round(m["step_dispatch_s_total"], 4),
                 f"{prefix}_commit_s": round(m["commit_s_total"], 4),
+                f"{prefix}_gap_s": round(m.get("gap_s_total", 0.0), 4),
                 f"{prefix}_bind_conflicts": int(m["bind_conflicts"]),
             }
     return out

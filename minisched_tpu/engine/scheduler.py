@@ -222,6 +222,7 @@ class Scheduler:
             "pods_failed": 0, "pods_bound": 0, "bind_conflicts": 0,
             "encode_s_total": 0.0, "step_s_total": 0.0,
             "step_dispatch_s_total": 0.0, "commit_s_total": 0.0,
+            "gap_s_total": 0.0,
             "last_batch_size": 0, "last_encode_s": 0.0,
             "last_step_s": 0.0, "last_commit_s": 0.0,
         }
@@ -271,17 +272,31 @@ class Scheduler:
         """The scheduling loop (reference minisched.go:28-30
         wait.UntilWithContext(ctx, scheduleOne, 0)) — here each iteration
         schedules a whole batch."""
+        last_done = None
         while not self._stop.is_set():
             batch = self.queue.pop_batch(
                 self.config.max_batch_size, timeout=0.2,
                 gather_window=self.config.batch_window_s)
+            if not batch:
+                # Genuine idle (no pending pods) is not inter-batch
+                # overhead; only back-to-back batches feed the gap metric.
+                last_done = None
+                continue
             if batch:
+                # Batch-to-batch dead time (queue pop + informer lag): the
+                # sustained-throughput diagnostic the per-phase timers
+                # inside schedule_batch can't see.
+                if last_done is not None:
+                    with self._metrics_lock:
+                        self._metrics["gap_s_total"] += (
+                            time.perf_counter() - last_done)
                 try:
                     self.schedule_batch(batch)
                 except Exception:
                     log.exception("schedule_batch failed; requeueing batch")
                     for qpi in batch:
                         self.queue.requeue_backoff(qpi)
+                last_done = time.perf_counter()
 
     # ---- one batched scheduling cycle ----------------------------------
 

@@ -86,7 +86,11 @@ def make_hybrid_mesh(pod_axis_size: Optional[int] = None,
         arr = mesh_utils.create_hybrid_device_mesh(
             mesh_shape=(1, per_host),   # ICI: node axis within a host
             dcn_mesh_shape=(n_proc, 1),  # DCN: pod axis across hosts
-            devices=devs)
+            devices=devs,
+            # Granule = PROCESS (host), not slice: without this the DCN
+            # factor counts slices, and a normal multi-host single-slice
+            # topology (n slices = 1 ≠ process count) refuses to build.
+            process_is_granule=True)
         return Mesh(arr, (POD_AXIS, NODE_AXIS))
     return make_mesh(devs, pod_axis_size=pod_axis_size)
 
