@@ -208,6 +208,7 @@ class Scheduler:
         # (cache.static_version, pad) — see _with_device_static. Touched
         # only by the scheduling thread.
         self._nf_static_device = None
+        self._trace_dir: Optional[str] = None  # see trace_next_batch
         # node name → pod keys whose bind accounting was dropped when that
         # node was removed (see on_node_added/on_node_removed; pruned by
         # on_bound_pod_deleted). Touched only on the informer dispatch
@@ -282,25 +283,39 @@ class Scheduler:
                 # overhead; only back-to-back batches feed the gap metric.
                 last_done = None
                 continue
-            if batch:
-                # Batch-to-batch dead time (queue pop + informer lag): the
-                # sustained-throughput diagnostic the per-phase timers
-                # inside schedule_batch can't see.
-                if last_done is not None:
-                    with self._metrics_lock:
-                        self._metrics["gap_s_total"] += (
-                            time.perf_counter() - last_done)
-                try:
-                    self.schedule_batch(batch)
-                except Exception:
-                    log.exception("schedule_batch failed; requeueing batch")
-                    for qpi in batch:
-                        self.queue.requeue_backoff(qpi)
-                last_done = time.perf_counter()
+            # Batch-to-batch dead time (queue pop + informer lag): the
+            # sustained-throughput diagnostic the per-phase timers
+            # inside schedule_batch can't see.
+            if last_done is not None:
+                with self._metrics_lock:
+                    self._metrics["gap_s_total"] += (
+                        time.perf_counter() - last_done)
+            try:
+                self.schedule_batch(batch)
+            except Exception:
+                log.exception("schedule_batch failed; requeueing batch")
+                for qpi in batch:
+                    self.queue.requeue_backoff(qpi)
+            last_done = time.perf_counter()
 
     # ---- one batched scheduling cycle ----------------------------------
 
+    def trace_next_batch(self, trace_dir: str) -> None:
+        """Capture a jax profiler trace (device + host timeline, viewable
+        in TensorBoard/Perfetto) of the NEXT scheduling batch into
+        ``trace_dir``. The reference's observability is klog lines only
+        (SURVEY §5 'no pprof, no timing metrics'); this is the rebuild's
+        deep-dive profiling tool alongside the always-on phase metrics."""
+        self._trace_dir = trace_dir
+
     def schedule_batch(self, batch: List[QueuedPodInfo]) -> Decision:
+        trace_dir, self._trace_dir = self._trace_dir, None
+        if trace_dir:
+            with jax.profiler.trace(trace_dir):
+                return self._schedule_batch_impl(batch)
+        return self._schedule_batch_impl(batch)
+
+    def _schedule_batch_impl(self, batch: List[QueuedPodInfo]) -> Decision:
         cfg = self.config
         # Pull queued gang-mates so no batch boundary splits a gang (the
         # step would reject the partial group for missing quorum). This may
