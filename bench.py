@@ -463,7 +463,16 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                     f"{prefix}_note":
                         "warmup pass reported; did not converge"}
         if attempt == "measured":
+            # Per-pod schedule latency: creation → binding commit stamps
+            # (the BASELINE metric "p50 schedule-one latency @ 50k nodes").
+            import numpy as _np
+
+            lat = [p.status.scheduled_time - p.metadata.creation_timestamp
+                   for p in store.list("Pod") if p.status.scheduled_time]
+            pcts = (_np.percentile(lat, [50, 99]) if lat else (0.0, 0.0))
             out = {
+                f"{prefix}_p50_latency_s": round(float(pcts[0]), 4),
+                f"{prefix}_p99_latency_s": round(float(pcts[1]), 4),
                 f"{prefix}_bound": bound,
                 f"{prefix}_total_s": round(total_s, 4),
                 f"{prefix}_sync_s": round(sync_s, 4),

@@ -208,7 +208,11 @@ class Scheduler:
         # (cache.static_version, pad) — see _with_device_static. Touched
         # only by the scheduling thread.
         self._nf_static_device = None
-        self._trace_dir: Optional[str] = None  # see trace_next_batch
+        # Armed trace request (see trace_next_batch). The lock covers the
+        # arm/consume pair: an unlocked read-then-clear swap on the
+        # scheduling thread could clobber a concurrent arm with None.
+        self._trace_lock = threading.Lock()
+        self._trace_dir: Optional[str] = None
         # node name → pod keys whose bind accounting was dropped when that
         # node was removed (see on_node_added/on_node_removed; pruned by
         # on_bound_pod_deleted). Touched only on the informer dispatch
@@ -306,10 +310,12 @@ class Scheduler:
         ``trace_dir``. The reference's observability is klog lines only
         (SURVEY §5 'no pprof, no timing metrics'); this is the rebuild's
         deep-dive profiling tool alongside the always-on phase metrics."""
-        self._trace_dir = trace_dir
+        with self._trace_lock:
+            self._trace_dir = trace_dir
 
     def schedule_batch(self, batch: List[QueuedPodInfo]) -> Decision:
-        trace_dir, self._trace_dir = self._trace_dir, None
+        with self._trace_lock:
+            trace_dir, self._trace_dir = self._trace_dir, None
         if trace_dir:
             with jax.profiler.trace(trace_dir):
                 return self._schedule_batch_impl(batch)

@@ -283,6 +283,11 @@ class PodStatus:
     # QueuedPodInfo.UnschedulablePlugins, used at queue/queue.go:167-190).
     unschedulable_plugins: List[str] = field(default_factory=list)
     message: str = ""
+    # Wall-clock the binding committed (upstream PodScheduled condition's
+    # lastTransitionTime analog). creation_timestamp → scheduled_time is
+    # the per-pod schedule latency — the BASELINE "p50 schedule-one
+    # latency" metric comes straight from these two stamps.
+    scheduled_time: float = 0.0
 
 
 @dataclass

@@ -259,6 +259,7 @@ class ClusterStore:
             updated.status.phase = obj.PodPhase.RUNNING
             updated.status.unschedulable_plugins = []
             updated.status.message = ""
+            updated.status.scheduled_time = time.time()
             return self.update(updated)
 
     def bind_pods(self, assignments) -> List[str]:
@@ -274,6 +275,7 @@ class ClusterStore:
         import dataclasses as _dc
 
         bound: List[str] = []
+        now = time.time()
         with self._cond:
             for pod_key, node_name in assignments:
                 pod = self._objects["Pod"].get(pod_key)
@@ -287,7 +289,8 @@ class ClusterStore:
                     metadata=_dc.replace(pod.metadata, resource_version=self._rv),
                     spec=_dc.replace(pod.spec, node_name=node_name),
                     status=_dc.replace(pod.status, phase=obj.PodPhase.RUNNING,
-                                       unschedulable_plugins=[], message=""))
+                                       unschedulable_plugins=[], message="",
+                                       scheduled_time=now))
                 self._objects["Pod"][pod_key] = new
                 self._append(WatchEvent(EventType.MODIFIED, "Pod", new, pod,
                                         self._rv))
