@@ -201,6 +201,16 @@ def run_child() -> None:
         detail[label] = "skipped (phase budget)"
         return False
 
+    def warm_and_time(step_fn, *args):
+        """Shared phase methodology: one warm call (eats the compile),
+        then one timed call. Returns (device_s, decision)."""
+        dw = step_fn(*args)
+        jax.block_until_ready(dw.chosen)
+        t0 = time.perf_counter()
+        dw = step_fn(*args)
+        jax.block_until_ready(dw.chosen)
+        return round(time.perf_counter() - t0, 4), dw
+
     # ---- pallas vs scan: equality + timings (TPU only) -----------------
     try:
         from minisched_tpu.ops.pallas_select import pallas_supported
@@ -211,12 +221,8 @@ def run_child() -> None:
             d_scan = None
             for name, flag in (("pallas", True), ("scan", False)):
                 v_step = build_step(plugin_set, explain=False, pallas=flag)
-                dv = v_step(eb, nf, af, key)
-                jax.block_until_ready(dv.chosen)
-                t0 = time.perf_counter()
-                dv = v_step(eb, nf, af, key)
-                jax.block_until_ready(dv.chosen)
-                detail[f"device_s_{name}"] = round(time.perf_counter() - t0, 4)
+                detail[f"device_s_{name}"], dv = warm_and_time(
+                    v_step, eb, nf, af, key)
                 if flag:
                     d_pallas = dv
                 else:
@@ -245,12 +251,8 @@ def run_child() -> None:
                 p.spec.pod_group_min = 8
             eb5 = encode_pods(pods5, p_pad, registry=cache.registry)
             step5 = build_step(plugin_set, explain=False)
-            d5 = step5(eb5, nf, af, key)
-            jax.block_until_ready(d5.chosen)
-            t0 = time.perf_counter()
-            d5 = step5(eb5, nf, af, key)
-            jax.block_until_ready(d5.chosen)
-            detail["config5_device_s"] = round(time.perf_counter() - t0, 4)
+            detail["config5_device_s"], d5 = warm_and_time(
+                step5, eb5, nf, af, key)
             detail["config5_scheduled"] = int(np.asarray(d5.assigned).sum())
             detail["config5_gang_rejected_pods"] = int(
                 np.asarray(d5.gang_rejected).sum())
@@ -259,17 +261,56 @@ def run_child() -> None:
     print(json.dumps(result))
     sys.stdout.flush()
 
+    # ---- BASELINE configs 2 + 3 (staged-ladder completeness) -----------
+    # Config 2: 1k nodes × 100 pods, NodeNumber score only (the "first TPU
+    # smoke" config). Config 3: 10k × 1k, NodeResourcesFit +
+    # LeastAllocated (dense constraint/score matrix). The headline subsumes
+    # both computationally; measuring them makes BENCH_TPU.json cover the
+    # whole BASELINE ladder explicitly.
+    try:
+        from minisched_tpu.plugins import (NodeNumber, NodeResourcesFit,
+                                           NodeResourcesLeastAllocated,
+                                           NodeUnschedulable, PluginSet)
+
+        for label, (cn, cp, ps_small) in {
+            "config2": (1000, 100, PluginSet([NodeUnschedulable(),
+                                              NodeNumber()])),
+            "config3": (10000, 1000, PluginSet(
+                [NodeUnschedulable(),
+                 NodeResourcesFit(score_strategy=None),
+                 NodeResourcesLeastAllocated()])),
+        }.items():
+            # Per-config budget gate (each pays its own XLA compile), and
+            # shapes clamp to the attempt's global shape so the CPU
+            # fallback's deliberate reduction applies here too.
+            if not in_budget(f"{label}_device_s"):
+                continue
+            cn, cp = min(cn, n_nodes), min(cp, n_pods)
+            c_make_nodes, c_make_pods = make_workload(cn, cp)
+            c_cache = NodeFeatureCache(capacity=cn)
+            for node in c_make_nodes():
+                c_cache.upsert_node(node)
+            c_eb = encode_pods(c_make_pods(), _pad_to(cp),
+                               registry=c_cache.registry)
+            c_nf, _ = c_cache.snapshot(pad=_pad_to(cn))
+            c_af = c_cache.snapshot_assigned()
+            c_step = build_step(ps_small, explain=False)
+            detail[f"{label}_shape"] = [cn, cp]
+            detail[f"{label}_device_s"], dc = warm_and_time(
+                c_step, c_eb, c_nf, c_af, key)
+            detail[f"{label}_scheduled"] = int(np.asarray(dc.assigned).sum())
+    except Exception as e:
+        detail["config23_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(result))
+    sys.stdout.flush()
+
     # ---- auction assignment mode -------------------------------------
     try:
         if in_budget("device_s_auction"):
             a_step = build_step(plugin_set, explain=False,
                                 assignment="auction")
-            da = a_step(eb, nf, af, key)
-            jax.block_until_ready(da.chosen)
-            t0 = time.perf_counter()
-            da = a_step(eb, nf, af, key)
-            jax.block_until_ready(da.chosen)
-            detail["device_s_auction"] = round(time.perf_counter() - t0, 4)
+            detail["device_s_auction"], da = warm_and_time(
+                a_step, eb, nf, af, key)
             detail["auction_scheduled"] = int(np.asarray(da.assigned).sum())
     except Exception as e:
         detail["auction_error"] = f"{type(e).__name__}: {e}"[:300]
@@ -328,13 +369,10 @@ def run_child() -> None:
             af4 = cache4.snapshot_assigned()
             step4 = build_step(ps4, explain=False)
             t0 = time.perf_counter()
-            d4 = step4(eb4, nf4, af4, key)
-            jax.block_until_ready(d4.chosen)
+            jax.block_until_ready(step4(eb4, nf4, af4, key).chosen)
             detail["config4_compile_s"] = round(time.perf_counter() - t0, 2)
-            t0 = time.perf_counter()
-            d4 = step4(eb4, nf4, af4, key)
-            jax.block_until_ready(d4.chosen)
-            detail["config4_device_s"] = round(time.perf_counter() - t0, 4)
+            detail["config4_device_s"], d4 = warm_and_time(
+                step4, eb4, nf4, af4, key)
             detail["config4_scheduled"] = int(np.asarray(d4.assigned).sum())
     except Exception as e:
         detail["config4_error"] = f"{type(e).__name__}: {e}"[:300]
