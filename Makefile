@@ -9,7 +9,7 @@ PY ?= python
 # a wedged tunnel can't hang backend init.
 CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: test start bench bench_sharded dryrun
+.PHONY: test start bench bench_sharded dryrun soak
 
 # Unit + integration suite on a virtual 8-device CPU mesh.
 test:
@@ -34,3 +34,11 @@ bench_sharded:
 # step on an 8-device virtual mesh.
 dryrun:
 	$(CPU_MESH) $(PY) __graft_entry__.py
+
+# Concurrency soak: repeat the chaos suite (threaded churn + invariants).
+# SOAK_N overrides the repeat count.
+SOAK_N ?= 5
+soak:
+	@for i in $$(seq 1 $(SOAK_N)); do \
+	  $(CPU_MESH) $(PY) -m pytest tests/test_chaos.py -x -q || exit 1; \
+	done
