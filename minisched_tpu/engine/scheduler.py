@@ -145,6 +145,103 @@ def arbitrate_rwo(batch: List[QueuedPodInfo], assigned, chosen,
     return revoked, parked_gangs
 
 
+def batch_group_match(batch: List[QueuedPodInfo], gf) -> np.ndarray:
+    """(P_live, G) bool: batch pod i's namespace+labels match selector
+    group g — the HOST twin of ops.topology.group_assigned_match (same
+    hash functions, same all-zero-selector = match-all and ns_hash 0 =
+    any-namespace semantics), evaluated over the batch pods themselves
+    (their labels are host objects; the device only encodes groups).
+    Label-pair rows are memoized per distinct signature — a deployment's
+    replicas share one."""
+    from ..encode import features as F
+
+    P, G = len(batch), gf.valid.shape[0]
+    sel = np.asarray(gf.sel_pairs, dtype=np.int64)   # (G,QT)
+    gvalid = np.asarray(gf.valid)
+    gns = np.asarray(gf.ns_hash, dtype=np.int64)
+    ns_memo: Dict[str, int] = {}
+    # per distinct label signature: the (G,) selector-match row
+    sig_memo: Dict[tuple, np.ndarray] = {}
+    match = np.zeros((P, G), dtype=bool)
+    for i, qpi in enumerate(batch):
+        pod = qpi.pod
+        sig = tuple(pod.metadata.labels.items())
+        sel_ok = sig_memo.get(sig)
+        if sel_ok is None:
+            s = {F.pair_hash(k, v) for k, v in sig}
+            sel_ok = np.array([
+                all((int(p) in s) for p in sel[g] if p != 0)
+                for g in range(G)])
+            sig_memo[sig] = sel_ok
+        nsv = ns_memo.get(pod.metadata.namespace)
+        if nsv is None:
+            nsv = ns_memo[pod.metadata.namespace] = (
+                F._h(pod.metadata.namespace) if pod.metadata.namespace else 0)
+        match[i] = gvalid & ((gns == 0) | (gns == nsv)) & sel_ok
+    return match
+
+
+def arbitrate_spread(batch: List[QueuedPodInfo], assigned, pf, gf,
+                     spread_pre, spread_dom, spread_min,
+                     dead: Set[int]) -> Set[int]:
+    """Intra-batch hard-spread arbitration → additional revoked indices.
+
+    Every batch pod was filtered/scored against PRE-batch topology counts,
+    so a burst can jointly violate a DoNotSchedule max_skew no single pod
+    violates alone (the sequential reference sees each prior placement).
+    Walk assignments in priority order carrying in-batch per-(group,
+    domain) count deltas — fed by EVERY matching assigned pod, hard
+    constraint or not, exactly like the committed counts would be; a pod
+    whose own hard slot would exceed max_skew at its turn (judged against
+    the conservative pre-batch min — in-batch additions can only raise the
+    true min, so this never under-revokes) is revoked and retried next
+    cycle, where the committed counts are visible. Gang atomicity: one
+    revoked member revokes its whole gang.
+
+    Inputs: pf/gf (host-side encoded batch), spread_pre/dom (P,G) and
+    spread_min (G,) from the step (state at each pod's chosen node),
+    ``dead`` = indices already revoked upstream (they never commit, so
+    they contribute no deltas)."""
+    from ..encode import features as F
+
+    if spread_pre.shape[0] == 0:
+        return set()
+    hard = ((pf.spread_group >= 0)
+            & (pf.spread_mode == F.SPREAD_DO_NOT_SCHEDULE))[:len(batch)]
+    if not hard.any():
+        return set()
+    match = batch_group_match(batch, gf)
+    delta: Dict[tuple, int] = {}
+    revoked: Set[int] = set()
+    for i in range(len(batch)):
+        if not assigned[i] or i in dead:
+            continue
+        viol = False
+        for c in np.nonzero(hard[i])[0]:
+            g = int(pf.spread_group[i, c])
+            d = int(spread_dom[i, g])
+            after = float(spread_pre[i, g]) + delta.get((g, d), 0) + 1
+            if after - float(spread_min[g]) > float(
+                    pf.spread_max_skew[i, c]):
+                viol = True
+                break
+        if viol:
+            revoked.add(i)
+            continue
+        for g in np.nonzero(match[i])[0]:
+            d = int(spread_dom[i, int(g)])
+            if d >= 0:  # node lacks the group's key → no domain membership
+                delta[(int(g), d)] = delta.get((int(g), d), 0) + 1
+    # gang atomicity over the new revocations
+    gangs = {gang_key(batch[i].pod) for i in revoked
+             if batch[i].pod.spec.pod_group}
+    if gangs:
+        for i, qpi in enumerate(batch):
+            if assigned[i] and i not in dead and gang_key(qpi.pod) in gangs:
+                revoked.add(i)
+    return revoked
+
+
 class Scheduler:
     def __init__(self, store, plugin_set: PluginSet,
                  config: Optional[SchedulerConfig] = None,
@@ -199,6 +296,10 @@ class Scheduler:
         # claim exclusivity is part of the profile.
         self._rwo_enabled = any(p.name == "VolumeRestrictions"
                                 for p in plugin_set.plugins)
+        # Intra-batch hard-spread arbitration only applies when the
+        # topology-spread plugin is part of the profile (arbitrate_spread).
+        self._spread_enabled = any(p.name == "PodTopologySpread"
+                                   for p in plugin_set.plugins)
         # WFFC candidate-zone memo: pvc key → (zones, computed_at).
         self._wffc_memo: Dict[str, tuple] = {}
         self._stop = threading.Event()
@@ -402,6 +503,19 @@ class Scheduler:
                     batch[i], {BATCH_CAPACITY},
                     "RWO claim pinned by an earlier pod in this batch",
                     retryable=True)
+
+        if self._spread_enabled:
+            s_revoked = arbitrate_spread(
+                batch, assigned, eb.pf, eb.gf,
+                np.asarray(decision.spread_pre),
+                np.asarray(decision.spread_dom),
+                np.asarray(decision.spread_min), dead=revoked)
+            for i in s_revoked:
+                self._handle_failure(
+                    batch[i], {BATCH_CAPACITY},
+                    "placement would breach max_skew within this batch; "
+                    "retrying against committed counts", retryable=True)
+            revoked = revoked | s_revoked
 
         to_bind: List[tuple] = []  # permit-free (qpi, node_name) pairs
         # With no permit plugins in the profile (the common case) the

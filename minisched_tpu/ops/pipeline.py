@@ -43,6 +43,19 @@ class Decision(NamedTuple):
     #   infeasible); else (0,N) placeholder — nothing on the scheduling
     #   path reads it, and a P×N output buffer is HBM the big configs need
     free_after: jnp.ndarray       # (N,R) f32
+    # Per-pod × per-selector-GROUP state at the CHOSEN node, for the
+    # engine's intra-batch skew arbitration (sequential spread semantics
+    # the batch can't see: every pod scored against pre-batch counts, so
+    # a burst can jointly violate a DoNotSchedule constraint none
+    # violates alone). Group space, not constraint-slot space: the
+    # arbitration must also count matching batch pods that carry no hard
+    # constraint themselves. (P,G)/(G,) when the profile runs topology
+    # plugins, else zero-size:
+    spread_pre: jnp.ndarray       # (P,G) f32 pre-batch count in chosen's
+    #                               domain under each group's key
+    spread_dom: jnp.ndarray       # (P,G) i32 chosen node's domain id (-1
+    #                               = node lacks the key / unassigned)
+    spread_min: jnp.ndarray       # (G,) f32 pre-batch min over domains
     # explain mode only (else zero-size placeholders):
     filter_masks: jnp.ndarray     # (F,P,N) bool per-plugin pass mask
     raw_scores: jnp.ndarray       # (S,P,N) f32 pre-normalize
@@ -229,6 +242,26 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
                 masked_total, pf.requests, nf.free,
                 eb.gang.group, eb.gang.min_count, key, greedy_fn=greedy_fn)
 
+        # Spread-arbitration inputs: per (pod, GROUP), gathered at the
+        # ASSIGNED node, so they must come after the assignment stage.
+        # Cheap — (P,G) gathers with G = distinct selector groups (small).
+        if needs_topology and "counts_node" in ctx:
+            G = eb.gf.valid.shape[0]
+            safe_row = jnp.clip(assign.chosen, 0, N - 1)         # (P,)
+            live = assign.assigned[:, None] & eb.gf.valid[None, :]
+            spread_pre = jnp.where(
+                live, ctx["counts_node"][:, safe_row].T, 0.0)    # (P,G)
+            gkey = jnp.clip(eb.gf.key_idx, 0,
+                            nf.topo_domains.shape[0] - 1)        # (G,)
+            spread_dom = jnp.where(
+                live, nf.topo_domains[gkey][:, safe_row].T, -1)  # (P,G)
+            spread_min = ctx["min_count"]                        # (G,)
+        else:
+            G = eb.gf.valid.shape[0]
+            spread_pre = jnp.zeros((0, G), dtype=jnp.float32)
+            spread_dom = jnp.full((0, G), -1, dtype=jnp.int32)
+            spread_min = jnp.zeros((0,), dtype=jnp.float32)
+
         if explain:
             filter_stack = (jnp.stack(masks) if masks
                             else jnp.zeros((0, P, N), dtype=bool))
@@ -253,6 +286,9 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             total_scores=(masked_total if explain
                           else jnp.zeros((0, N), dtype=jnp.float32)),
             free_after=assign.free_after,
+            spread_pre=spread_pre,
+            spread_min=spread_min,
+            spread_dom=spread_dom,
             filter_masks=filter_stack,
             raw_scores=raw_stack,
             norm_scores=norm_stack,

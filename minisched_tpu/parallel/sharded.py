@@ -63,6 +63,9 @@ def build_sharded_step(plugin_set: PluginSet, mesh, eb_template, nf_template,
         feasible_counts=pod_only,
         reject_counts=NamedSharding(mesh, P(None, POD_AXIS)),
         total_scores=both, free_after=node_res,
+        spread_pre=NamedSharding(mesh, P(POD_AXIS, None)),
+        spread_dom=NamedSharding(mesh, P(POD_AXIS, None)),
+        spread_min=NamedSharding(mesh, P()),
         filter_masks=stack_both, raw_scores=stack_both, norm_scores=stack_both)
 
     return jax.jit(stepfn, in_shardings=(eb_sh, nf_sh, af_sh, key_sh),
