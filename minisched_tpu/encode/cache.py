@@ -62,6 +62,12 @@ class NodeFeatureCache:
         # this a replacement member of a running gang could never schedule.
         self._gang_bound: Dict[str, int] = {}
         self._key_gang: Dict[str, str] = {}
+        # Required anti-affinity terms of RUNNING pods (upstream symmetric
+        # enforcement): sig=(key_idx, ns_hash, sel_pairs) → {node row:
+        # count of bound pods holding that term on that row}. Feeds
+        # anti_forbidden_for → encode.anti_forbid slots.
+        self._anti_terms: Dict[tuple, Dict[int, int]] = {}
+        self._pod_anti: Dict[str, List[tuple]] = {}  # pod key → sigs
         self.overflow: List[str] = []  # encoding-slot overflow reports
         self.version = 0  # bumped on every mutation (cheap staleness check)
         # Bumped only when STATIC node features change (node add/update/
@@ -131,6 +137,7 @@ class NodeFeatureCache:
                     self._assigned.label_pairs[a] = 0
                     self._a_free.append(a)
                 self._drop_gang_member(k)
+                self._anti_drop_locked(k, i)
             self.version += 1
             self.static_version += 1
             return gone
@@ -180,6 +187,7 @@ class NodeFeatureCache:
                     continue
                 batch_seen.add(pod.key)
                 if (reqs is None or pod.spec.volumes or pod.spec.ports
+                        or self._pod_has_anti(pod)
                         or pod.key in self._bound):
                     self._account_bind_locked(
                         pod, node_name,
@@ -274,6 +282,7 @@ class NodeFeatureCache:
         if group:
             self._key_gang[pod.key] = group
             self._gang_bound[group] = self._gang_bound.get(group, 0) + 1
+        self._anti_add_locked(pod, i)
 
         a = self._alloc_assigned_row()
         self._a_row[pod.key] = a
@@ -308,6 +317,7 @@ class NodeFeatureCache:
                 self._assigned.label_pairs[a] = 0
                 self._a_free.append(a)
             self._drop_gang_member(pod_key)
+            self._anti_drop_locked(pod_key, i)
             self.version += 1
 
     def _drop_gang_member(self, pod_key: str) -> None:
@@ -464,6 +474,87 @@ class NodeFeatureCache:
     def row_of(self, name: str) -> Optional[int]:
         with self._lock:
             return self._index.get(name)
+
+    # ---- symmetric anti-affinity table ----------------------------------
+
+    @staticmethod
+    def _pod_has_anti(pod: Pod) -> bool:
+        a = pod.spec.affinity
+        return bool(a and a.pod_anti_affinity and a.pod_anti_affinity.required)
+
+    def _anti_sigs(self, pod: Pod) -> List[tuple]:
+        """Signatures of the pod's required anti terms, mirroring
+        encode.GroupBuilder's (key_idx, ns_hash, sorted sel pairs) —
+        the two sides must agree for symmetric matching to line up."""
+        if not self._pod_has_anti(pod):
+            return []
+        ns_h = (F._h(pod.metadata.namespace)
+                if pod.metadata.namespace else 0)
+        sigs = []
+        for term in pod.spec.affinity.pod_anti_affinity.required:
+            key_idx = self.registry.index_of(term.topology_key, self.overflow)
+            if key_idx < 0:
+                continue
+            ns = (F._h(term.namespaces[0]) if term.namespaces else ns_h)
+            pairs: tuple = ()
+            if term.label_selector is not None:
+                raw = sorted(F.pair_hash(k, v) for k, v in
+                             term.label_selector.match_labels.items())
+                pairs = tuple(raw[: self.cfg.max_term_selector_pairs])
+            sigs.append((key_idx, ns, pairs))
+        return sigs
+
+    def _anti_add_locked(self, pod: Pod, row: int) -> None:
+        sigs = self._anti_sigs(pod)
+        if sigs:
+            self._pod_anti[pod.key] = sigs
+            for sig in sigs:
+                rows = self._anti_terms.setdefault(sig, {})
+                rows[row] = rows.get(row, 0) + 1
+
+    def _anti_drop_locked(self, pod_key: str, row: int) -> None:
+        for sig in self._pod_anti.pop(pod_key, ()):
+            rows = self._anti_terms.get(sig)
+            if not rows:
+                continue
+            n = rows.get(row, 0) - 1
+            if n > 0:
+                rows[row] = n
+            else:
+                rows.pop(row, None)
+            if not rows:
+                self._anti_terms.pop(sig, None)
+
+    def anti_forbidden_for(self, pod: Pod) -> List[Tuple[int, int]]:
+        """(key_idx, domain) pairs the pod must avoid: domains holding a
+        RUNNING pod whose required anti-affinity term matches this pod
+        (upstream existing-pod anti-affinity symmetry; term semantics
+        mirror the device side: empty selector = match-all, term namespace
+        defaults to the owner pod's). Feeds encode.anti_forbid slots via
+        the engine's encode callback."""
+        with self._lock:
+            if not self._anti_terms:
+                return []
+            self._refresh_topology_locked()
+            ns_h = (F._h(pod.metadata.namespace)
+                    if pod.metadata.namespace else 0)
+            labels = {F.pair_hash(k, v)
+                      for k, v in pod.metadata.labels.items()}
+            out: List[Tuple[int, int]] = []
+            seen = set()
+            for (key_idx, ns, pairs), rows in self._anti_terms.items():
+                # ns 0 = any-namespace wildcard, mirroring the device
+                # group convention (a term owner with no namespace).
+                if ns != 0 and ns != ns_h:
+                    continue
+                if not all(p in labels for p in pairs):
+                    continue
+                for row in rows:
+                    dom = int(self._feats.topo_domains[key_idx, row])
+                    if dom >= 0 and (key_idx, dom) not in seen:
+                        seen.add((key_idx, dom))
+                        out.append((key_idx, dom))
+            return out
 
     # ---- internals ------------------------------------------------------
 

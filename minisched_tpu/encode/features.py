@@ -83,6 +83,10 @@ class EncodingConfig:
     max_term_selector_pairs: int = 4  # match_labels pairs per term selector
     domain_buckets: int = 4096   # hashed domain space for non-hostname keys
     max_pod_claims: int = 4      # PVC references per pod (volume plugins)
+    # forbidden (topology key, domain) slots per pod: domains occupied by a
+    # RUNNING pod whose required anti-affinity term matches this pod
+    # (upstream existing-pod anti-affinity symmetry)
+    max_anti_forbid: int = 4
 
 
 # Spread when_unsatisfiable codes.
@@ -255,6 +259,10 @@ class PodFeatures(NamedTuple):
     anti_req_group: np.ndarray   # (P,T) i32 required anti-affinity terms
     anti_pref_group: np.ndarray  # (P,T) i32 preferred anti-affinity terms
     anti_pref_weight: np.ndarray  # (P,T) f32
+    # Symmetric existing-pod anti-affinity (upstream parity): domains this
+    # pod must avoid because a RUNNING pod's required anti term matches it.
+    anti_forbid_key: np.ndarray  # (P,S) i32 topology-key idx, -1 unused
+    anti_forbid_dom: np.ndarray  # (P,S) i32 domain id under that key
 
 
 class GroupFeatures(NamedTuple):
@@ -630,7 +638,8 @@ def encode_pods(pods: List[Pod], p_pad: int,
                 volumes_ready_fn=None,
                 group_pad: Optional[int] = None,
                 gang_bound_fn=None,
-                volume_info_fn=None):
+                volume_info_fn=None,
+                anti_forbidden_fn=None):
     """Encode a batch of pending pods, padded to ``p_pad`` rows.
 
     Returns an EncodedBatch: pod features plus the batch's distinct
@@ -641,6 +650,9 @@ def encode_pods(pods: List[Pod], p_pad: int,
     ``volume_info_fn(pod) -> (claim_rows, zone_key_idx, zone_dom)`` supplies
     the VolumeRestrictions / VolumeZone inputs (engine resolves them from
     the store + node cache) — default: unrestricted, no zone requirement.
+    ``anti_forbidden_fn(pod) -> [(key_idx, dom_id), ...]`` supplies domains
+    occupied by RUNNING pods whose required anti-affinity terms match this
+    pod (cache.anti_forbidden_for) — default: none.
     """
     if registry is None:
         registry = TopologyKeyRegistry(cfg)
@@ -677,6 +689,8 @@ def encode_pods(pods: List[Pod], p_pad: int,
         anti_req_group=np.full((P, T), -1, dtype=np.int32),
         anti_pref_group=np.full((P, T), -1, dtype=np.int32),
         anti_pref_weight=np.zeros((P, T), dtype=np.float32),
+        anti_forbid_key=np.full((P, cfg.max_anti_forbid), -1, dtype=np.int32),
+        anti_forbid_dom=np.full((P, cfg.max_anti_forbid), -1, dtype=np.int32),
     )
     gang_group = np.full(P, -1, dtype=np.int32)
     gang_ids: Dict[str, int] = {}
@@ -767,6 +781,16 @@ def encode_pods(pods: List[Pod], p_pad: int,
             _encode_pod_affinity_terms(
                 i, pa.preferred, f.aff_pref_group, f.aff_pref_weight, builder,
                 registry, ns_h, overflow, f"pod {pod.key} podAffinity.preferred")
+        if anti_forbidden_fn is not None:
+            pairs = anti_forbidden_fn(pod)
+            if len(pairs) > cfg.max_anti_forbid and overflow is not None:
+                overflow.append(
+                    f"pod {pod.key} anti-affinity forbidden domains: "
+                    f"{len(pairs)} > {cfg.max_anti_forbid} slots")
+            for s, (fk, fd) in enumerate(pairs[:cfg.max_anti_forbid]):
+                f.anti_forbid_key[i, s] = fk
+                f.anti_forbid_dom[i, s] = fd
+
         anti = aff.pod_anti_affinity if aff else None
         if anti:
             _encode_pod_affinity_terms(

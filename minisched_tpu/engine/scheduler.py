@@ -183,20 +183,30 @@ def batch_group_match(batch: List[QueuedPodInfo], gf) -> np.ndarray:
 
 def arbitrate_spread(batch: List[QueuedPodInfo], assigned, pf, gf,
                      spread_pre, spread_dom, spread_min,
-                     dead: Set[int]) -> Set[int]:
-    """Intra-batch hard-spread arbitration → additional revoked indices.
+                     dead: Set[int], anti_enabled: bool = True) -> Set[int]:
+    """Intra-batch topology arbitration → additional revoked indices.
 
     Every batch pod was filtered/scored against PRE-batch topology counts,
-    so a burst can jointly violate a DoNotSchedule max_skew no single pod
-    violates alone (the sequential reference sees each prior placement).
+    so a burst can jointly commit constraints none violates alone (the
+    sequential reference sees each prior placement):
+
+      * hard (DoNotSchedule) spread: a burst can stack one domain past
+        max_skew;
+      * required anti-affinity: two mutually-exclusive batch pods can
+        both land in one domain — direct (the later pod's own anti term
+        matches an earlier placement) and symmetric (an earlier pod's
+        anti term matches the later pod).
+
     Walk assignments in priority order carrying in-batch per-(group,
-    domain) count deltas — fed by EVERY matching assigned pod, hard
-    constraint or not, exactly like the committed counts would be; a pod
-    whose own hard slot would exceed max_skew at its turn (judged against
-    the conservative pre-batch min — in-batch additions can only raise the
-    true min, so this never under-revokes) is revoked and retried next
-    cycle, where the committed counts are visible. Gang atomicity: one
-    revoked member revokes its whole gang.
+    domain) deltas — membership deltas fed by EVERY matching assigned
+    pod, constraint or not, and anti-term deltas by each survivor's own
+    anti terms. Spread is judged against the conservative pre-batch min
+    (in-batch additions can only raise the true min, so this never
+    under-revokes). Violators are revoked and retried next cycle, where
+    the committed counts are visible — required AFFINITY needs no
+    arbitration: in-batch blindness can only under-admit, and the parked
+    pod is revived by the peer's bind event. Gang atomicity: one revoked
+    member revokes its whole gang.
 
     Inputs: pf/gf (host-side encoded batch), spread_pre/dom (P,G) and
     spread_min (G,) from the step (state at each pod's chosen node),
@@ -206,14 +216,21 @@ def arbitrate_spread(batch: List[QueuedPodInfo], assigned, pf, gf,
 
     if spread_pre.shape[0] == 0:
         return set()
+    P = len(batch)
     hard = ((pf.spread_group >= 0)
-            & (pf.spread_mode == F.SPREAD_DO_NOT_SCHEDULE))[:len(batch)]
-    if not hard.any():
+            & (pf.spread_mode == F.SPREAD_DO_NOT_SCHEDULE))[:P]
+    anti = pf.anti_req_group[:P]                     # (P,T), -1 unused
+    # Anti terms are always encoded, but only the InterPodAffinity filter
+    # ENFORCES them — arbitrating them in a profile that ignores them
+    # would revoke pods the next cycle happily co-locates anyway.
+    has_anti = anti_enabled and bool((anti >= 0).any())
+    if not hard.any() and not has_anti:
         return set()
     match = batch_group_match(batch, gf)
-    delta: Dict[tuple, int] = {}
+    delta: Dict[tuple, int] = {}       # (g,d) → matching pods placed
+    anti_delta: Dict[tuple, int] = {}  # (g,d) → anti-terms-on-g placed in d
     revoked: Set[int] = set()
-    for i in range(len(batch)):
+    for i in range(P):
         if not assigned[i] or i in dead:
             continue
         viol = False
@@ -225,6 +242,21 @@ def arbitrate_spread(batch: List[QueuedPodInfo], assigned, pf, gf,
                     pf.spread_max_skew[i, c]):
                 viol = True
                 break
+        if not viol and has_anti:
+            for t in np.nonzero(anti[i] >= 0)[0]:
+                g = int(anti[i, t])
+                d = int(spread_dom[i, g])
+                # direct: an earlier matching placement in my domain
+                if d >= 0 and delta.get((g, d), 0) > 0:
+                    viol = True
+                    break
+            if not viol:
+                # symmetric: an earlier pod's anti term targets ME
+                for g in np.nonzero(match[i])[0]:
+                    d = int(spread_dom[i, int(g)])
+                    if d >= 0 and anti_delta.get((int(g), d), 0) > 0:
+                        viol = True
+                        break
         if viol:
             revoked.add(i)
             continue
@@ -232,6 +264,12 @@ def arbitrate_spread(batch: List[QueuedPodInfo], assigned, pf, gf,
             d = int(spread_dom[i, int(g)])
             if d >= 0:  # node lacks the group's key → no domain membership
                 delta[(int(g), d)] = delta.get((int(g), d), 0) + 1
+        if has_anti:
+            for t in np.nonzero(anti[i] >= 0)[0]:
+                g = int(anti[i, t])
+                d = int(spread_dom[i, g])
+                if d >= 0:
+                    anti_delta[(g, d)] = anti_delta.get((g, d), 0) + 1
     # gang atomicity over the new revocations
     gangs = {gang_key(batch[i].pod) for i in revoked
              if batch[i].pod.spec.pod_group}
@@ -296,10 +334,16 @@ class Scheduler:
         # claim exclusivity is part of the profile.
         self._rwo_enabled = any(p.name == "VolumeRestrictions"
                                 for p in plugin_set.plugins)
-        # Intra-batch hard-spread arbitration only applies when the
-        # topology-spread plugin is part of the profile (arbitrate_spread).
-        self._spread_enabled = any(p.name == "PodTopologySpread"
-                                   for p in plugin_set.plugins)
+        # Intra-batch topology arbitration (hard spread + required
+        # anti-affinity, arbitrate_spread) applies when either topology
+        # plugin is in the profile.
+        self._spread_enabled = any(
+            p.name in ("PodTopologySpread", "InterPodAffinity")
+            for p in plugin_set.plugins)
+        # Symmetric existing-pod anti-affinity is enforced by the
+        # InterPodAffinity filter via encode.anti_forbid slots.
+        self._anti_enabled = any(p.name == "InterPodAffinity"
+                                 for p in plugin_set.plugins)
         # WFFC candidate-zone memo: pvc key → (zones, computed_at).
         self._wffc_memo: Dict[str, tuple] = {}
         self._stop = threading.Event()
@@ -459,7 +503,9 @@ class Scheduler:
                          overflow=self.cache.overflow,
                          volumes_ready_fn=lambda p: vol_state(p)[0],
                          gang_bound_fn=self.cache.gang_bound_count,
-                         volume_info_fn=lambda p: vol_state(p)[1:])
+                         volume_info_fn=lambda p: vol_state(p)[1:],
+                         anti_forbidden_fn=(self.cache.anti_forbidden_for
+                                            if self._anti_enabled else None))
         # Versioned snapshot: the static version is observed under the
         # snapshot lock (the snapshot's own topology refresh can bump it),
         # and the cache skips host copies of static leaves we already hold
@@ -509,12 +555,15 @@ class Scheduler:
                 batch, assigned, eb.pf, eb.gf,
                 np.asarray(decision.spread_pre),
                 np.asarray(decision.spread_dom),
-                np.asarray(decision.spread_min), dead=revoked)
+                np.asarray(decision.spread_min), dead=revoked,
+                anti_enabled=self._anti_enabled)
             for i in s_revoked:
                 self._handle_failure(
                     batch[i], {BATCH_CAPACITY},
-                    "placement would breach max_skew within this batch; "
-                    "retrying against committed counts", retryable=True)
+                    "placement would breach a topology constraint "
+                    "(max_skew / required anti-affinity) within this "
+                    "batch; retrying against committed counts",
+                    retryable=True)
             revoked = revoked | s_revoked
 
         to_bind: List[tuple] = []  # permit-free (qpi, node_name) pairs

@@ -13,7 +13,15 @@ group g, "a matching pod exists in the node's domain" ⇔ counts_node[g] > 0.
                           pod).
 
 Counts see pods bound before this batch (same batching semantics as
-PodTopologySpread).
+PodTopologySpread); intra-batch required-anti-affinity conflicts — direct
+and symmetric between two pods of the SAME batch — are caught by the
+engine's priority-order arbitration (engine.scheduler.arbitrate_spread)
+and retried. The SYMMETRIC check against already-RUNNING pods (upstream's
+existing-pod anti-affinity) is enforced via per-pod forbidden-domain
+slots: the node cache tracks bound pods' required anti terms
+(cache.anti_forbidden_for), the encoder stamps matching incoming pods
+with the occupied (key, domain) pairs, and the filter masks those
+domains below.
 """
 from __future__ import annotations
 
@@ -54,6 +62,18 @@ class InterPodAffinity(BatchedPlugin):
             acounts = gather_group_rows(ag, ctx["counts_node"])
             adom = gather_group_rows(ag, ctx["dom_valid"].astype(jnp.float32)) > 0
             ok = ok & jnp.where((ag >= 0)[:, None], ~(adom & (acounts > 0)), True)
+
+        # Symmetric existing-pod anti-affinity (upstream parity): mask
+        # domains a RUNNING pod's required anti term forbids for THIS pod
+        # (encode.anti_forbid slots, fed by the cache's anti-term table).
+        S = pf.anti_forbid_key.shape[1]
+        K = nf.topo_domains.shape[0]
+        for s in range(S):
+            k = pf.anti_forbid_key[:, s]                     # (P,)
+            d = pf.anti_forbid_dom[:, s]
+            node_dom = nf.topo_domains[jnp.clip(k, 0, K - 1)]  # (P,N)
+            ok = ok & jnp.where((k >= 0)[:, None],
+                                node_dom != d[:, None], True)
         return ok
 
     def score(self, pf, nf, ctx) -> jnp.ndarray:

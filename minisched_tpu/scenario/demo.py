@@ -40,12 +40,11 @@ def demo_scenario(c: Cluster) -> None:
                 spec=obj.PodSpec(requests={"cpu": 200},
                                  topology_spread_constraints=[spread]))
         for i in range(6)])
-    zones = {}
+    zones = {f"z{i}": 0 for i in range(3)}  # count EVERY zone: a 3-3-0
+    # split is a skew-3 violation a present-zones-only dict would hide
     for i in range(6):
         p = c.wait_for_pod_bound(f"web-{i}", timeout=20)
-        node = c.get_node(p.spec.node_name)
-        zones[node.metadata.labels[ZONE_KEY]] = \
-            zones.get(node.metadata.labels[ZONE_KEY], 0) + 1
+        zones[c.get_node(p.spec.node_name).metadata.labels[ZONE_KEY]] += 1
     assert max(zones.values()) - min(zones.values()) <= 1, zones
     print(f"spread: 6 replicas balanced across zones {dict(sorted(zones.items()))}")
 
@@ -55,9 +54,9 @@ def demo_scenario(c: Cluster) -> None:
                 spec=obj.PodSpec(requests={"cpu": 100}, pod_group="train",
                                  pod_group_min=4))
         for i in range(3)])  # 3 members < quorum 4 → all park
-    wait_until(lambda: all(
+    assert wait_until(lambda: all(
         c.get_pod(f"trainer-{i}").status.unschedulable_plugins
-        for i in range(3)), timeout=20)
+        for i in range(3)), timeout=20), "gang members never attempted"
     assert not any(c.get_pod(f"trainer-{i}").spec.node_name for i in range(3))
     print("gang: 3/4 members parked (quorum not met, none bound)")
 

@@ -217,3 +217,37 @@ def test_snapshot_versioned_static_elision_and_atomicity():
     c.account_bind(pod("p0", cpu=10), node_name="n0")
     _, _, sv4 = c.snapshot_versioned()
     assert sv4 == sv3
+
+
+def test_anti_term_table_bind_unbind_refcount():
+    """The cache's running-pod anti-term table must refcount per (term,
+    row): two pods with the same term on one node keep the domain
+    forbidden until BOTH leave; anti_forbidden_for matches only pods the
+    selector + namespace actually cover."""
+    from minisched_tpu.state.objects import (Affinity, LabelSelector,
+                                             PodAffinityTerm, PodAntiAffinity)
+
+    zone = "topology.kubernetes.io/zone"
+    c = NodeFeatureCache()
+    c.upsert_node(node("an-1", labels={zone: "za"}))
+    anti = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+        PodAffinityTerm(label_selector=LabelSelector(match_labels={"a": "x"}),
+                        topology_key=zone)]))
+    p1, p2 = pod("ap1", affinity=anti), pod("ap2", affinity=anti)
+    c.account_bind(p1, node_name="an-1")
+    c.account_bind(p2, node_name="an-1")
+
+    victim = pod("vic")
+    victim.metadata.labels = {"a": "x"}
+    assert len(c.anti_forbidden_for(victim)) == 1
+    other_ns = pod("vic2", ns="other")
+    other_ns.metadata.labels = {"a": "x"}
+    assert c.anti_forbidden_for(other_ns) == []  # term ns = owner's ns
+    nomatch = pod("vic3")
+    nomatch.metadata.labels = {"a": "y"}
+    assert c.anti_forbidden_for(nomatch) == []
+
+    c.account_unbind(p1.key)
+    assert len(c.anti_forbidden_for(victim)) == 1  # p2 still holds it
+    c.account_unbind(p2.key)
+    assert c.anti_forbidden_for(victim) == []

@@ -327,11 +327,10 @@ def test_intra_batch_spread_arbitration():
                     spec=obj.PodSpec(requests={"cpu": 100},
                                      topology_spread_constraints=[spread]))
             for i in range(6)])
-        zones = {}
+        zones = {f"z{i}": 0 for i in range(3)}  # count EVERY zone
         for i in range(6):
             p = c.wait_for_pod_bound(f"sp-p{i}", timeout=20)
-            z = c.get_node(p.spec.node_name).metadata.labels[zone]
-            zones[z] = zones.get(z, 0) + 1
+            zones[c.get_node(p.spec.node_name).metadata.labels[zone]] += 1
         assert max(zones.values()) - min(zones.values()) <= 1, zones
     finally:
         c.shutdown()
@@ -391,5 +390,117 @@ def test_spread_arbitration_counts_unconstrained_matching_pods():
         # and hard-c must not be the one creating a 3/0 or a 2-vs-0 split.
         assert max(per_zone.values()) - min(per_zone.get(z, 0)
                                             for z in ("za", "zb")) <= 1, per_zone
+    finally:
+        c.shutdown()
+
+
+def test_intra_batch_required_anti_affinity():
+    """Two mutually-exclusive pods arriving in ONE batch must not both
+    bind into the same zone — direct (B's own anti term matches A's
+    placement) and symmetric (A's anti term matches B) directions. The
+    device filter only sees pre-batch counts; the engine arbitration
+    walks the batch in priority order."""
+    from minisched_tpu.state import objects as obj
+
+    zone = "topology.kubernetes.io/zone"
+    c = Cluster()
+    try:
+        c.start(profile=Profile(plugins=["NodeUnschedulable",
+                                         "NodeResourcesFit",
+                                         "InterPodAffinity"]),
+                config=fast_config(max_batch_size=16, batch_window_s=0.3))
+        c.create_node("aa-n0", cpu=2000, labels={zone: "za"})
+        c.create_node("aa-n1", cpu=2000, labels={zone: "zb"})
+        anti = obj.Affinity(pod_anti_affinity=obj.PodAntiAffinity(
+            required=[obj.PodAffinityTerm(
+                label_selector=obj.LabelSelector(match_labels={"app": "xc"}),
+                topology_key=zone)]))
+        # direct: both carry the anti term AND the label
+        c.create_objects([
+            obj.Pod(metadata=obj.ObjectMeta(name=f"xc-{i}",
+                                            namespace="default",
+                                            labels={"app": "xc"}),
+                    spec=obj.PodSpec(requests={"cpu": 100}, affinity=anti))
+            for i in range(2)])
+        c.wait_for_pod_bound("xc-0", timeout=20)
+        c.wait_for_pod_bound("xc-1", timeout=20)
+        z0 = c.get_node(c.get_pod("xc-0").spec.node_name).metadata.labels[zone]
+        z1 = c.get_node(c.get_pod("xc-1").spec.node_name).metadata.labels[zone]
+        assert z0 != z1, (z0, z1)
+
+        # symmetric: A carries the anti term vs app=sy but NOT the label;
+        # B carries the label but no constraint. One batch; B must avoid
+        # A's zone (or A must avoid B's) — never co-located.
+        anti_sy = obj.Affinity(pod_anti_affinity=obj.PodAntiAffinity(
+            required=[obj.PodAffinityTerm(
+                label_selector=obj.LabelSelector(match_labels={"app": "sy"}),
+                topology_key=zone)]))
+        c.create_objects([
+            obj.Pod(metadata=obj.ObjectMeta(name="guard",
+                                            namespace="default",
+                                            labels={"app": "other"}),
+                    spec=obj.PodSpec(requests={"cpu": 100},
+                                     affinity=anti_sy, priority=10)),
+            obj.Pod(metadata=obj.ObjectMeta(name="intruder",
+                                            namespace="default",
+                                            labels={"app": "sy"}),
+                    spec=obj.PodSpec(requests={"cpu": 100})),
+        ])
+        c.wait_for_pod_bound("guard", timeout=20)
+        c.wait_for_pod_bound("intruder", timeout=20)
+        zg = c.get_node(c.get_pod("guard").spec.node_name).metadata.labels[zone]
+        zi = c.get_node(c.get_pod("intruder").spec.node_name).metadata.labels[zone]
+        assert zg != zi, (zg, zi)
+    finally:
+        c.shutdown()
+
+
+def test_symmetric_anti_affinity_vs_running_pod():
+    """Upstream existing-pod anti-affinity: a RUNNING pod's required anti
+    term must repel later arrivals that match it — the guard binds FIRST
+    (separate cycle), then the intruder arrives and must land in the
+    other zone; with only one zone available it must stay pending."""
+    from minisched_tpu.state import objects as obj
+
+    zone = "topology.kubernetes.io/zone"
+    c = Cluster()
+    try:
+        c.start(profile=Profile(plugins=["NodeUnschedulable",
+                                         "NodeResourcesFit",
+                                         "InterPodAffinity"]),
+                config=fast_config(max_batch_size=16, batch_window_s=0.0))
+        c.create_node("sr-n0", cpu=2000, labels={zone: "za"})
+        anti = obj.Affinity(pod_anti_affinity=obj.PodAntiAffinity(
+            required=[obj.PodAffinityTerm(
+                label_selector=obj.LabelSelector(match_labels={"app": "ry"}),
+                topology_key=zone)]))
+        c.create_pod("sr-guard", cpu=100, affinity=anti)
+        c.wait_for_pod_bound("sr-guard", timeout=15)
+
+        # Intruder matches the guard's anti term; only zone za exists →
+        # it must NOT bind (the guard's term forbids its own zone).
+        c.create_objects([obj.Pod(
+            metadata=obj.ObjectMeta(name="sr-intruder2", namespace="default",
+                                    labels={"app": "ry"}),
+            spec=obj.PodSpec(requests={"cpu": 100}))])
+        p = c.wait_for_pod_pending("sr-intruder2", timeout=20)
+        assert "InterPodAffinity" in p.status.unschedulable_plugins
+
+        # A second zone appears → the intruder binds there, not in za.
+        c.create_node("sr-n1", cpu=2000, labels={zone: "zb"})
+        bound = c.wait_for_pod_bound("sr-intruder2", timeout=20)
+        assert bound.spec.node_name == "sr-n1"
+
+        # The guard leaving frees its domain: a third matching pod can
+        # then use za again (table decrements on unbind).
+        c.delete_pod("sr-guard")
+        c.create_objects([obj.Pod(
+            metadata=obj.ObjectMeta(name="sr-late", namespace="default",
+                                    labels={"app": "ry"}),
+            spec=obj.PodSpec(requests={"cpu": 100}))])
+        # sr-late matches intruder2's... intruder2 has NO anti term, so za
+        # (now empty of anti terms) must admit sr-late.
+        bound2 = c.wait_for_pod_bound("sr-late", timeout=20)
+        assert bound2.spec.node_name in ("sr-n0", "sr-n1")
     finally:
         c.shutdown()
