@@ -24,6 +24,41 @@ from .features import (AssignedPodFeatures, DEFAULT_ENCODING, EncodingConfig,
                        NodeFeatures, TopologyKeyRegistry)
 
 
+class OverflowLog(list):
+    """Bounded, deduplicating sink for encoding-slot overflow reports.
+
+    Keeps the plain-list interface encode callbacks expect (append/iter)
+    but drops repeats — the same pod's overflow re-reports on every
+    account_bind during churn — and caps total retained entries so a
+    long-lived scheduler cannot leak memory proportional to bind count.
+    """
+
+    MAX = 512
+
+    def __init__(self):
+        super().__init__()
+        self._seen: set = set()
+        self._truncated = False
+        # Written from both the informer thread (account_bind → _anti_sigs)
+        # and the scheduling thread (encode_pods) — the check-then-act
+        # dedup must be atomic.
+        self._applock = threading.Lock()
+
+    def append(self, msg: str) -> None:  # type: ignore[override]
+        with self._applock:
+            if msg in self._seen:
+                return
+            if len(self._seen) >= self.MAX:
+                if not self._truncated:
+                    self._truncated = True
+                    super().append(
+                        f"... overflow log truncated at {self.MAX} distinct "
+                        "messages; further reports dropped")
+                return
+            self._seen.add(msg)
+            super().append(msg)
+
+
 def bucket_for(n: int, minimum: int = 16) -> int:
     """Smallest power-of-two bucket ≥ n (≥ minimum)."""
     b = minimum
@@ -68,7 +103,10 @@ class NodeFeatureCache:
         # anti_forbidden_for → encode.anti_forbid slots.
         self._anti_terms: Dict[tuple, Dict[int, int]] = {}
         self._pod_anti: Dict[str, List[tuple]] = {}  # pod key → sigs
-        self.overflow: List[str] = []  # encoding-slot overflow reports
+        # Encoding-slot overflow reports: deduplicated and bounded — bind
+        # churn re-reports the same pod's overflow on every account_bind,
+        # and nothing drains this sink in production.
+        self.overflow: List[str] = OverflowLog()
         self.version = 0  # bumped on every mutation (cheap staleness check)
         # Bumped only when STATIC node features change (node add/update/
         # remove, topology-domain refresh) — NOT on bind/unbind accounting,
@@ -493,15 +531,29 @@ class NodeFeatureCache:
         sigs = []
         for term in pod.spec.affinity.pod_anti_affinity.required:
             key_idx = self.registry.index_of(term.topology_key, self.overflow)
-            if key_idx < 0:
-                continue
-            ns = (F._h(term.namespaces[0]) if term.namespaces else ns_h)
+            # key_idx < 0 (registry full): the term's domains cannot be
+            # represented. Keep the signature with the sentinel key rather
+            # than dropping the term — anti_forbidden_for surfaces it as an
+            # unrepresentable (-1, -1) pair so the engine FAILS CLOSED for
+            # matching pods instead of silently permitting them.
+            # Multiple namespaces are exact here (host-side matching): one
+            # signature per namespace, each matched independently.
+            ns_list = ([F._h(n) for n in term.namespaces]
+                       if term.namespaces else [ns_h])
             pairs: tuple = ()
             if term.label_selector is not None:
                 raw = sorted(F.pair_hash(k, v) for k, v in
                              term.label_selector.match_labels.items())
+                if len(raw) > self.cfg.max_term_selector_pairs:
+                    # Truncation BROADENS the match (repels more pods) —
+                    # the conservative direction for a hard constraint.
+                    self.overflow.append(
+                        f"anti-affinity term on {pod.key}: selector pairs "
+                        f"overflow ({len(raw)} > "
+                        f"{self.cfg.max_term_selector_pairs}); truncated")
                 pairs = tuple(raw[: self.cfg.max_term_selector_pairs])
-            sigs.append((key_idx, ns, pairs))
+            for ns in ns_list:
+                sigs.append((key_idx, ns, pairs))
         return sigs
 
     def _anti_add_locked(self, pod: Pod, row: int) -> None:
@@ -549,6 +601,14 @@ class NodeFeatureCache:
                     continue
                 if not all(p in labels for p in pairs):
                     continue
+                if key_idx < 0:
+                    # Unrepresentable term (registry was full when its
+                    # owner bound): forbidden domains unknown — emit the
+                    # sentinel so the engine fails closed for this pod.
+                    if (-1, -1) not in seen:
+                        seen.add((-1, -1))
+                        out.append((-1, -1))
+                    continue
                 for row in rows:
                     dom = int(self._feats.topo_domains[key_idx, row])
                     if dom >= 0 and (key_idx, dom) not in seen:
@@ -590,11 +650,17 @@ class NodeFeatureCache:
     def _refresh_topology_locked(self) -> None:
         """Recompute domain tables if new topology keys registered since the
         last snapshot (pod encoding may grow the shared registry)."""
-        if self._topo_version == self.registry.version:
+        # Snapshot the version ONCE at entry: a concurrent index_of on the
+        # scheduling thread mid-loop would otherwise mark this refresh
+        # current while early rows were computed without the new key.
+        v = self.registry.version
+        if self._topo_version == v:
             return
+        keys = self.registry.keys()  # one lock + copy, not one per row
         for name, i in self._index.items():
-            F.compute_topo_domains_row(self._feats, i, self.registry, self.cfg)
-        self._topo_version = self.registry.version
+            F.compute_topo_domains_row(self._feats, i, self.registry,
+                                       self.cfg, keys=keys)
+        self._topo_version = v
         self.static_version += 1
 
     def _recompute_free_row(self, i: int) -> None:

@@ -22,6 +22,7 @@ SURVEY §7 "dynamic shapes").
 from __future__ import annotations
 
 import functools
+import threading
 import zlib
 from dataclasses import dataclass
 from typing import Dict, List, NamedTuple, Optional, Tuple
@@ -151,24 +152,32 @@ class TopologyKeyRegistry:
         self._keys = [HOSTNAME_KEY]
         self._idx = {HOSTNAME_KEY: 0}
         self.version = 1
+        # The registry is reached from both the informer thread
+        # (cache.account_bind → _anti_sigs) and the scheduling thread
+        # (encode_pods / GroupBuilder); the two-step insert below must not
+        # interleave or a key is permanently mapped to the wrong slot.
+        self._lock = threading.Lock()
 
     def index_of(self, key: str, overflow: Optional[List[str]] = None) -> int:
-        idx = self._idx.get(key)
-        if idx is not None:
+        with self._lock:
+            idx = self._idx.get(key)
+            if idx is not None:
+                return idx
+            if len(self._keys) >= self.max:
+                if overflow is not None:
+                    overflow.append(
+                        f"topology key registry full ({self.max}); "
+                        f"cannot register {key!r}")
+                return -1
+            idx = len(self._keys)
+            self._idx[key] = idx
+            self._keys.append(key)
+            self.version += 1
             return idx
-        if len(self._keys) >= self.max:
-            if overflow is not None:
-                overflow.append(
-                    f"topology key registry full ({self.max}); "
-                    f"cannot register {key!r}")
-            return -1
-        self._idx[key] = len(self._keys)
-        self._keys.append(key)
-        self.version += 1
-        return self._idx[key]
 
     def keys(self) -> List[str]:
-        return list(self._keys)
+        with self._lock:
+            return list(self._keys)
 
 
 class NodeFeatures(NamedTuple):
@@ -346,12 +355,15 @@ def empty_assigned_features(a: int, cfg: EncodingConfig = DEFAULT_ENCODING
 
 def compute_topo_domains_row(feats: NodeFeatures, i: int,
                              registry: TopologyKeyRegistry,
-                             cfg: EncodingConfig = DEFAULT_ENCODING) -> None:
-    """Fill topo_domains[:, i] for one node row from its label slots."""
+                             cfg: EncodingConfig = DEFAULT_ENCODING,
+                             keys: Optional[List[str]] = None) -> None:
+    """Fill topo_domains[:, i] for one node row from its label slots.
+    ``keys`` lets a bulk refresh snapshot registry.keys() once instead of
+    taking the registry lock and copying the list per node row."""
     feats.topo_domains[:, i] = -1
     if not feats.valid[i]:
         return
-    for k, key in enumerate(registry.keys()):
+    for k, key in enumerate(registry.keys() if keys is None else keys):
         if k == 0:  # hostname: every node is its own domain
             feats.topo_domains[0, i] = i
             continue
@@ -462,22 +474,32 @@ class GroupBuilder:
     def __init__(self, cfg: EncodingConfig = DEFAULT_ENCODING):
         self.cfg = cfg
         self._groups: Dict[tuple, int] = {}
+        # Set by group_of when the returned group's selector was WEAKENED
+        # (match_expressions dropped or selector pairs truncated) — the
+        # group matches a superset of the real constraint. Callers
+        # encoding a HARD constraint must then fail the pod closed.
+        self.last_weakened = False
 
     def group_of(self, key_idx: int, ns_hash: int, selector,
                  overflow: Optional[List[str]], what: str) -> int:
+        self.last_weakened = False
         if key_idx < 0:
             return -1
         pairs: Tuple[int, ...] = ()
         if selector is not None:
-            if selector.match_expressions and overflow is not None:
-                overflow.append(
-                    f"{what}: match_expressions in term selector unsupported")
+            if selector.match_expressions:
+                if overflow is not None:
+                    overflow.append(
+                        f"{what}: match_expressions in term selector "
+                        "unsupported")
+                self.last_weakened = True
             raw = sorted(pair_hash(k, v)
                          for k, v in selector.match_labels.items())
             if len(raw) > self.cfg.max_term_selector_pairs:
                 if overflow is not None:
                     overflow.append(f"{what}: selector pairs overflow")
                 raw = raw[: self.cfg.max_term_selector_pairs]
+                self.last_weakened = True
             pairs = tuple(raw)
         sig = (key_idx, ns_hash, pairs)
         gid = self._groups.get(sig)
@@ -604,11 +626,19 @@ class NodeAffinityBuilder:
 
 def _encode_pod_affinity_terms(i, terms, group_arr, weight_arr, builder,
                                registry, pod_ns_hash, overflow, what,
-                               self_arr=None, pod_labels=None):
-    """Encode PodAffinityTerm list (plain or weighted) into group slots."""
+                               self_arr=None, pod_labels=None) -> bool:
+    """Encode PodAffinityTerm list (plain or weighted) into group slots.
+
+    Returns True when a REQUIRED term (weight_arr is None) could not be
+    represented — truncated past the slot count, or its topology key
+    failed to register — so the caller can fail the pod closed rather
+    than schedule it against a silently weakened hard constraint."""
     T = group_arr.shape[1]
-    if len(terms) > T and overflow is not None:
-        overflow.append(f"{what}: {len(terms)} terms > {T} slots")
+    hard_dropped = False
+    if len(terms) > T:
+        if overflow is not None:
+            overflow.append(f"{what}: {len(terms)} terms > {T} slots")
+        hard_dropped = weight_arr is None
     for t, term in enumerate(terms[:T]):
         if weight_arr is not None:
             weight, term = term.weight, term.term
@@ -616,19 +646,27 @@ def _encode_pod_affinity_terms(i, terms, group_arr, weight_arr, builder,
             weight = None
         k_idx = registry.index_of(term.topology_key, overflow)
         if term.namespaces:
-            if len(term.namespaces) > 1 and overflow is not None:
-                overflow.append(f"{what}: multiple namespaces unsupported")
+            if len(term.namespaces) > 1:
+                if overflow is not None:
+                    overflow.append(
+                        f"{what}: multiple namespaces unsupported")
+                if weight_arr is None:  # required term weakened to ns[0]
+                    hard_dropped = True
             ns = _h(term.namespaces[0])
         else:
             ns = pod_ns_hash
         group_arr[i, t] = builder.group_of(k_idx, ns, term.label_selector,
                                            overflow, what)
+        if (group_arr[i, t] < 0 or builder.last_weakened) \
+                and weight_arr is None:
+            hard_dropped = True
         if weight is not None and group_arr[i, t] >= 0:
             weight_arr[i, t] = float(weight)
         if self_arr is not None and group_arr[i, t] >= 0:
             self_arr[i, t] = (ns == pod_ns_hash
                               and (term.label_selector is None
                                    or term.label_selector.matches(pod_labels or {})))
+    return hard_dropped
 
 
 def encode_pods(pods: List[Pod], p_pad: int,
@@ -639,7 +677,8 @@ def encode_pods(pods: List[Pod], p_pad: int,
                 group_pad: Optional[int] = None,
                 gang_bound_fn=None,
                 volume_info_fn=None,
-                anti_forbidden_fn=None):
+                anti_forbidden_fn=None,
+                hard_failed: Optional[Dict[int, Tuple[str, str]]] = None):
     """Encode a batch of pending pods, padded to ``p_pad`` rows.
 
     Returns an EncodedBatch: pod features plus the batch's distinct
@@ -653,9 +692,18 @@ def encode_pods(pods: List[Pod], p_pad: int,
     ``anti_forbidden_fn(pod) -> [(key_idx, dom_id), ...]`` supplies domains
     occupied by RUNNING pods whose required anti-affinity terms match this
     pod (cache.anti_forbidden_for) — default: none.
+    ``hard_failed`` (optional out-param): pod index → (plugin name, reason)
+    for pods whose HARD constraint (required affinity/anti-affinity term,
+    DoNotSchedule spread) could not be represented in the encoding slots —
+    the engine fails such pods closed instead of scheduling them against a
+    silently weakened constraint.
     """
     if registry is None:
         registry = TopologyKeyRegistry(cfg)
+
+    def _mark_hard(idx: int, plugin: str, reason: str) -> None:
+        if hard_failed is not None and idx not in hard_failed:
+            hard_failed[idx] = (plugin, reason)
     builder = GroupBuilder(cfg)
     na_builder = NodeAffinityBuilder(cfg)
     P = p_pad
@@ -758,14 +806,29 @@ def encode_pods(pods: List[Pod], p_pad: int,
 
         ns_h = _h(pod.metadata.namespace) if pod.metadata.namespace else 0
         cons = pod.spec.topology_spread_constraints
-        if len(cons) > C and overflow is not None:
-            overflow.append(f"pod {pod.key} spread constraints overflow")
+        if len(cons) > C:
+            if overflow is not None:
+                overflow.append(f"pod {pod.key} spread constraints overflow")
+            if any(t.when_unsatisfiable == "DoNotSchedule" for t in cons[C:]):
+                _mark_hard(i, "PodTopologySpread",
+                           f"DoNotSchedule spread constraints exceed the "
+                           f"{C} encoder slots")
         for c, tsc in enumerate(cons[:C]):
             k_idx = registry.index_of(tsc.topology_key, overflow)
             gid = builder.group_of(k_idx, ns_h, tsc.label_selector, overflow,
                                    f"pod {pod.key} spread[{c}]")
+            hard = tsc.when_unsatisfiable == "DoNotSchedule"
             if gid < 0:
+                if hard:
+                    _mark_hard(i, "PodTopologySpread",
+                               f"DoNotSchedule spread topology key "
+                               f"{tsc.topology_key!r} could not be "
+                               "registered (registry full)")
                 continue
+            if builder.last_weakened and hard:
+                _mark_hard(i, "PodTopologySpread",
+                           "DoNotSchedule spread selector could not be "
+                           "fully represented (pairs/expressions overflow)")
             f.spread_group[i, c] = gid
             f.spread_max_skew[i, c] = int(tsc.max_skew)
             f.spread_mode[i, c] = (SPREAD_DO_NOT_SCHEDULE
@@ -774,10 +837,13 @@ def encode_pods(pods: List[Pod], p_pad: int,
 
         pa = aff.pod_affinity if aff else None
         if pa:
-            _encode_pod_affinity_terms(
-                i, pa.required, f.aff_req_group, None, builder, registry,
-                ns_h, overflow, f"pod {pod.key} podAffinity",
-                self_arr=f.aff_req_self, pod_labels=pod.metadata.labels)
+            if _encode_pod_affinity_terms(
+                    i, pa.required, f.aff_req_group, None, builder, registry,
+                    ns_h, overflow, f"pod {pod.key} podAffinity",
+                    self_arr=f.aff_req_self, pod_labels=pod.metadata.labels):
+                _mark_hard(i, "InterPodAffinity",
+                           "required pod-affinity term could not be "
+                           "represented (slot or registry overflow)")
             _encode_pod_affinity_terms(
                 i, pa.preferred, f.aff_pref_group, f.aff_pref_weight, builder,
                 registry, ns_h, overflow, f"pod {pod.key} podAffinity.preferred")
@@ -793,9 +859,12 @@ def encode_pods(pods: List[Pod], p_pad: int,
 
         anti = aff.pod_anti_affinity if aff else None
         if anti:
-            _encode_pod_affinity_terms(
-                i, anti.required, f.anti_req_group, None, builder, registry,
-                ns_h, overflow, f"pod {pod.key} podAntiAffinity")
+            if _encode_pod_affinity_terms(
+                    i, anti.required, f.anti_req_group, None, builder,
+                    registry, ns_h, overflow, f"pod {pod.key} podAntiAffinity"):
+                _mark_hard(i, "InterPodAffinity",
+                           "required pod-anti-affinity term could not be "
+                           "represented (slot or registry overflow)")
             _encode_pod_affinity_terms(
                 i, anti.preferred, f.anti_pref_group, f.anti_pref_weight,
                 builder, registry, ns_h, overflow,

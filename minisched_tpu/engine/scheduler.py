@@ -498,14 +498,48 @@ class Scheduler:
             return st
 
         t0 = time.perf_counter()
+        # Fail closed on unrepresentable hard constraints: a pod whose
+        # required anti-affinity/affinity term or DoNotSchedule spread
+        # constraint cannot fit the encoding slots (or whose forbidden
+        # domains exceed the anti_forbid slots) would otherwise be
+        # scheduled against a silently weakened constraint — record the
+        # pod with its reason and reject it after the step instead.
+        fail_closed: Dict[str, tuple] = {}  # pod key → (plugin, reason)
+        anti_fn = None
+        if self._anti_enabled:
+            max_forbid = self.cache.cfg.max_anti_forbid
+
+            def anti_fn(pod: Pod) -> List[tuple]:
+                pairs = self.cache.anti_forbidden_for(pod)
+                if any(k < 0 for k, _ in pairs):
+                    # (-1, -1) sentinel: a running pod's matching anti term
+                    # has an unregistrable topology key — permanent until
+                    # that pod leaves, not a domain-count problem.
+                    fail_closed.setdefault(pod.key, (
+                        "InterPodAffinity",
+                        "a running pod's matching anti-affinity term has "
+                        "an unrepresentable topology key (registry full); "
+                        "failing closed"))
+                elif len(pairs) > max_forbid:
+                    fail_closed.setdefault(pod.key, (
+                        "InterPodAffinity",
+                        f"pod is repelled by more than {max_forbid} "
+                        "distinct anti-affinity domains; failing closed "
+                        "rather than evaluating a truncated constraint"))
+                return pairs
+
+        encode_hard: Dict[int, tuple] = {}
         eb = encode_pods(pods, bucket_for(len(pods), cfg.pod_bucket_min),
+                         cfg=self.cache.cfg,
                          registry=self.cache.registry,
                          overflow=self.cache.overflow,
                          volumes_ready_fn=lambda p: vol_state(p)[0],
                          gang_bound_fn=self.cache.gang_bound_count,
                          volume_info_fn=lambda p: vol_state(p)[1:],
-                         anti_forbidden_fn=(self.cache.anti_forbidden_for
-                                            if self._anti_enabled else None))
+                         anti_forbidden_fn=anti_fn,
+                         hard_failed=encode_hard)
+        for idx, info in encode_hard.items():
+            fail_closed.setdefault(batch[idx].pod.key, info)
         # Versioned snapshot: the static version is observed under the
         # snapshot lock (the snapshot's own topology refresh can bump it),
         # and the cache skips host copies of static leaves we already hold
@@ -565,6 +599,32 @@ class Scheduler:
                     "batch; retrying against committed counts",
                     retryable=True)
             revoked = revoked | s_revoked
+
+        if fail_closed:
+            # Gang atomicity: failing one member closed parks its whole
+            # gang — peers binding at sub-quorum is the partial-allocation
+            # deadlock gang scheduling exists to prevent.
+            dead_gangs = {gang_key(q.pod) for q in batch
+                          if q.pod.key in fail_closed
+                          and q.pod.spec.pod_group}
+            for i, qpi in enumerate(batch):
+                if i in revoked:
+                    continue
+                info = fail_closed.get(qpi.pod.key)
+                gk = gang_key(qpi.pod)
+                if info is None and gk not in dead_gangs:
+                    continue
+                if info is not None:
+                    plugins, reason = {info[0]}, info[1]
+                else:
+                    plugins = set()
+                    reason = (f"gang {qpi.pod.spec.pod_group} member "
+                              "failed closed on an unrepresentable hard "
+                              "constraint")
+                if gk in dead_gangs:
+                    plugins.add(COSCHEDULING)
+                self._handle_failure(qpi, plugins, reason, retryable=False)
+                revoked = revoked | {i}
 
         to_bind: List[tuple] = []  # permit-free (qpi, node_name) pairs
         # With no permit plugins in the profile (the common case) the
@@ -643,7 +703,8 @@ class Scheduler:
             self._binder.submit(self._bind_many, to_bind)
 
         t_commit = time.perf_counter()
-        n_assigned = int(assigned[:len(batch)].sum()) - len(revoked)
+        n_assigned = (int(assigned[:len(batch)].sum())
+                      - sum(1 for i in revoked if assigned[i]))
         with self._metrics_lock:
             m = self._metrics
             m["batches"] += 1

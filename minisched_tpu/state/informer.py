@@ -90,10 +90,14 @@ class InformerFactory:
     SYNC_ORDER = ("Node", "PersistentVolume", "PersistentVolumeClaim",
                   "Pod", "Event")
 
+    @classmethod
+    def _in_sync_order(cls, kinds) -> List[str]:
+        return sorted(kinds, key=lambda k: (
+            cls.SYNC_ORDER.index(k) if k in cls.SYNC_ORDER
+            else len(cls.SYNC_ORDER)))
+
     def _run(self, initial: Dict[str, List[Any]]) -> None:
-        ordered = sorted(initial, key=lambda k: (
-            self.SYNC_ORDER.index(k) if k in self.SYNC_ORDER else len(self.SYNC_ORDER)))
-        for kind in ordered:
+        for kind in self._in_sync_order(initial):
             self._dispatch_adds(kind, initial[kind])
         self._synced.set()
         while not self._stop.is_set():
@@ -114,8 +118,12 @@ class InformerFactory:
                     "redelivering adds (deletes in the gap are lost)")
                 initial, self._watcher = self.store.list_and_watch(
                     kinds=list(self._handlers) or None)
-                for kind, objs in initial.items():
-                    self._dispatch_adds(kind, objs)
+                # Redeliver in SYNC_ORDER like the initial sync: a Pod bound
+                # to a Node created in the gap must see that Node's add
+                # first, or bind accounting is silently dropped (unknown
+                # node) and the node over-commits.
+                for kind in self._in_sync_order(initial):
+                    self._dispatch_adds(kind, initial[kind])
                 continue
             # Group consecutive ADDED runs of one kind so bulk-capable
             # handlers see the whole burst at once; everything else

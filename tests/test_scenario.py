@@ -504,3 +504,77 @@ def test_symmetric_anti_affinity_vs_running_pod():
         assert bound2.spec.node_name in ("sr-n0", "sr-n1")
     finally:
         c.shutdown()
+
+
+def test_anti_affinity_forbidden_domain_overflow_fails_closed():
+    """A pod repelled by more distinct (topology key, domain) pairs than
+    the encoder has anti_forbid slots must FAIL CLOSED (pend under
+    InterPodAffinity), not schedule against a silently truncated
+    constraint (which would admit the overflowed domains)."""
+    from minisched_tpu.encode.features import DEFAULT_ENCODING
+    from minisched_tpu.state import objects as obj
+
+    zone = "topology.kubernetes.io/zone"
+    n_zones = DEFAULT_ENCODING.max_anti_forbid + 1
+    c = Cluster()
+    try:
+        c.start(profile=Profile(plugins=["NodeUnschedulable",
+                                         "NodeResourcesFit",
+                                         "NodeName",
+                                         "InterPodAffinity"]),
+                config=fast_config(max_batch_size=16, batch_window_s=0.0))
+        anti = obj.Affinity(pod_anti_affinity=obj.PodAntiAffinity(
+            required=[obj.PodAffinityTerm(
+                label_selector=obj.LabelSelector(match_labels={"fc": "1"}),
+                topology_key=zone)]))
+        # One guard pinned per zone: every zone in the cluster becomes a
+        # forbidden domain for pods labeled fc=1.
+        for i in range(n_zones):
+            c.create_node(f"fc-n{i}", cpu=2000, labels={zone: f"fz{i}"})
+            c.create_pod(f"fc-guard{i}", cpu=100, affinity=anti,
+                         required_node_name=f"fc-n{i}")
+            c.wait_for_pod_bound(f"fc-guard{i}", timeout=15)
+
+        c.create_objects([obj.Pod(
+            metadata=obj.ObjectMeta(name="fc-victim", namespace="default",
+                                    labels={"fc": "1"}),
+            spec=obj.PodSpec(requests={"cpu": 100}))])
+        p = c.wait_for_pod_pending("fc-victim", timeout=20)
+        assert "InterPodAffinity" in p.status.unschedulable_plugins
+        # It must stay pending (all domains forbidden, none truncated away).
+        import time
+        time.sleep(1.0)
+        assert c.get_pod("fc-victim").spec.node_name == ""
+    finally:
+        c.shutdown()
+
+
+def test_own_required_anti_term_unregistrable_key_fails_closed():
+    """A pending pod whose OWN required anti-affinity term references a
+    topology key the full registry cannot register must fail closed —
+    not schedule with the hard constraint silently dropped."""
+    from minisched_tpu.state import objects as obj
+
+    c = Cluster()
+    try:
+        c.start(profile=Profile(plugins=["NodeUnschedulable",
+                                         "NodeResourcesFit",
+                                         "InterPodAffinity"]),
+                config=fast_config(max_batch_size=16, batch_window_s=0.0))
+        eng = next(iter(c.service._scheds.values()))
+        reg = eng.cache.registry
+        while reg.index_of(f"junk/{len(reg.keys())}") >= 0:
+            pass  # fill the registry to max
+        c.create_node("ou-n0", cpu=2000)
+        anti = obj.Affinity(pod_anti_affinity=obj.PodAntiAffinity(
+            required=[obj.PodAffinityTerm(
+                label_selector=obj.LabelSelector(match_labels={"x": "1"}),
+                topology_key="unregistrable/key")]))
+        c.create_pod("ou-victim", cpu=100, affinity=anti)
+        p = c.wait_for_pod_pending("ou-victim", timeout=20)
+        assert "InterPodAffinity" in p.status.unschedulable_plugins
+        import time
+        time.sleep(0.8)
+        assert c.get_pod("ou-victim").spec.node_name == ""
+    finally:
+        c.shutdown()
