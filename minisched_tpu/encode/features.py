@@ -626,18 +626,27 @@ class NodeAffinityBuilder:
 
 def _encode_pod_affinity_terms(i, terms, group_arr, weight_arr, builder,
                                registry, pod_ns_hash, overflow, what,
-                               self_arr=None, pod_labels=None) -> bool:
+                               self_arr=None, pod_labels=None,
+                               anti: bool = False) -> bool:
     """Encode PodAffinityTerm list (plain or weighted) into group slots.
 
-    Returns True when a REQUIRED term (weight_arr is None) could not be
-    represented — truncated past the slot count, or its topology key
-    failed to register — so the caller can fail the pod closed rather
-    than schedule it against a silently weakened hard constraint."""
+    Returns True when a REQUIRED term (weight_arr is None) was weakened in
+    the UNSAFE direction, so the caller can fail the pod closed rather
+    than schedule it against a silently loosened hard constraint.
+    Direction matters: an AFFINITY term admits placements, so any
+    broadening (selector pairs truncated, match_expressions dropped) or
+    whole-term loss is unsafe, while narrowing to namespaces[0] can only
+    reject valid placements (safe). An ANTI term repels placements, so
+    broadening the selector over-repels (safe) while narrowing it —
+    multi-namespace truncation or losing the term entirely — under-repels
+    (unsafe)."""
     T = group_arr.shape[1]
     hard_dropped = False
     if len(terms) > T:
         if overflow is not None:
             overflow.append(f"{what}: {len(terms)} terms > {T} slots")
+        # Dropped whole terms loosen both affinity (ANDed requirements
+        # lost) and anti (repels lost): unsafe either way.
         hard_dropped = weight_arr is None
     for t, term in enumerate(terms[:T]):
         if weight_arr is not None:
@@ -650,16 +659,18 @@ def _encode_pod_affinity_terms(i, terms, group_arr, weight_arr, builder,
                 if overflow is not None:
                     overflow.append(
                         f"{what}: multiple namespaces unsupported")
-                if weight_arr is None:  # required term weakened to ns[0]
+                if weight_arr is None and anti:  # anti under-repels ns[1:]
                     hard_dropped = True
             ns = _h(term.namespaces[0])
         else:
             ns = pod_ns_hash
         group_arr[i, t] = builder.group_of(k_idx, ns, term.label_selector,
                                            overflow, what)
-        if (group_arr[i, t] < 0 or builder.last_weakened) \
-                and weight_arr is None:
-            hard_dropped = True
+        if weight_arr is None:
+            if group_arr[i, t] < 0:
+                hard_dropped = True  # term unenforced: unsafe either way
+            elif builder.last_weakened and not anti:
+                hard_dropped = True  # broadened affinity admits too much
         if weight is not None and group_arr[i, t] >= 0:
             weight_arr[i, t] = float(weight)
         if self_arr is not None and group_arr[i, t] >= 0:
@@ -768,10 +779,14 @@ def encode_pods(pods: List[Pod], p_pad: int,
             f.tol_pairs[i, j] = pair_hash(tol.key, tol.value) if tol.operator != "Exists" else 0
             f.tol_effects[i, j] = _EFFECT_CODE.get(tol.effect, EFFECT_NONE) if tol.effect else EFFECT_NONE
 
-        host_ports = [p.host_port for p in pod.spec.ports if p.host_port]
-        _fill_slots(f.ports[i], host_ports, f"pod {pod.key} host ports", overflow)
-        _fill_slots(f.images[i], [_h(im) for im in pod.spec.images],
-                    f"pod {pod.key} images", overflow)
+        if pod.spec.ports:
+            host_ports = [p.host_port for p in pod.spec.ports if p.host_port]
+            if host_ports:
+                _fill_slots(f.ports[i], host_ports,
+                            f"pod {pod.key} host ports", overflow)
+        if pod.spec.images:
+            _fill_slots(f.images[i], [_h(im) for im in pod.spec.images],
+                        f"pod {pod.key} images", overflow)
 
         if pod.spec.required_node_name:
             f.required_node[i] = _h(pod.spec.required_node_name)
@@ -861,7 +876,8 @@ def encode_pods(pods: List[Pod], p_pad: int,
         if anti:
             if _encode_pod_affinity_terms(
                     i, anti.required, f.anti_req_group, None, builder,
-                    registry, ns_h, overflow, f"pod {pod.key} podAntiAffinity"):
+                    registry, ns_h, overflow, f"pod {pod.key} podAntiAffinity",
+                    anti=True):
                 _mark_hard(i, "InterPodAffinity",
                            "required pod-anti-affinity term could not be "
                            "represented (slot or registry overflow)")

@@ -46,6 +46,32 @@ from .waitingpod import WaitingPod
 log = logging.getLogger(__name__)
 
 
+@jax.jit
+def _pack_decision(chosen, assigned, gang_rejected, feasible, rejects):
+    """Fuse the per-pod step outputs into one (4+F, P) i32 array so the
+    host fetches ONE buffer per batch. On a remote-TPU tunnel every
+    separate np.asarray is a device round trip; five fetches of small
+    arrays cost ~4 extra latencies — measured ~0.27 s/batch at 10k pods,
+    on par with the entire device compute."""
+    import jax.numpy as jnp
+
+    head = jnp.stack([chosen.astype(jnp.int32),
+                      assigned.astype(jnp.int32),
+                      gang_rejected.astype(jnp.int32),
+                      feasible.astype(jnp.int32)])
+    return jnp.concatenate([head, rejects.astype(jnp.int32)], axis=0)
+
+
+@jax.jit
+def _pack_spread(pre, dom, mn):
+    """Spread-arbitration inputs as one (2P+1, G) f32 fetch. Domain ids
+    and counts are < 2^24, exact in f32."""
+    import jax.numpy as jnp
+
+    return jnp.concatenate(
+        [pre, dom.astype(jnp.float32), mn[None, :]], axis=0)
+
+
 def arbitrate_rwo(batch: List[QueuedPodInfo], assigned, chosen,
                   vol_memo: Dict[str, tuple]):
     """In-batch RWO arbitration → (revoked pod indices, parked gang keys).
@@ -344,6 +370,12 @@ class Scheduler:
         # InterPodAffinity filter via encode.anti_forbid slots.
         self._anti_enabled = any(p.name == "InterPodAffinity"
                                  for p in plugin_set.plugins)
+        # Which encode-side fail-closed verdicts apply: only constraints
+        # this profile's plugin set actually enforces may park a pod.
+        self._fail_closed_plugins = {
+            "InterPodAffinity": self._anti_enabled,
+            "PodTopologySpread": any(p.name == "PodTopologySpread"
+                                     for p in plugin_set.plugins)}
         # WFFC candidate-zone memo: pvc key → (zones, computed_at).
         self._wffc_memo: Dict[str, tuple] = {}
         self._stop = threading.Event()
@@ -538,8 +570,14 @@ class Scheduler:
                          volume_info_fn=lambda p: vol_state(p)[1:],
                          anti_forbidden_fn=anti_fn,
                          hard_failed=encode_hard)
+        # Only fail closed for constraints this profile's plugin set
+        # actually ENFORCES: a profile without InterPodAffinity ignores
+        # affinity terms entirely (encode always records them; only the
+        # filter enforces), so an unrepresentable term must not park the
+        # pod under a plugin that can never regate it.
         for idx, info in encode_hard.items():
-            fail_closed.setdefault(batch[idx].pod.key, info)
+            if self._fail_closed_plugins.get(info[0], True):
+                fail_closed.setdefault(batch[idx].pod.key, info)
         # Versioned snapshot: the static version is observed under the
         # snapshot lock (the snapshot's own topology refresh can bump it),
         # and the cache skips host copies of static leaves we already hold
@@ -554,16 +592,28 @@ class Scheduler:
         self._step_counter += 1
         key = jax.random.fold_in(self._key, self._step_counter)
         decision: Decision = self._step(eb, nf, af, key)
+        # Pack every per-pod output into ONE device array per dtype family
+        # before fetching: on a remote-TPU tunnel each np.asarray is a
+        # full round trip, and five separate fetches of tiny arrays cost
+        # ~4 extra latencies per batch (measured ~0.27 s at 10k pods —
+        # comparable to the whole device compute).
+        packed_dev = _pack_decision(
+            decision.chosen, decision.assigned, decision.gang_rejected,
+            decision.feasible_counts, decision.reject_counts)
+        spread_dev = (_pack_spread(decision.spread_pre, decision.spread_dom,
+                                   decision.spread_min)
+                      if self._spread_enabled else None)
         # Dispatch returns before the device finishes (jax async); the
         # first np.asarray below blocks. Splitting the two reveals whether
         # step time is host→device feeding or device compute.
         t_dispatch = time.perf_counter()
 
-        chosen = np.asarray(decision.chosen)
-        assigned = np.asarray(decision.assigned)
-        gang_rejected = np.asarray(decision.gang_rejected)
-        feasible = np.asarray(decision.feasible_counts)
-        rejects = np.asarray(decision.reject_counts)
+        packed = np.asarray(packed_dev)
+        chosen = packed[0]
+        assigned = packed[1].astype(bool)
+        gang_rejected = packed[2].astype(bool)
+        feasible = packed[3]
+        rejects = packed[4:]
         t_step = time.perf_counter()
 
         if self.recorder is not None:
@@ -585,11 +635,13 @@ class Scheduler:
                     retryable=True)
 
         if self._spread_enabled:
+            sp = np.asarray(spread_dev)  # one fetch for all three arrays
+            sp_p = decision.spread_pre.shape[0]
             s_revoked = arbitrate_spread(
                 batch, assigned, eb.pf, eb.gf,
-                np.asarray(decision.spread_pre),
-                np.asarray(decision.spread_dom),
-                np.asarray(decision.spread_min), dead=revoked,
+                sp[:sp_p],
+                sp[sp_p:2 * sp_p].astype(np.int32),
+                sp[2 * sp_p], dead=revoked,
                 anti_enabled=self._anti_enabled)
             for i in s_revoked:
                 self._handle_failure(
@@ -635,10 +687,16 @@ class Scheduler:
         bulk_assume = not self.plugin_set.permit_plugins
         assume_items: List[tuple] = []
         assume_rows: List[int] = []
+        # Python-int views: per-element numpy scalar indexing inside a
+        # 10k-iteration loop costs real milliseconds on the commit path.
+        chosen_l = chosen[:len(batch)].tolist()
+        assigned_l = assigned[:len(batch)].tolist()
+        gang_rejected_l = gang_rejected[:len(batch)].tolist()
+        feasible_l = feasible[:len(batch)].tolist()
         for i, qpi in enumerate(batch):
             if i in revoked:
                 continue
-            gk = gang_key(qpi.pod)
+            gk = gang_key(qpi.pod) if parked_gangs else None
             if gk and gk in parked_gangs:
                 # Unassigned members of a parked gang would otherwise fall
                 # through to the retryable BATCH_CAPACITY path and thrash
@@ -650,8 +708,8 @@ class Scheduler:
                     "gang members demand the same RWO claim on different "
                     "nodes", retryable=False)
                 continue
-            if assigned[i]:
-                node_name = names[int(chosen[i])]
+            if assigned_l[i]:
+                node_name = names[chosen_l[i]]
                 if bulk_assume:
                     assume_items.append((qpi.pod, node_name))
                     assume_rows.append(i)
@@ -660,12 +718,12 @@ class Scheduler:
                     pair = self._start_binding_cycle(qpi, node_name)
                     if pair is not None:
                         to_bind.append(pair)
-            elif gang_rejected[i]:
+            elif gang_rejected_l[i]:
                 # The pod's gang missed quorum — park the whole member set
                 # under Coscheduling (plus any real filter rejections, for
                 # precise event gating) until a new member or capacity event.
                 plugins = {COSCHEDULING}
-                if feasible[i] == 0:
+                if feasible_l[i] == 0:
                     plugins |= {self.filter_names[f]
                                 for f in range(rejects.shape[0])
                                 if rejects[f, i] > 0}
@@ -673,7 +731,7 @@ class Scheduler:
                     qpi, plugins,
                     f"gang {qpi.pod.spec.pod_group} missed quorum "
                     f"{qpi.pod.spec.pod_group_min}", retryable=False)
-            elif feasible[i] > 0:
+            elif feasible_l[i] > 0:
                 # Nodes were feasible but earlier pods in the batch took the
                 # capacity — retryable, not unschedulable (SURVEY §7
                 # "batch-internal causality").
@@ -987,16 +1045,25 @@ class Scheduler:
         bookkeeping. Pods the store skipped (deleted mid-flight, bound by
         a competing scheduler, node gone) fall back to the per-pod failure
         handling of _bind."""
+        # Compute each pod key ONCE (it's an f-string property) and reuse
+        # it for the store commit, the bound diff, and the event payload.
+        keyed = [(qpi.pod.key, qpi, node_name) for qpi, node_name in items]
         bound_keys = set(self.store.bind_pods(
-            [(qpi.pod.key, node_name) for qpi, node_name in items]))
+            [(k, n) for k, _, n in keyed]))
         with self._metrics_lock:
             self._metrics["pods_bound"] += len(bound_keys)
         self.queue.forget_many(bound_keys)
-        for qpi, node_name in items:
-            if qpi.pod.key in bound_keys:
-                self.broadcaster.scheduled(qpi.pod, node_name)
-            else:
-                self._bind_failed(qpi, node_name, "skipped by bulk commit")
+        ok = keyed
+        if len(bound_keys) != len(keyed):  # rare: some skipped mid-flight
+            ok = []
+            for k, qpi, node_name in keyed:
+                if k in bound_keys:
+                    ok.append((k, qpi, node_name))
+                else:
+                    self._bind_failed(qpi, node_name,
+                                      "skipped by bulk commit")
+        self.broadcaster.scheduled_many(
+            [(k, qpi.pod.metadata.namespace, n) for k, qpi, n in ok])
         if bound_keys:
             log.info("bulk-bound %d pods", len(bound_keys))
 

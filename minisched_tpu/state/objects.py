@@ -402,10 +402,23 @@ def object_key(obj: Any) -> str:
     return obj.key
 
 
+_ATOMIC_TYPES = frozenset((str, int, float, bool, type(None)))
+_DATACLASS_TYPES: set = set()  # observed dataclass types (clone fast path)
+
+
 def _clone(v):
+    # Branch order matters: ~3/4 of the calls on a pod tree are atomic
+    # leaves and most of the rest are dataclasses — is_dataclass() per
+    # call was the top cost of bulk ingestion (10k-pod create_many).
     t = v.__class__
-    if t in (str, int, float, bool, type(None)):
+    if t in _ATOMIC_TYPES:
         return v
+    if t in _DATACLASS_TYPES:
+        new = t.__new__(t)
+        d = new.__dict__
+        for k, x in v.__dict__.items():
+            d[k] = _clone(x)
+        return new
     if t is dict:
         return {k: _clone(x) for k, x in v.items()}
     if t is list:
@@ -415,6 +428,7 @@ def _clone(v):
     if t is set:
         return set(v)  # sets here only ever hold scalars (plugin names)
     if dataclasses.is_dataclass(v):
+        _DATACLASS_TYPES.add(t)
         new = t.__new__(t)
         d = new.__dict__
         for k, x in v.__dict__.items():
@@ -423,6 +437,21 @@ def _clone(v):
     import copy
 
     return copy.deepcopy(v)
+
+
+def shallow_evolve(o: Any, **kw: Any) -> Any:
+    """Fast dataclasses.replace: builds the new object via __dict__ instead
+    of __init__ and SHARES unchanged field values with the original.
+    Safe only under the store's replacement-only convention (stored
+    objects are never mutated in place), where structural sharing between
+    an object and its superseded version is already the contract —
+    dataclasses.replace costs ~5x more on the bulk-bind hot path (one
+    full __init__ per evolved sub-object × 4 objects × 10k pods)."""
+    new = object.__new__(type(o))
+    d = new.__dict__
+    d.update(o.__dict__)
+    d.update(kw)
+    return new
 
 
 def deepcopy_obj(obj):

@@ -269,32 +269,37 @@ class ClusterStore:
         the store's copy-on-read isolation). Pods already bound/deleted or
         nodes gone are skipped (callers diff the returned keys against the
         request to re-schedule).
-        Uses dataclasses.replace instead of deep copies — stored objects are
+        Uses shallow_evolve instead of deep copies — stored objects are
         replacement-only, so structural sharing with superseded versions is
-        safe; watch events carry the same immutable-by-convention snapshots."""
-        import dataclasses as _dc
-
+        safe; watch events carry the same immutable-by-convention snapshots.
+        One watcher wake-up for the whole batch (a per-pod notify_all is
+        10k condvar broadcasts under the lock)."""
+        evolve = obj.shallow_evolve
         bound: List[str] = []
         now = time.time()
         with self._cond:
+            pods_map = self._objects["Pod"]
+            nodes_map = self._objects["Node"]
             for pod_key, node_name in assignments:
-                pod = self._objects["Pod"].get(pod_key)
+                pod = pods_map.get(pod_key)
                 if pod is None or pod.spec.node_name:
                     continue
-                if node_name not in self._objects["Node"]:
+                if node_name not in nodes_map:
                     continue
                 self._rv += 1
-                new = _dc.replace(
+                new = evolve(
                     pod,
-                    metadata=_dc.replace(pod.metadata, resource_version=self._rv),
-                    spec=_dc.replace(pod.spec, node_name=node_name),
-                    status=_dc.replace(pod.status, phase=obj.PodPhase.RUNNING,
-                                       unschedulable_plugins=[], message="",
-                                       scheduled_time=now))
-                self._objects["Pod"][pod_key] = new
+                    metadata=evolve(pod.metadata, resource_version=self._rv),
+                    spec=evolve(pod.spec, node_name=node_name),
+                    status=evolve(pod.status, phase=obj.PodPhase.RUNNING,
+                                  unschedulable_plugins=[], message="",
+                                  scheduled_time=now))
+                pods_map[pod_key] = new
                 self._append(WatchEvent(EventType.MODIFIED, "Pod", new, pod,
-                                        self._rv))
+                                        self._rv), notify=False)
                 bound.append(pod_key)
+            if bound:
+                self._cond.notify_all()
         return bound
 
     # ---- Watch ----------------------------------------------------------
