@@ -79,9 +79,12 @@ def main() -> None:
     out["single_device_s"], d = time_step(single, (eb, nf, af, key))
     chosen_single = np.asarray(d.chosen)
 
-    # sharded step on the ("pod","node") mesh
+    # sharded step on the ("pod","node") mesh — greedy mode pinned for the
+    # exact-parity row (the DEFAULT sharded assignment is now the
+    # priority-tiered auction, measured below as sharded_auction_s)
     mesh = make_mesh(jax.devices())
-    step = build_sharded_step(plugin_set, mesh, eb, nf, af)
+    step = build_sharded_step(plugin_set, mesh, eb, nf, af,
+                              assignment="greedy")
     eb_d, nf_d, af_d = shard_features(mesh, eb, nf, af)
     out["sharded_step_s"], ds = time_step(step, (eb_d, nf_d, af_d, key))
     out["mesh"] = f"{mesh.devices.shape} {mesh.axis_names}"

@@ -42,6 +42,18 @@ class SchedulerConfig:
     seed: int = 0                    # PRNG seed for tie-breaking parity
     bind_workers: int = 16           # async binding-cycle pool size
     platform: str = ""               # "" = whatever jax picks; or cpu/tpu
+    # Node-axis sampling for the scoring step — the upstream
+    # percentageOfNodesToScore analog (adaptive default; surfaced ignored
+    # at the reference's scheduler_test.go:79). 0 = auto (upstream's
+    # 50 - nodes/125, floored at 5); 100 = always evaluate every node.
+    # A sampled batch that finds a pod 0-feasible re-checks it against
+    # the full axis in the same cycle, so terminal verdicts never come
+    # from a sample.
+    percentage_of_nodes_to_score: int = 0
+    # Never sample below this many candidate nodes (upstream
+    # minFeasibleNodesToFind), and only bother sampling at all when the
+    # cluster is at least twice this size.
+    min_sample_nodes: int = 256
 
 
 def config_from_env() -> SchedulerConfig:
@@ -61,4 +73,6 @@ def config_from_env() -> SchedulerConfig:
         backoff_initial_s=float(_req("MINISCHED_BACKOFF_INITIAL", "1.0")),
         backoff_max_s=float(_req("MINISCHED_BACKOFF_MAX", "10.0")),
         platform=os.environ.get("MINISCHED_PLATFORM", ""),
+        percentage_of_nodes_to_score=int(
+            _req("MINISCHED_PCT_NODES_TO_SCORE", "0")),
     )

@@ -689,7 +689,7 @@ def encode_pods(pods: List[Pod], p_pad: int,
                 gang_bound_fn=None,
                 volume_info_fn=None,
                 anti_forbidden_fn=None,
-                hard_failed: Optional[Dict[int, Tuple[str, str]]] = None):
+                hard_failed: Optional[Dict[int, List[Tuple[str, str]]]] = None):
     """Encode a batch of pending pods, padded to ``p_pad`` rows.
 
     Returns an EncodedBatch: pod features plus the batch's distinct
@@ -703,7 +703,8 @@ def encode_pods(pods: List[Pod], p_pad: int,
     ``anti_forbidden_fn(pod) -> [(key_idx, dom_id), ...]`` supplies domains
     occupied by RUNNING pods whose required anti-affinity terms match this
     pod (cache.anti_forbidden_for) — default: none.
-    ``hard_failed`` (optional out-param): pod index → (plugin name, reason)
+    ``hard_failed`` (optional out-param): pod index → list of
+    (plugin name, reason) — one entry per tripped constraint —
     for pods whose HARD constraint (required affinity/anti-affinity term,
     DoNotSchedule spread) could not be represented in the encoding slots —
     the engine fails such pods closed instead of scheduling them against a
@@ -713,8 +714,12 @@ def encode_pods(pods: List[Pod], p_pad: int,
         registry = TopologyKeyRegistry(cfg)
 
     def _mark_hard(idx: int, plugin: str, reason: str) -> None:
-        if hard_failed is not None and idx not in hard_failed:
-            hard_failed[idx] = (plugin, reason)
+        # One pod can trip several plugins' constraints; record ALL of
+        # them — the engine gates by enabled plugin, and a first-write-
+        # wins single slot would let a disabled plugin's verdict mask an
+        # enabled one's.
+        if hard_failed is not None:
+            hard_failed.setdefault(idx, []).append((plugin, reason))
     builder = GroupBuilder(cfg)
     na_builder = NodeAffinityBuilder(cfg)
     P = p_pad

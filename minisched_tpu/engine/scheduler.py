@@ -575,9 +575,11 @@ class Scheduler:
         # affinity terms entirely (encode always records them; only the
         # filter enforces), so an unrepresentable term must not park the
         # pod under a plugin that can never regate it.
-        for idx, info in encode_hard.items():
-            if self._fail_closed_plugins.get(info[0], True):
-                fail_closed.setdefault(batch[idx].pod.key, info)
+        for idx, infos in encode_hard.items():
+            for info in infos:
+                if self._fail_closed_plugins.get(info[0], True):
+                    fail_closed.setdefault(batch[idx].pod.key, info)
+                    break
         # Versioned snapshot: the static version is observed under the
         # snapshot lock (the snapshot's own topology refresh can bump it),
         # and the cache skips host copies of static leaves we already hold
@@ -591,7 +593,14 @@ class Scheduler:
 
         self._step_counter += 1
         key = jax.random.fold_in(self._key, self._step_counter)
-        decision: Decision = self._step(eb, nf, af, key)
+        # Node-axis sampling (percentage_of_nodes_to_score): a small batch
+        # against a huge cluster runs the pipeline on the top-K candidate
+        # subset; pods the sample finds 0-feasible are re-checked below
+        # against the full axis before any terminal verdict.
+        has_gang = any(q.pod.spec.pod_group for q in batch)
+        step_fn, sample_k = self._sampled_step(
+            nf.free.shape[0], len(batch), has_gang)
+        decision: Decision = (step_fn or self._step)(eb, nf, af, key)
         # Pack every per-pod output into ONE device array per dtype family
         # before fetching: on a remote-TPU tunnel each np.asarray is a
         # full round trip, and five separate fetches of tiny arrays cost
@@ -608,12 +617,27 @@ class Scheduler:
         # step time is host→device feeding or device compute.
         t_dispatch = time.perf_counter()
 
-        packed = np.asarray(packed_dev)
+        packed = np.array(packed_dev)  # writable: residual merge below
         chosen = packed[0]
         assigned = packed[1].astype(bool)
         gang_rejected = packed[2].astype(bool)
         feasible = packed[3]
         rejects = packed[4:]
+        sp = (np.array(spread_dev) if spread_dev is not None else None)
+
+        if sample_k is not None:
+            # Residual pass: a pod with zero feasible nodes IN THE SAMPLE
+            # may still fit elsewhere (pinned claim row, node selector,
+            # scarce taint tolerance outside the top-K) — re-evaluate
+            # those pods against the full axis with the sample's capacity
+            # already subtracted, and merge. Terminal unschedulable
+            # verdicts therefore never come from a sample.
+            L = len(batch)
+            res_rows = np.nonzero((feasible[:L] == 0) & ~assigned[:L])[0]
+            if res_rows.size:
+                self._run_residual(
+                    eb, nf, af, key, res_rows, decision,
+                    chosen, assigned, gang_rejected, feasible, rejects, sp)
         t_step = time.perf_counter()
 
         if self.recorder is not None:
@@ -635,7 +659,6 @@ class Scheduler:
                     retryable=True)
 
         if self._spread_enabled:
-            sp = np.asarray(spread_dev)  # one fetch for all three arrays
             sp_p = decision.spread_pre.shape[0]
             s_revoked = arbitrate_spread(
                 batch, assigned, eb.pf, eb.gf,
@@ -781,6 +804,82 @@ class Scheduler:
             m["last_step_s"] = t_step - t_encode
             m["last_commit_s"] = t_commit - t_step
         return decision
+
+    # ---- node-axis sampling (percentage_of_nodes_to_score) --------------
+
+    def _sampled_step(self, n_pad: int, batch_len: int, has_gang: bool):
+        """(step_fn, K) for this batch, or (None, None) when sampling
+        doesn't apply. Gangs disable sampling — quorum must be judged
+        against one consistent node set, and a member failing only
+        because the sample missed its nodes would wrongly reject the
+        whole gang. Explain mode disables it too (per-node annotation
+        columns would misalign with the full name table)."""
+        cfg = self.config
+        if cfg.explain or has_gang:
+            return None, None
+        pct = cfg.percentage_of_nodes_to_score
+        if pct >= 100:
+            return None, None
+        n_real = self.cache.node_count()
+        if n_real < 2 * cfg.min_sample_nodes:
+            return None, None
+        if pct <= 0:  # auto: upstream's adaptive formula
+            pct = max(5, 50 - n_real // 125)
+        if pct >= 100:
+            return None, None
+        want = max(cfg.min_sample_nodes, (n_real * pct) // 100,
+                   2 * batch_len)
+        k = bucket_for(want, cfg.node_bucket_min)
+        if k >= n_pad:
+            return None, None
+        return build_step(self.plugin_set, explain=False,
+                          assignment=cfg.assignment, sample_nodes=k), k
+
+    def _run_residual(self, eb, nf, af, key, rows, decision,
+                      chosen, assigned, gang_rejected, feasible,
+                      rejects, sp) -> None:
+        """Full-axis re-evaluation of sampled-out pods, merged in place.
+
+        The residual sub-batch reuses the batch's group tables (same gf/
+        naf, so group ids and spread columns stay aligned) with gangs
+        stripped (sampling is disabled for gang batches), and sees the
+        cluster's free capacity AFTER the sampled assignments
+        (decision.free_after is full-size under sampling)."""
+        from ..encode.features import GangFeatures
+
+        n_res = len(rows)
+        P2 = bucket_for(n_res, self.config.pod_bucket_min)
+
+        def take(a):
+            a = np.asarray(a)
+            out = np.zeros((P2,) + a.shape[1:], dtype=a.dtype)
+            out[:n_res] = a[rows]
+            return out
+
+        pf2 = type(eb.pf)(*[take(getattr(eb.pf, f))
+                            for f in eb.pf._fields])
+        gang2 = GangFeatures(
+            group=np.full(P2, -1, dtype=np.int32),
+            min_count=np.asarray(eb.gang.min_count))
+        eb2 = eb._replace(pf=pf2, gang=gang2)
+        nf2 = nf._replace(free=np.asarray(decision.free_after))
+        d2: Decision = self._step(eb2, nf2, af,
+                                  jax.random.fold_in(key, 0x5e5))
+        p2 = np.asarray(_pack_decision(
+            d2.chosen, d2.assigned, d2.gang_rejected,
+            d2.feasible_counts, d2.reject_counts))
+        chosen[rows] = p2[0][:n_res]
+        assigned[rows] = p2[1][:n_res].astype(bool)
+        gang_rejected[rows] = p2[2][:n_res].astype(bool)
+        feasible[rows] = p2[3][:n_res]
+        rejects[:, rows] = p2[4:][:, :n_res]
+        if sp is not None and sp.shape[0] > 1:
+            sp2 = np.asarray(_pack_spread(
+                d2.spread_pre, d2.spread_dom, d2.spread_min))
+            sp_p = decision.spread_pre.shape[0]
+            if d2.spread_pre.shape[0]:
+                sp[rows] = sp2[:P2][:n_res]
+                sp[sp_p + rows] = sp2[P2:2 * P2][:n_res]
 
     # ---- node lifecycle (informer thread) -------------------------------
 

@@ -54,6 +54,8 @@ with the pallas greedy kernel (87 ms) while remaining GSPMD-partitionable.
 """
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
@@ -68,7 +70,8 @@ STALE_ROUNDS = 8
 
 def auction_assign(scores: jnp.ndarray, requests: jnp.ndarray,
                    free0: jnp.ndarray, key: jax.Array,
-                   eps: float = 1e-2, max_rounds: int = 256) -> AssignResult:
+                   eps: float = 1e-2, max_rounds: Optional[int] = None,
+                   priority=None) -> AssignResult:
     """Drop-in for select.greedy_assign with auction semantics.
 
     scores:   (P,N) f32 with NEG on infeasible pairs
@@ -76,8 +79,28 @@ def auction_assign(scores: jnp.ndarray, requests: jnp.ndarray,
     free0:    (N,R) f32 free resources entering the batch
     eps:      minimum price increment (optimality slack; normalized scores
               are 0..100*weight, so 1e-2 is fine-grained)
+    priority: optional (P,) i32 — PRIORITY-TIERED bidding. Pods auction in
+              descending priority BANDS: a band's rounds run fully
+              parallel, and the next band starts only when the current one
+              is assigned or capacity-stale, against the remaining
+              capacity. This restores the greedy contract's batch-priority
+              faithfulness ACROSS priorities (a low-priority pod can never
+              consume capacity a higher-priority pod needed) while keeping
+              the within-band parallelism that makes the mode
+              GSPMD-friendly — the fix for the sharded default being
+              either faithful-but-serial (chunked scan) or
+              parallel-but-priority-blind (flat auction). Node prices
+              reset between bands (a price is contention state of the
+              band that raised it).
     """
     P, N = scores.shape
+    if max_rounds is None:
+        # The round budget is SHARED across priority bands: every win
+        # resets the stale counter and a band costs at most its wins plus
+        # STALE_ROUNDS no-progress rounds, so ~(1+STALE)·P+STALE bounds
+        # the whole banded run — a fixed 256 would starve the low bands
+        # of a many-band batch before they ever bid.
+        max_rounds = max(256, (1 + STALE_ROUNDS) * P + STALE_ROUNDS)
     seed = seed_from_key(key)
     rows = jnp.arange(P, dtype=jnp.int32)
 
@@ -100,10 +123,20 @@ def auction_assign(scores: jnp.ndarray, requests: jnp.ndarray,
     # dense rounds after the last real assignment, every batch.
     feasible = jnp.any(scores > NEG, axis=1)                   # (P,)
 
+    NEG_BAND = jnp.int32(-(2 ** 31) + 1)
+    prio = (jnp.zeros((P,), jnp.int32) if priority is None
+            else priority.astype(jnp.int32))
+
+    def next_band(chosen, below):
+        """Highest priority strictly below ``below`` that still has an
+        unassigned feasible pod; NEG_BAND when none (loop exit)."""
+        cand = jnp.where(feasible & (chosen < 0) & (prio < below),
+                         prio, NEG_BAND)
+        return jnp.max(cand)
+
     def cond(state):
-        chosen, free, prices, rnd, stale = state
-        return ((rnd < max_rounds) & (stale < STALE_ROUNDS)
-                & jnp.any((chosen < 0) & feasible))
+        chosen, free, prices, rnd, stale, band = state
+        return (rnd < max_rounds) & (band > NEG_BAND)
 
     # NOTE on lowering: everything below is dense math — one-hot matmuls
     # (precision=highest, so the 0/1-weighted sums are f32-exact) and
@@ -114,10 +147,25 @@ def auction_assign(scores: jnp.ndarray, requests: jnp.ndarray,
     hi = jax.lax.Precision.HIGHEST
 
     def body(state):
-        chosen, free, prices, rnd, stale = state
-        active = chosen < 0                                    # (P,)
-        value = jnp.where((scores > NEG) & active[:, None],
-                          scores - prices[None, :], NEG)       # (P,N)
+        chosen, free, prices, rnd, stale, band = state
+        active = (chosen < 0) & (prio == band)                 # (P,)
+        # Nodes that cannot fit even the smallest active request leave
+        # the auction NOW: without this, a full-but-cheap node keeps
+        # winning bids it must capacity-reject, and its price climbs one
+        # small Bertsekas margin per round while genuinely-open (but
+        # pricier) nodes sit idle — at exact-capacity workloads the
+        # bouncing burns the stale budget with slots still free. One
+        # (R,) min + (N,R) compare; never a (P,N,R) tensor.
+        # Only real bidders shape the test: padding / infeasible rows
+        # carry zero requests, and a 0-vector min would make node_open
+        # all-True (a silent no-op) for any band containing them.
+        bidder = active & feasible
+        min_req = jnp.min(jnp.where(bidder[:, None], requests, jnp.inf),
+                          axis=0)                              # (R,)
+        node_open = jnp.all(free >= min_req, axis=1)           # (N,)
+        value = jnp.where(
+            (scores > NEG) & active[:, None] & node_open[None, :],
+            scores - prices[None, :], NEG)                     # (P,N)
         v_best = jnp.max(value, axis=1)                        # (P,)
         best = jnp.argmax(value, axis=1).astype(jnp.int32)     # (P,)
         bid1h = jax.nn.one_hot(best, N, dtype=bool)            # (P,N)
@@ -150,12 +198,22 @@ def auction_assign(scores: jnp.ndarray, requests: jnp.ndarray,
             "pn,p->n", (bid1h & win[:, None]).astype(jnp.float32),
             gamma, precision=hi)
         stale = jnp.where(jnp.any(win_ok), jnp.int32(0), stale + 1)
-        return (chosen, free, prices, rnd + 1, stale)
+
+        # Band control: advance when the current band is fully assigned
+        # or capacity-stale; the next band bids against the remaining
+        # capacity with fresh prices.
+        band_left = jnp.any((chosen < 0) & feasible & (prio == band))
+        advance = (~band_left) | (stale >= STALE_ROUNDS)
+        band = jnp.where(advance, next_band(chosen, band), band)
+        stale = jnp.where(advance, jnp.int32(0), stale)
+        prices = jnp.where(advance, jnp.zeros_like(prices), prices)
+        return (chosen, free, prices, rnd + 1, stale, band)
 
     chosen0 = jnp.full((P,), -1, jnp.int32)
     prices0 = jnp.zeros((N,), jnp.float32)
-    chosen, free, _prices, _rnd, _stale = jax.lax.while_loop(
+    band0 = jnp.max(jnp.where(feasible, prio, NEG_BAND))
+    chosen, free, _prices, _rnd, _stale, _band = jax.lax.while_loop(
         cond, body,
-        (chosen0, free0, prices0, jnp.int32(0), jnp.int32(0)))
+        (chosen0, free0, prices0, jnp.int32(0), jnp.int32(0), band0))
     return AssignResult(chosen=chosen, assigned=chosen >= 0,
                         free_after=free)

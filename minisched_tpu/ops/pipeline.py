@@ -73,11 +73,39 @@ _CHUNK_TARGET_BYTES = 256 << 20
 _CHUNK_MIN_PODS = 128
 
 
+def _select_node_sample(nf, key, k: int) -> jnp.ndarray:
+    """Pick K candidate node rows for a sampled step: top-K by a cheap
+    LeastAllocated-flavored proxy (mean free fraction over resource axes)
+    plus small random jitter, restricted to schedulable nodes. The proxy
+    biases the sample toward nodes the default scorers would rank high;
+    random jitter keeps the sample diverse so repeated batches don't
+    hammer one node set. One (N,)-shaped pass + top_k — O(N log K)
+    against the O(P×N×plugins) it saves."""
+    alloc = jnp.maximum(nf.allocatable, 1e-9)
+    frac = jnp.clip(nf.free, 0.0, None) / alloc
+    score = frac.mean(axis=1)
+    noise = jax.random.uniform(key, score.shape, maxval=0.05)
+    ok = nf.valid & ~nf.unschedulable
+    return jax.lax.top_k(jnp.where(ok, score + noise, -jnp.inf), k)[1]
+
+
+def _gather_nodes(nf, idx):
+    """NodeFeatures restricted to rows ``idx`` (topo_domains' node axis is
+    axis 1; every other leaf leads with N). Domain ids are NOT remapped —
+    they stay global so counts, minima and anti-forbid comparisons agree
+    with state computed on the full cluster."""
+    return nf._replace(
+        topo_domains=nf.topo_domains[:, idx],
+        **{f: getattr(nf, f)[idx]
+           for f in nf._fields if f != "topo_domains"})
+
+
 def build_step(plugin_set: PluginSet, *, explain: bool = False,
                cfg: EncodingConfig = DEFAULT_ENCODING,
                pallas: Optional[bool] = None,
                assignment: str = "greedy",
-               assign_fn=None, assign_key=None):
+               assign_fn=None, assign_key=None,
+               sample_nodes: Optional[int] = None):
     """Compile the scheduling step for a plugin profile.
 
     Returns jitted ``step(eb, nf, af, key) -> Decision`` where eb is an
@@ -101,11 +129,30 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
     supplies the shard_map chunked-gather scan,
     parallel/sharded_assign.py); ``assign_key`` is its hashable identity
     for the step cache.
+
+    ``sample_nodes``: the percentage_of_nodes_to_score analog (upstream
+    adaptive node sampling, surfaced ignored at the reference's
+    scheduler_test.go:79). When set to K < N, a cheap device-side
+    pre-pass picks the top-K candidate nodes (free-capacity proxy +
+    random jitter over schedulable nodes) and the full filter/score/
+    assign pipeline runs on the gathered (P, K) problem — the step cost
+    is N-dominated, so a small batch stops paying the whole-cluster
+    price. Topology/affinity state is computed on the FULL node set
+    first (global domain ids, counts and minima stay exact) and only the
+    per-node tables are gathered. Outputs are remapped to global node
+    rows; ``free_after`` is returned full-size. A pod with zero feasible
+    nodes IN THE SAMPLE must be re-evaluated by the caller against the
+    full axis before being declared unschedulable (the engine's residual
+    pass). Not supported with explain mode (per-node annotation columns
+    would misalign) or a custom assign_fn.
     """
     if assignment not in ("greedy", "auction"):
         raise ValueError(
             f"unknown assignment strategy {assignment!r}; "
             "expected 'greedy' or 'auction'")
+    if sample_nodes is not None and (explain or assign_fn is not None):
+        raise ValueError(
+            "sample_nodes is incompatible with explain mode / assign_fn")
     if assign_fn is not None and assign_key is None:
         # Without an explicit identity the cache would collide with the
         # default-assignment step and silently drop the custom stage.
@@ -114,7 +161,7 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
         tuple(p.trace_key() for p in plugin_set.filter_plugins),
         tuple((p.trace_key(), plugin_set.weight_of(p))
               for p in plugin_set.score_plugins),
-        explain, cfg, pallas, assignment, assign_key,
+        explain, cfg, pallas, assignment, assign_key, sample_nodes,
     )
     cached = _STEP_CACHE.get(cache_key)
     if cached is not None:
@@ -133,6 +180,9 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
 
         # Shared cycle state (reference CycleState / RunPreScorePlugins):
         # computed once, consumed by any plugin that declared a need.
+        # ALWAYS computed on the full node set — topology domain ids,
+        # counts and minima must stay global even under node sampling
+        # (a subset min would let DoNotSchedule skew fail open).
         ctx = {"af": af, "gf": eb.gf, "naf": eb.naf}
         if needs_topology:
             num_domains = max(N, cfg.domain_buckets)
@@ -143,6 +193,29 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
 
             ctx["na_req_match"] = group_required_match(eb.naf, nf)
             ctx["na_pref_score"] = group_preferred_score(eb.naf, nf)
+
+        sample_idx = None
+        free_full = nf.free
+        if sample_nodes is not None and sample_nodes < N:
+            key, skey = jax.random.split(key)
+            sample_idx = _select_node_sample(nf, skey, sample_nodes)
+            # Inverse map for row-identity inputs: a claim pinned to a
+            # node OUTSIDE the sample maps to row K (out of range), which
+            # matches no sampled node — the pod then reads 0-feasible and
+            # the caller's residual full-axis pass decides it.
+            inv = jnp.full((N,), sample_nodes, dtype=jnp.int32)
+            inv = inv.at[sample_idx].set(
+                jnp.arange(sample_nodes, dtype=jnp.int32))
+            cr = pf.claim_rows
+            pf = pf._replace(claim_rows=jnp.where(
+                cr >= 0, inv[jnp.clip(cr, 0, N - 1)], cr))
+            eb = eb._replace(pf=pf)
+            nf = _gather_nodes(nf, sample_idx)
+            for k2 in ("counts_node", "dom_valid",
+                       "na_req_match", "na_pref_score"):
+                if k2 in ctx:
+                    ctx[k2] = ctx[k2][:, sample_idx]
+            N = sample_nodes
 
         def evaluate(pf_sub):
             """Filters + scores for a pod sub-batch against the full node
@@ -222,9 +295,15 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             # Re-evaluated per shape bucket at retrace.
             greedy_fn = None
             if assignment == "auction":
+                import functools
+
                 from .auction import auction_assign
 
-                greedy_fn = auction_assign
+                # Priority-tiered bidding: the batch rows carry real
+                # priorities; banded rounds keep the greedy contract's
+                # cross-priority faithfulness (ops/auction.py docstring).
+                greedy_fn = functools.partial(auction_assign,
+                                              priority=pf.priority)
             else:
                 use_pallas = pallas
                 if use_pallas is None:
@@ -274,8 +353,18 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             raw_stack = jnp.zeros((0, P, N), dtype=jnp.float32)
             norm_stack = jnp.zeros((0, P, N), dtype=jnp.float32)
 
+        chosen = assign.chosen
+        free_after = assign.free_after
+        if sample_idx is not None:
+            # Remap subset rows back to GLOBAL node rows; free_after is
+            # scattered into the full-size table so downstream consumers
+            # (the engine's residual pass) see cluster-wide capacity.
+            safe = jnp.clip(chosen, 0, sample_nodes - 1)
+            chosen = jnp.where(assign.assigned, sample_idx[safe], chosen)
+            free_after = free_full.at[sample_idx].set(assign.free_after)
+
         return Decision(
-            chosen=assign.chosen,
+            chosen=chosen,
             assigned=assign.assigned,
             gang_rejected=assign.gang_rejected,
             feasible_counts=feasible_counts,
@@ -285,7 +374,7 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             # program output costs a P×N f32 buffer (4.3GB at 16k×65k).
             total_scores=(masked_total if explain
                           else jnp.zeros((0, N), dtype=jnp.float32)),
-            free_after=assign.free_after,
+            free_after=free_after,
             spread_pre=spread_pre,
             spread_min=spread_min,
             spread_dom=spread_dom,
@@ -338,7 +427,8 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
                 "scheduling step failed on first call (pallas lowering?); "
                 "retrying with the lax.scan assignment")
             state["fn"] = build_step(plugin_set, explain=explain, cfg=cfg,
-                                     pallas=False)
+                                     pallas=False,
+                                     sample_nodes=sample_nodes)
             state["fell_back"] = True
             return state["fn"](eb, nf, af, key)
 

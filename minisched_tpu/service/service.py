@@ -53,6 +53,17 @@ class SchedulerService:
             raise RuntimeError("scheduler already running")
         if isinstance(profile, SchedulerConfiguration):
             profiles, self._multi = list(profile.profiles), True
+            if (config is not None
+                    and profile.percentage_of_nodes_to_score
+                    != type(profile)().percentage_of_nodes_to_score):
+                import dataclasses as _dc
+
+                config = _dc.replace(
+                    config, percentage_of_nodes_to_score=(
+                        profile.percentage_of_nodes_to_score))
+            elif config is None and profile.percentage_of_nodes_to_score:
+                config = SchedulerConfig(percentage_of_nodes_to_score=(
+                    profile.percentage_of_nodes_to_score))
         elif isinstance(profile, (list, tuple)):
             profiles, self._multi = list(profile), True
         else:

@@ -46,7 +46,8 @@ def test_sharded_step_matches_single_chip(eight_devices):
     key = jax.random.PRNGKey(42)
 
     single = build_step(ps)(eb, nf, af, key)
-    sharded_step = build_sharded_step(ps, mesh, eb, nf, af)
+    sharded_step = build_sharded_step(ps, mesh, eb, nf, af,
+                                      assignment="greedy")
     eb_d, nf_d, af_d = shard_features(mesh, eb, nf, af)
     sharded = sharded_step(eb_d, nf_d, af_d, key)
 
@@ -112,7 +113,7 @@ def test_hybrid_mesh_single_process_and_step(eight_devices):
     ps = PluginSet([NodeUnschedulable(), NodeNumber()])
     key = jax.random.PRNGKey(7)
     single = build_step(ps)(eb, nf, af, key)
-    step = build_sharded_step(ps, mesh, eb, nf, af)
+    step = build_sharded_step(ps, mesh, eb, nf, af, assignment="greedy")
     eb_d, nf_d, af_d = shard_features(mesh, eb, nf, af)
     sharded = step(eb_d, nf_d, af_d, key)
     np.testing.assert_array_equal(np.asarray(single.chosen),
