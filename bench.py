@@ -239,6 +239,48 @@ def run_child() -> None:
     except Exception as e:
         detail["pallas_error"] = f"{type(e).__name__}: {e}"[:300]
 
+    # ---- pallas kernel shape matrix (hardware) -------------------------
+    # One headline shape is not evidence: sweep the kernel's tiling edges
+    # — N at one lane tile, P tiny/odd (sub-POD_BLOCK padding), P > N,
+    # square, large-N — against the scan on REAL hardware, and record the
+    # off-tile shapes the kernel must refuse (scan fallback by contract).
+    try:
+        if (in_budget("pallas_shapes")
+                and jax.default_backend() == "tpu"):
+            import jax.numpy as jnp
+
+            from minisched_tpu.ops.pallas_select import (
+                greedy_assign_pallas, pallas_supported)
+            from minisched_tpu.ops.select import NEG, greedy_assign
+
+            table = {}
+            rng = np.random.default_rng(0)
+            for sp, sn in ((8, 128), (3, 128), (17, 384), (512, 256),
+                           (128, 6400), (1024, 1024), (16, 64),
+                           (256, 127), (256, 129)):
+                label = f"{sp}x{sn}"
+                if not pallas_supported(sn):
+                    table[label] = "unsupported(scan fallback)"
+                    continue
+                scores = rng.random((sp, sn)).astype(np.float32) * 100
+                scores[rng.random((sp, sn)) < 0.2] = float(NEG)
+                req = (rng.integers(1, 4, (sp, 4)) * 100).astype(np.float32)
+                free = (rng.integers(1, 5, (sn, 4)) * 250).astype(np.float32)
+                args = (jnp.array(scores), jnp.array(req),
+                        jnp.array(free), jax.random.PRNGKey(5))
+                a = jax.jit(greedy_assign_pallas)(*args)
+                b = jax.jit(greedy_assign)(*args)
+                ok = (np.array_equal(np.asarray(a.chosen),
+                                     np.asarray(b.chosen))
+                      and np.array_equal(np.asarray(a.assigned),
+                                         np.asarray(b.assigned)))
+                table[label] = "equal" if ok else "MISMATCH"
+            detail["pallas_shapes"] = table
+            if any(v == "MISMATCH" for v in table.values()):
+                detail["error"] = "pallas kernel mismatch in shape sweep"
+    except Exception as e:
+        detail["pallas_shapes_error"] = f"{type(e).__name__}: {e}"[:300]
+
     # ---- BASELINE config 5: gang scheduling at full scale --------------
     # (all-or-nothing joint assignment: pods in gangs of 8, quorum = 8;
     # the step is the SAME compiled program as the headline — gang inputs

@@ -36,9 +36,7 @@ from ..errors import ConflictError, NotFoundError
 from ..ops.pipeline import Decision, build_step
 from ..plugins.base import PluginSet
 from ..state.events import ActionType, ClusterEvent, EventBroadcaster, GVK
-from ..state.informer import InformerFactory
 from ..state.objects import Pod, claim_keys, gang_key
-from . import eventhandlers
 from .queue import (BATCH_CAPACITY, COSCHEDULING, QueuedPodInfo,
                     SchedulingQueue)
 from .waitingpod import WaitingPod
@@ -309,7 +307,10 @@ def arbitrate_spread(batch: List[QueuedPodInfo], assigned, pf, gf,
 class Scheduler:
     def __init__(self, store, plugin_set: PluginSet,
                  config: Optional[SchedulerConfig] = None,
-                 recorder=None, scheduler_names: Optional[Set[str]] = None):
+                 recorder=None, scheduler_names: Optional[Set[str]] = None,
+                 shared=None):
+        from .clusterstate import SharedClusterState
+
         self.store = store
         self.plugin_set = plugin_set
         self.config = config or SchedulerConfig()
@@ -319,7 +320,14 @@ class Scheduler:
         # KubeSchedulerProfile.SchedulerName selection); None = accept all
         # (single-profile mode).
         self.scheduler_names = scheduler_names
-        self.cache = NodeFeatureCache()
+        # Cluster state (feature cache + informers) is SHARED across the
+        # service's profile engines (reference: one scheduler struct,
+        # many profiles, scheduler.go:97-142) — a solo engine owns a
+        # private instance, so direct construction keeps working.
+        self._shared = shared or SharedClusterState(store)
+        self._owns_shared = shared is None
+        self.cache = self._shared.cache
+        self._shared.register(self)
         self.broadcaster = EventBroadcaster(store)
 
         event_map = plugin_set.cluster_event_map()
@@ -344,9 +352,6 @@ class Scheduler:
             event_map,
             backoff_initial=self.config.backoff_initial_s,
             backoff_max=self.config.backoff_max_s)
-
-        self.informer_factory = InformerFactory(store)
-        eventhandlers.add_all_event_handlers(self, self.informer_factory)
 
         self._step = build_step(plugin_set, explain=self.config.explain,
                                 assignment=self.config.assignment)
@@ -390,11 +395,6 @@ class Scheduler:
         # scheduling thread could clobber a concurrent arm with None.
         self._trace_lock = threading.Lock()
         self._trace_dir: Optional[str] = None
-        # node name → pod keys whose bind accounting was dropped when that
-        # node was removed (see on_node_added/on_node_removed; pruned by
-        # on_bound_pod_deleted). Touched only on the informer dispatch
-        # thread.
-        self._orphaned_binds: Dict[str, Set[str]] = {}
         # Timing/counter metrics (beyond the reference's klog-only
         # observability, SURVEY §5): cumulative sums + last-batch values,
         # guarded by a dedicated lock (read from any thread).
@@ -418,11 +418,12 @@ class Scheduler:
     # ---- lifecycle ------------------------------------------------------
 
     def start(self) -> None:
-        """Start informers + the scheduling loop (reference
-        scheduler/scheduler.go:72-75: factory.Start, WaitForCacheSync,
-        go sched.Run)."""
-        self.informer_factory.start()
-        self.informer_factory.wait_for_cache_sync()
+        """Start the shared informers (once across all profile engines)
+        + this engine's scheduling loop (reference scheduler.go:72-75:
+        factory.Start, WaitForCacheSync, go sched.Run). With multiple
+        profiles, the SERVICE must construct every engine before starting
+        any — a late registration would miss the initial sync."""
+        self._shared.ensure_started()
         self._thread = threading.Thread(target=self.run, daemon=True,
                                         name="scheduling-loop")
         self._thread.start()
@@ -433,7 +434,8 @@ class Scheduler:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
-        self.informer_factory.shutdown()
+        if self._owns_shared:
+            self._shared.shutdown()
         self._binder.shutdown(wait=False)
         if self.recorder is not None:
             # Budget past one flush's full retry backoff (~6 s at defaults)
@@ -881,48 +883,9 @@ class Scheduler:
                 sp[rows] = sp2[:P2][:n_res]
                 sp[sp_p + rows] = sp2[P2:2 * P2][:n_res]
 
-    # ---- node lifecycle (informer thread) -------------------------------
-
-    def on_node_added(self, node) -> None:
-        """Node appeared: encode it, and RE-ADOPT any pods still bound (in
-        the store) to a previous same-named incarnation. Their accounting
-        was dropped with the old row; without re-adoption the recreated
-        node starts at full free capacity while the store still charges
-        those pods to its name — every new bind then over-commits it.
-        Adoption happens inside the cache's upsert lock hold, so no
-        snapshot can observe the row before its pods are accounted. A pod
-        deleted between the store read here and the upsert is cleaned up
-        by its own DELETE event: this thread dispatches it afterwards and
-        account_unbind reverses the adoption."""
-        name = node.metadata.name
-        adopt = []
-        for key in self._orphaned_binds.pop(name, ()):
-            try:
-                pod = self.store.get("Pod", key)
-            except NotFoundError:
-                continue  # deleted while the node was gone
-            if pod.spec.node_name == name:
-                adopt.append(pod)
-        self.cache.upsert_node(node, bound_pods=adopt)
-
-    def on_node_removed(self, name: str) -> None:
-        """Node deleted: drop its row, remembering which bound pods lost
-        their accounting so a same-named re-add can restore them."""
-        gone = self.cache.remove_node(name)
-        if gone:
-            self._orphaned_binds.setdefault(name, set()).update(gone)
-
-    def on_bound_pod_deleted(self, pod) -> None:
-        """A bound pod vanished: release accounting, and prune any orphan
-        record (its node may never come back — without pruning,
-        _orphaned_binds grows monotonically under name-churning node
-        workloads)."""
-        self.cache.account_unbind(pod.key)
-        orphans = self._orphaned_binds.get(pod.spec.node_name)
-        if orphans is not None:
-            orphans.discard(pod.key)
-            if not orphans:
-                del self._orphaned_binds[pod.spec.node_name]
+    # Node lifecycle (informer thread) lives on the shared cluster state
+    # (engine/clusterstate.py) — one cache, one re-adoption table, all
+    # profile engines.
 
     # NodeFeatures leaves that change only on node events / topology
     # refresh — derived from the cache's authoritative dynamic list so the

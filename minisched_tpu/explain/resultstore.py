@@ -21,6 +21,15 @@ Bounding: at ``top_k`` (default 128) the per-pod annotation records only
 the k best nodes by weighted normalized score (all nodes when N ≤ k) —
 an unbounded record at 50k nodes would be a multi-megabyte annotation per
 pod and O(P×N) host work per batch.
+
+Full-N filter coverage: the JSON annotations are top-k bounded, but the
+question a scheduler simulator most often answers — "why did node X
+specifically reject this pod", for ARBITRARY X (reference
+resultstore/store.go:137-168 records every node) — is served by
+``filter_verdict``: per pod, a compact (N,) uint32 bitmask of failing
+filter plugins (bit f = plugin f rejected) retained for the most recent
+``full_n_retain`` pods. One uint32 per (pod, node) instead of the
+annotation's per-plugin JSON strings — no O(P×N) JSON blowup.
 """
 from __future__ import annotations
 
@@ -61,13 +70,25 @@ class ResultStore:
 
     def __init__(self, store, *, flush: bool = True,
                  async_flush: bool = False, top_k: int = 128,
-                 retry_initial_s: float = 0.05, retry_steps: int = 6):
+                 retry_initial_s: float = 0.05, retry_steps: int = 6,
+                 full_n_retain: Optional[int] = None,
+                 full_n_budget_bytes: int = 128 << 20):
         self._cluster = store
         self._flush = flush
         self._top_k = top_k
         self._lock = threading.Lock()
         # pod key → (batch record, pod row)
         self._results: Dict[str, tuple] = {}
+        # pod key → (name→col, (N,) uint32 failing-plugin bits, fnames);
+        # FIFO-bounded by ``full_n_retain`` rows when given, else by a
+        # BYTE budget (a fixed row count would silently cost ~0.8 GB at
+        # 50k nodes; the budget scales the row cap with N). Rows are
+        # views into one shared per-batch (P,N) array — memory frees when
+        # a batch's last key evicts, so worst-case residency is about one
+        # extra batch array beyond the budget.
+        self._filter_bits: Dict[str, tuple] = {}
+        self._full_n_retain = full_n_retain
+        self._full_n_budget = full_n_budget_bytes
         self._retry_initial = retry_initial_s
         self._retry_steps = retry_steps
         self._worker: Optional[threading.Thread] = None
@@ -166,11 +187,31 @@ class ResultStore:
             fnames=fnames, snames=snames, weights=weights,
             filter_masks=filter_masks, raw=raw, norm=norm)
 
+        # Full-N failing-plugin bitmask: one uint32 per (pod, node) —
+        # loop over F keeps the working set at (P,N), never (F,P,N)x4.
+        fail_bits = col_of = None
+        if filter_masks.shape[0]:
+            fail_bits = np.zeros(filter_masks.shape[1:], dtype=np.uint32)
+            for f in range(min(filter_masks.shape[0], 32)):
+                fail_bits |= (~filter_masks[f]).astype(np.uint32) << f
+            col_of = {n: j for j, n in enumerate(names) if n is not None}
+
+        retain = self._full_n_retain
+        if retain is None and fail_bits is not None:
+            row_bytes = max(1, fail_bits.shape[1] * 4)
+            retain = max(64, self._full_n_budget // row_bytes)
         keys = []
         with self._lock:
             for i, pod in enumerate(pods):
                 self._results[pod.key] = (batch, i)
                 keys.append(pod.key)
+                if fail_bits is not None:
+                    self._filter_bits.pop(pod.key, None)  # refresh order
+                    self._filter_bits[pod.key] = (col_of, fail_bits[i],
+                                                  fnames)
+            if fail_bits is not None:
+                while len(self._filter_bits) > retain:
+                    self._filter_bits.pop(next(iter(self._filter_bits)))
         return keys
 
     # ---- flushing (reference addSchedulingResultToPod store.go:90-135) --
@@ -296,6 +337,26 @@ class ResultStore:
         if self._q is not None:
             self._q.put(None)
 
+    def filter_verdict(self, pod_key: str,
+                       node_name: str) -> Optional[Dict[str, str]]:
+        """Why did node ``node_name`` accept/reject this pod — answerable
+        for EVERY node of the pod's last recorded attempt (full-N
+        coverage; reference resultstore/store.go:137-168 records every
+        node), not just the top-k annotated ones. Returns plugin →
+        PASSED/FAILED, or None if the pod's record was evicted or the
+        node wasn't in that attempt's snapshot."""
+        with self._lock:
+            rec = self._filter_bits.get(pod_key)
+        if rec is None:
+            return None
+        col_of, bits_row, fnames = rec
+        j = col_of.get(node_name)
+        if j is None:
+            return None
+        b = int(bits_row[j])
+        return {fn: (FAILED if (b >> f) & 1 else PASSED)
+                for f, fn in enumerate(fnames)}
+
     def delete_data(self, key: str) -> None:
         # Only _results is purged: _queued_keys counts are owned by the
         # enqueue/worker pairing — popping here would make the worker's
@@ -304,6 +365,7 @@ class ResultStore:
         # (flush_pod → NotFound → evict).
         with self._lock:
             self._results.pop(key, None)
+            self._filter_bits.pop(key, None)
 
     def pending_keys(self) -> List[str]:
         """Everything not yet flushed: ingested results AND batches still

@@ -426,8 +426,11 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             logging.getLogger(__name__).exception(
                 "scheduling step failed on first call (pallas lowering?); "
                 "retrying with the lax.scan assignment")
+            # assignment is always "greedy" here (other modes take the
+            # unguarded early return above) — passed through anyway so a
+            # future guard extension can't silently switch strategies.
             state["fn"] = build_step(plugin_set, explain=explain, cfg=cfg,
-                                     pallas=False,
+                                     pallas=False, assignment=assignment,
                                      sample_nodes=sample_nodes)
             state["fell_back"] = True
             return state["fn"](eb, nf, af, key)

@@ -18,6 +18,7 @@ import logging
 from typing import Dict, List, Optional, Sequence, Union
 
 from ..config import SchedulerConfig
+from ..engine.clusterstate import SharedClusterState
 from ..engine.scheduler import Scheduler
 from ..explain.resultstore import ResultStore
 from .config import SchedulerConfiguration
@@ -32,6 +33,7 @@ class SchedulerService:
     def __init__(self, store):
         self._store = store
         self._scheds: Dict[str, Scheduler] = {}
+        self._shared_state: Optional[SharedClusterState] = None
         self._profiles: List[Profile] = []
         self._multi = False
         self._config: Optional[SchedulerConfig] = None
@@ -87,14 +89,23 @@ class SchedulerService:
         # profile (unknown plugin, bad args) can't leave a half-started
         # service behind.
         built = [(p, p.build()) for p in profiles]
+        # ONE cluster state (feature cache + informer set) for every
+        # profile engine (reference: one scheduler struct, many profiles,
+        # scheduler.go:97-142) — per-profile caches would multiply
+        # tens-of-MB node state AND let two profiles jointly over-commit
+        # a node neither would alone. All engines must register before
+        # the first start() syncs the informers.
+        self._shared_state = SharedClusterState(self._store)
         for p, plugin_set in built:
             # In multi-profile mode each engine only takes pods naming its
             # profile; a single profile keeps the accept-everything legacy
             # behavior.
             sched = Scheduler(
                 self._store, plugin_set, self._config, recorder=recorder,
-                scheduler_names={p.name} if self._multi else None)
+                scheduler_names={p.name} if self._multi else None,
+                shared=self._shared_state)
             self._scheds[p.name] = sched
+        for sched in self._scheds.values():
             sched.start()
         log.info("scheduler started (profiles=%s)", names)
         return self.scheduler
@@ -103,6 +114,9 @@ class SchedulerService:
         for name, sched in list(self._scheds.items()):
             sched.shutdown()
             log.info("scheduler %s shut down", name)
+        if self._scheds and self._shared_state is not None:
+            self._shared_state.shutdown()
+            self._shared_state = None
         self._scheds.clear()
 
     def restart_scheduler(self) -> Scheduler:

@@ -213,3 +213,60 @@ def test_duplicate_profile_names_rejected():
     with pytest.raises(ValueError):
         svc.start_scheduler([Profile(name="x", plugins=["NodeUnschedulable"]),
                              Profile(name="x", plugins=["NodeUnschedulable"])])
+
+
+def test_two_profiles_share_one_cache_and_informer_at_10k_nodes():
+    """Cluster state is shared across profile engines (reference: one
+    scheduler struct, many profiles, scheduler.go:97-142): at 10k nodes a
+    two-profile service must hold ONE NodeFeatureCache (identity) and run
+    ONE informer dispatch stream — per-profile duplicates would multiply
+    tens-of-MB caches and redundant watch streams, and (worse) let two
+    profiles jointly over-commit a node."""
+    import threading
+
+    from minisched_tpu.state.objects import (Node, NodeStatus, ObjectMeta,
+                                             Pod, PodSpec)
+
+    store = ClusterStore()
+    store.create_many([Node(
+        metadata=ObjectMeta(name=f"mp-n{i:05d}"),
+        status=NodeStatus(allocatable={"cpu": 1000, "pods": 110}))
+        for i in range(10_000)])
+    svc = SchedulerService(store)
+    svc.start_scheduler([
+        Profile(name="prof-a", plugins=["NodeUnschedulable",
+                                        "NodeResourcesFit"]),
+        Profile(name="prof-b", plugins=["NodeUnschedulable",
+                                        "NodeResourcesFit",
+                                        "NodeResourcesLeastAllocated"]),
+    ], SchedulerConfig(backoff_initial_s=0.05, backoff_max_s=0.2,
+                       batch_window_s=0.0))
+    try:
+        a, b = svc.schedulers["prof-a"], svc.schedulers["prof-b"]
+        assert a.cache is b.cache                      # ONE cache
+        assert a._shared is b._shared                  # ONE cluster state
+        assert a.cache.node_count() == 10_000
+        pumps = [t for t in threading.enumerate()
+                 if t.name == "informer-dispatch"]
+        assert len(pumps) == 1, [t.name for t in pumps]  # ONE watch stream
+
+        # capacity accounting is globally consistent across profiles:
+        # each engine binds via the shared cache
+        store.create_many([
+            Pod(metadata=ObjectMeta(name="mp-pa", namespace="default"),
+                spec=PodSpec(requests={"cpu": 100},
+                             scheduler_name="prof-a")),
+            Pod(metadata=ObjectMeta(name="mp-pb", namespace="default"),
+                spec=PodSpec(requests={"cpu": 100},
+                             scheduler_name="prof-b")),
+        ])
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            pa = store.get("Pod", "default/mp-pa")
+            pb = store.get("Pod", "default/mp-pb")
+            if pa.spec.node_name and pb.spec.node_name:
+                break
+            time.sleep(0.05)
+        assert pa.spec.node_name and pb.spec.node_name
+    finally:
+        svc.shutdown_scheduler()

@@ -122,3 +122,25 @@ def test_hybrid_mesh_single_process_and_step(eight_devices):
     # explicit pod axis override still honored
     mesh4 = make_hybrid_mesh(pod_axis_size=4, devices=eight_devices)
     assert mesh4.devices.shape == (4, 2)
+
+
+def test_sharded_hard_semantics_gang_spread_anti(eight_devices):
+    """Gang quorum (met AND missed, all-or-nothing), DoNotSchedule spread
+    and required anti-affinity on the virtual mesh, under capacity-1
+    scarcity; the sharded decision must equal single-device (same
+    tiered-auction assignment, same key)."""
+    import __graft_entry__ as G
+
+    mesh = make_mesh(eight_devices)
+    eb, nf, af, names = G._semantics_inputs()
+    ps = G._flagship_plugin_set()
+    key = jax.random.PRNGKey(7)
+    d_sh = build_sharded_step(ps, mesh, eb, nf, af)(
+        *shard_features(mesh, eb, nf, af), key)
+    G.check_semantics_decision(d_sh, names)
+    d_si = build_step(ps, pallas=False, assignment="auction")(
+        eb, nf, af, key)
+    G.check_semantics_decision(d_si, names)
+    for f in ("chosen", "assigned", "gang_rejected"):
+        np.testing.assert_array_equal(np.asarray(getattr(d_si, f)),
+                                      np.asarray(getattr(d_sh, f)), f)

@@ -215,3 +215,59 @@ def test_top_k_prefers_feasible_nodes():
     assert {"n0", "n1"} <= set(fr)          # all feasible nodes present
     assert len(fr) == 3                     # one infeasible fills the slot
     assert fr["n7"]["NodeUnschedulable"] != PASSED  # best infeasible kept
+
+
+# ---- full-N filter verdicts (beyond the top-k annotation bound) ---------
+
+def test_filter_verdict_answers_outside_topk_at_5k_nodes():
+    """'Why did node X specifically reject this pod' must be answerable
+    for an arbitrary X OUTSIDE the top-k annotation window at N=5k
+    (reference resultstore/store.go:137-168 records every node; the
+    rebuild's JSON annotations are top-k bounded, the compact bitmask is
+    not)."""
+    from minisched_tpu.explain.resultstore import FAILED
+
+    N, K = 5000, 128
+    store = ClusterStore()
+    pods = [store.create(_pod("fq0"))]
+    plugin_set = PluginSet([NodeUnschedulable(), NodeNumber()], {})
+    rs = ResultStore(store, flush=True, top_k=K, retry_initial_s=0.001)
+    names = [f"fn{i:05d}" for i in range(N)]
+    fm = np.ones((1, 1, N), dtype=bool)
+    # reject a band of low-scoring nodes: scores descend with the index,
+    # so anything past the top-k window is out of the annotation
+    fm[0, 0, 4000:4500] = False
+    raw = np.linspace(100.0, 0.0, N, dtype=np.float32)[None, None, :]
+    norm = raw.copy()
+    rs.record_batch(pods, names, FakeDecision(fm, raw, norm), plugin_set)
+
+    # annotation is bounded: the rejected node is NOT in the JSON
+    pod = store.get("Pod", pods[0].key)
+    fr = json.loads(pod.metadata.annotations[FILTER_RESULT_KEY])
+    assert len(fr) == K
+    assert "fn04321" not in fr
+    # ...but the full-N verdict answers for it (and any other node)
+    v = rs.filter_verdict(pods[0].key, "fn04321")
+    assert v == {"NodeUnschedulable": FAILED}
+    assert rs.filter_verdict(pods[0].key, "fn00001") == {
+        "NodeUnschedulable": PASSED}
+    assert rs.filter_verdict(pods[0].key, "no-such-node") is None
+    assert rs.filter_verdict("ghost/pod", "fn00001") is None
+
+
+def test_filter_verdict_retention_bound_and_delete():
+    store = ClusterStore()
+    plugin_set = PluginSet([NodeUnschedulable()], {})
+    rs = ResultStore(store, flush=False, full_n_retain=4)
+    names = ["na", "nb"]
+    for i in range(6):
+        p = store.create(_pod(f"rb{i}"))
+        fm = np.zeros((1, 1, 2), dtype=bool)
+        raw = np.zeros((1, 1, 2), dtype=np.float32)
+        rs.record_batch([p], names, FakeDecision(fm, raw, raw), plugin_set)
+    # FIFO bound: oldest two evicted
+    assert rs.filter_verdict("default/rb0", "na") is None
+    assert rs.filter_verdict("default/rb1", "na") is None
+    assert rs.filter_verdict("default/rb5", "na") is not None
+    rs.delete_data("default/rb5")
+    assert rs.filter_verdict("default/rb5", "na") is None
