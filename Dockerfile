@@ -1,0 +1,27 @@
+# Hermetic dev/run image: `clone and run without a Python env`.
+# The reference ships docker-compose pairing the simulator with etcd
+# (reference docker-compose.yml:2-30; its own Dockerfile is broken —
+# SURVEY §2 quirk). The rebuild needs no etcd (the cluster store is
+# in-process), so one image covers test, scenario, and the HTTP
+# apiserver. CPU wheels only — TPU runs use the host's libtpu install.
+FROM python:3.12-slim
+
+# slim images exclude make; the dev targets are Makefile-driven
+RUN apt-get update && apt-get install -y --no-install-recommends make \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+
+# CPU jax is enough for every containerized target (tests/scenario run
+# on the virtual 8-device CPU mesh; see Makefile CPU_MESH).
+RUN pip install --no-cache-dir \
+    "jax==0.9.0" "flax==0.12.3" "optax==0.2.6" "chex==0.1.91" \
+    "einops==0.8.2" "numpy>=2" "pytest==8.4.2"
+
+COPY Makefile bench.py bench_sharded.py bench_workload.py \
+     __graft_entry__.py ./
+COPY minisched_tpu/ minisched_tpu/
+COPY tests/ tests/
+
+# Default: prove the image works end-to-end (README scenario).
+CMD ["make", "start"]

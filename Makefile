@@ -9,7 +9,7 @@ PY ?= python
 # a wedged tunnel can't hang backend init.
 CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: test start demo bench bench_sharded dryrun soak
+.PHONY: test start start-remote demo bench bench_sharded dryrun soak
 
 # Unit + integration suite on a virtual 8-device CPU mesh.
 test:
@@ -19,6 +19,12 @@ test:
 # unschedulable nodes + 1 pod pending → node10 added → pod bound.
 start:
 	$(CPU_MESH) $(PY) -m minisched_tpu.scenario.runner
+
+# README scenario over the WIRE: a subprocess boots store + scheduler +
+# HTTP apiserver; the client drives it purely through the socket
+# (reference k8sapiserver + client-go pairing).
+start-remote:
+	$(CPU_MESH) $(PY) -m minisched_tpu.scenario.remote
 
 # Advanced-feature demo: zone spread (with intra-batch skew arbitration),
 # gang quorum, explain annotations.

@@ -1,0 +1,110 @@
+"""HTTP client for the API server — the client-go analog.
+
+``RemoteStore`` mirrors the ClusterStore verbs an external tool needs
+(create / create_many / get / list / update / delete / watch_events) over
+the wire, decoding JSON back into the typed API objects and mapping
+status codes back onto the store's exception types — so scenario code
+written against the in-process store drives a remote simulator unchanged
+(reference sched.go:42-68 drives its apiserver through client-go the
+same way).
+"""
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, List, Optional, Tuple
+
+from ..errors import AlreadyExistsError, ConflictError, NotFoundError
+from ..state import objects as obj
+
+
+class RemoteStore:
+    def __init__(self, address: str, timeout: float = 10.0):
+        self.address = address.rstrip("/")
+        self.timeout = timeout
+
+    # ---- wire plumbing --------------------------------------------------
+
+    def _call(self, method: str, path: str, body=None,
+              timeout: Optional[float] = None):
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(
+            self.address + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                msg = str(e)
+            if e.code == 404:
+                raise NotFoundError(msg) from None
+            if e.code == 409:
+                # the server folds AlreadyExists and Conflict into 409;
+                # disambiguate on the message like client-go does on
+                # status reasons
+                if "already exists" in msg:
+                    raise AlreadyExistsError(msg) from None
+                raise ConflictError(msg) from None
+            if e.code == 410:
+                raise ValueError(msg) from None  # watch fell behind
+            raise RuntimeError(f"apiserver {e.code}: {msg}") from None
+
+    # ---- store verbs ----------------------------------------------------
+
+    def create(self, o: Any) -> Any:
+        kind = obj.kind_of(o)
+        return obj.from_dict(kind, self._call(
+            "POST", f"/apis/{kind}", obj.to_dict(o)))
+
+    def create_many(self, objs: List[Any]) -> List[Any]:
+        if not objs:
+            return []
+        kind = obj.kind_of(objs[0])
+        out = self._call("POST", f"/apis/{kind}?bulk=1",
+                         [obj.to_dict(o) for o in objs])
+        return [obj.from_dict(kind, d) for d in out["items"]]
+
+    def get(self, kind: str, key: str) -> Any:
+        return obj.from_dict(kind, self._call("GET", f"/apis/{kind}/{key}"))
+
+    def list(self, kind: str) -> List[Any]:
+        out = self._call("GET", f"/apis/{kind}")
+        return [obj.from_dict(kind, d) for d in out["items"]]
+
+    def update(self, o: Any) -> Any:
+        kind = obj.kind_of(o)
+        return obj.from_dict(kind, self._call(
+            "PUT", f"/apis/{kind}/{o.key}", obj.to_dict(o)))
+
+    def delete(self, kind: str, key: str) -> None:
+        self._call("DELETE", f"/apis/{kind}/{key}")
+
+    def watch_events(self, cursor: int, kinds: Optional[List[str]] = None,
+                     timeout: float = 5.0) -> Tuple[List[dict], int]:
+        """One long-poll: events after ``cursor`` (dicts with type/kind/
+        object/old/rv; objects decoded) and the new cursor. Raises
+        ValueError when the cursor fell behind (re-list and restart —
+        the k8s reflector contract)."""
+        q = f"/watch?from={cursor}&timeout={timeout}"
+        if kinds:
+            q += "&kinds=" + ",".join(kinds)
+        out = self._call("GET", q, timeout=timeout + self.timeout)
+        events = []
+        for e in out["events"]:
+            e = dict(e)
+            e["object"] = obj.from_dict(e["kind"], e["object"])
+            if e.get("old") is not None:
+                e["old"] = obj.from_dict(e["kind"], e["old"])
+            events.append(e)
+        return events, out["cursor"]
+
+    def healthz(self) -> bool:
+        try:
+            return bool(self._call("GET", "/healthz").get("ok"))
+        except Exception:
+            return False

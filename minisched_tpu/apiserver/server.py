@@ -1,0 +1,215 @@
+"""HTTP+JSON front on the cluster store — the out-of-process client
+surface.
+
+The reference boots a REAL kube-apiserver over HTTP and its scenario
+drives the simulator through client-go like any external tool
+(reference k8sapiserver/k8sapiserver.go:43-71, sched.go:42-68). The
+rebuild's store is an in-process object — this module restores the
+"any client can attach" property with a thin wire layer over the
+store's existing CRUD + versioned watch:
+
+    GET    /apis/{kind}                 → {"items": [...]}
+    GET    /apis/{kind}/{key}           → object   (key = ns/name or name)
+    POST   /apis/{kind}                 → create   (JSON object body)
+    POST   /apis/{kind}?bulk=1          → create_many (JSON list body)
+    PUT    /apis/{kind}/{key}           → update
+    DELETE /apis/{kind}/{key}           → delete
+    GET    /watch?from={rv}&kinds=a,b&timeout=s
+           → {"events": [{type, kind, object, old, rv}], "cursor": rv}
+             long-poll; 410 Gone when the cursor fell behind the retained
+             log (client re-lists, exactly the k8s watch contract)
+    GET    /healthz
+
+Errors map to status codes: 404 NotFound, 409 AlreadyExists/Conflict,
+400 bad input. Server threads only touch the thread-safe store; the
+scheduler service runs beside it in-process, exactly like the
+reference's apiserver+scheduler pairing.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..errors import AlreadyExistsError, ConflictError, NotFoundError
+from ..state import objects as obj
+from ..state.store import ClusterStore
+
+log = logging.getLogger(__name__)
+
+
+class APIServer:
+    """Serve a ClusterStore over HTTP on localhost:port (0 = ephemeral)."""
+
+    def __init__(self, store: ClusterStore, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.store = store
+        handler = _make_handler(store)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "APIServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="apiserver")
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def _make_handler(store: ClusterStore):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # ---- plumbing ---------------------------------------------------
+
+        def log_message(self, fmt, *args):  # route through logging, quiet
+            log.debug("apiserver: " + fmt, *args)
+
+        def _send(self, code: int, payload) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _error(self, code: int, msg: str) -> None:
+            self._send(code, {"error": msg})
+
+        def _body(self):
+            n = int(self.headers.get("Content-Length", "0"))
+            return json.loads(self.rfile.read(n)) if n else None
+
+        def _route(self):
+            """(kind, key, query) from the request path; key may be ''."""
+            u = urlparse(self.path)
+            parts = [p for p in u.path.split("/") if p]
+            q = parse_qs(u.query)
+            if not parts:
+                return None, None, q
+            if parts[0] == "apis" and len(parts) >= 2:
+                return parts[1], "/".join(parts[2:]), q
+            return parts[0], "/".join(parts[1:]), q
+
+        def _guard(self, fn):
+            try:
+                fn()
+            except NotFoundError as e:
+                self._error(404, str(e))
+            except AlreadyExistsError as e:
+                self._error(409, str(e))
+            except ConflictError as e:
+                self._error(409, str(e))
+            except (KeyError, TypeError, ValueError) as e:
+                self._error(400, f"{type(e).__name__}: {e}")
+            except Exception as e:  # pragma: no cover - server must answer
+                log.exception("apiserver internal error")
+                self._error(500, f"{type(e).__name__}: {e}")
+
+        # ---- verbs ------------------------------------------------------
+
+        def do_GET(self):
+            kind, key, q = self._route()
+            if kind == "healthz":
+                return self._send(200, {"ok": True})
+            if kind == "watch":
+                return self._guard(lambda: self._watch(q))
+            if kind is None:
+                return self._error(404, "no route")
+
+            def run():
+                if key:
+                    self._send(200, obj.to_dict(store.get(kind, key)))
+                else:
+                    self._send(200, {"items": [obj.to_dict(o)
+                                               for o in store.list(kind)]})
+            self._guard(run)
+
+        def _watch(self, q):
+            """Stateless long-poll watch: each call opens a cursor at
+            ``from`` and drains up to ~1024 events (or times out empty).
+            A cursor behind the retained log answers 410 Gone — the
+            client re-lists and restarts, the k8s reflector contract."""
+            frm = int(q.get("from", ["0"])[0])
+            kinds = q.get("kinds", [""])[0]
+            timeout = min(float(q.get("timeout", ["5"])[0]), 30.0)
+            w = None
+            try:
+                w = store.watch(kinds=kinds.split(",") if kinds else None,
+                                from_version=frm)
+                evs = w.next_events(1024, timeout=timeout)
+                # The watcher's own cursor, NOT the last matching event's
+                # rv: it advanced past kind-filtered events too, so the
+                # client neither rescans them next poll nor spuriously
+                # falls behind on unrelated churn.
+                cursor = w.cursor
+            except ValueError as e:  # fell behind the retained log
+                return self._error(410, str(e))
+            finally:
+                if w is not None:
+                    w.stop()
+            out = [{"type": e.type,  # plain str constants (store.EventType)
+                    "kind": e.kind,
+                    "object": obj.to_dict(e.object),
+                    "old": (obj.to_dict(e.old_object)
+                            if e.old_object is not None else None),
+                    "rv": e.resource_version} for e in evs]
+            self._send(200, {"events": out, "cursor": cursor})
+
+        def do_POST(self):
+            kind, key, q = self._route()
+            if kind is None:
+                return self._error(404, "no route")
+
+            def run():
+                body = self._body()
+                if q.get("bulk"):
+                    created = store.create_many(
+                        [obj.from_dict(kind, d) for d in body])
+                    self._send(201, {"items": [obj.to_dict(o)
+                                               for o in created]})
+                else:
+                    created = store.create(obj.from_dict(kind, body))
+                    self._send(201, obj.to_dict(created))
+            self._guard(run)
+
+        def do_PUT(self):
+            kind, key, _q = self._route()
+            if kind is None or not key:
+                return self._error(404, "no route")
+
+            def run():
+                o = obj.from_dict(kind, self._body())
+                if o.key != key:
+                    return self._error(
+                        400, f"body names {o.key!r} but URL targets "
+                             f"{key!r}")
+                updated = store.update(o)
+                self._send(200, obj.to_dict(updated))
+            self._guard(run)
+
+        def do_DELETE(self):
+            kind, key, _q = self._route()
+            if kind is None or not key:
+                return self._error(404, "no route")
+
+            def run():
+                store.delete(kind, key)
+                self._send(200, {"deleted": key})
+            self._guard(run)
+
+    return Handler

@@ -1,0 +1,125 @@
+"""The README scenario driven OVER THE WIRE — reference parity for
+"an external process drives the simulator through its apiserver"
+(reference boots kube-apiserver at k8sapiserver/k8sapiserver.go:43-71 and
+sched.go:42-68 drives it through client-go).
+
+``--serve``: boot store + scheduler service + HTTP apiserver and print
+the listening address (the simulator process).
+default: spawn the server as a SUBPROCESS, then run the README scenario
+(sched.go:70-143) purely through HTTP via RemoteStore — 9 unschedulable
+nodes, pod1 pends with NodeUnschedulable recorded, node10 arrives, pod1
+binds to node10 — and shut the server down.
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+from ..state import objects as obj
+
+
+def serve() -> None:
+    """Simulator process: store + scheduler + HTTP front; prints the
+    address, serves until stdin closes (parent exit kills us)."""
+    from ..apiserver import APIServer
+    from ..config import SchedulerConfig
+    from ..service.service import SchedulerService
+    from ..state.store import ClusterStore
+
+    import os
+
+    store = ClusterStore()
+    svc = SchedulerService(store)
+    svc.start_scheduler(config=SchedulerConfig(
+        backoff_initial_s=0.1, backoff_max_s=0.5, batch_window_s=0.0))
+    api = APIServer(store,
+                    host=os.environ.get("MINISCHED_API_HOST", "127.0.0.1"),
+                    port=int(os.environ.get("MINISCHED_API_PORT", "0"))
+                    ).start()
+    print(f"LISTENING {api.address}", flush=True)
+    try:
+        sys.stdin.read()  # parent closes the pipe → exit
+    except KeyboardInterrupt:
+        pass
+    finally:
+        api.shutdown()
+        svc.shutdown_scheduler()
+
+
+def _wait(pred, timeout: float = 30.0, interval: float = 0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+def run_remote_scenario(address: str) -> None:
+    """The README scenario (reference sched.go:70-143), over HTTP."""
+    from ..apiserver import RemoteStore
+
+    rs = RemoteStore(address)
+    _wait(rs.healthz, timeout=15)
+
+    rs.create_many([obj.Node(
+        metadata=obj.ObjectMeta(name=f"node{i}"),
+        spec=obj.NodeSpec(unschedulable=True),
+        status=obj.NodeStatus(allocatable={"cpu": 4000, "memory": 16 << 30,
+                                           "pods": 110}))
+        for i in range(9)])
+    rs.create(obj.Pod(metadata=obj.ObjectMeta(name="pod1",
+                                              namespace="default"),
+                      spec=obj.PodSpec(requests={"cpu": 100})))
+
+    pending = _wait(lambda: (
+        p := rs.get("Pod", "default/pod1")).status.unschedulable_plugins
+        and p or None)
+    assert pending.status.unschedulable_plugins == ["NodeUnschedulable"], \
+        pending.status.unschedulable_plugins
+    assert pending.spec.node_name == ""
+    print("pod1 pending as expected over the wire "
+          f"(unschedulable_plugins={pending.status.unschedulable_plugins})")
+
+    rs.create(obj.Node(
+        metadata=obj.ObjectMeta(name="node10"),
+        status=obj.NodeStatus(allocatable={"cpu": 4000, "memory": 16 << 30,
+                                           "pods": 110})))
+    bound = _wait(lambda: (
+        p := rs.get("Pod", "default/pod1")).spec.node_name and p or None)
+    assert bound.spec.node_name == "node10", bound.spec.node_name
+    print(f"pod1 is bound to {bound.spec.node_name} over the wire")
+
+    # watch surface: the whole history replays through the HTTP long-poll
+    events, cursor = rs.watch_events(0, kinds=["Pod"], timeout=2.0)
+    kinds_seen = {(e["type"]) for e in events}
+    assert "ADDED" in kinds_seen and "MODIFIED" in kinds_seen, kinds_seen
+    assert any(e["type"] == "MODIFIED"
+               and e["object"].spec.node_name == "node10" for e in events)
+    print(f"watch replayed {len(events)} Pod events to cursor {cursor}")
+    print("remote scenario OK")
+
+
+def main() -> None:
+    if "--serve" in sys.argv:
+        serve()
+        return
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "minisched_tpu.scenario.remote", "--serve"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("LISTENING "), line
+        run_remote_scenario(line.split(" ", 1)[1])
+    finally:
+        try:
+            proc.stdin.close()  # server exits when the pipe closes
+            proc.wait(timeout=10)
+        except Exception:
+            proc.kill()
+
+
+if __name__ == "__main__":
+    main()

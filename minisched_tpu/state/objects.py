@@ -17,6 +17,7 @@ Conventions:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import itertools
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -468,6 +469,57 @@ def deepcopy_obj(obj):
 
 def to_dict(obj: Any) -> Dict[str, Any]:
     return dataclasses.asdict(obj)
+
+
+_KIND_CLASS = {v: k for k, v in KIND_OF.items()}
+
+
+@functools.lru_cache(maxsize=None)
+def _field_hints(cls) -> Dict[str, Any]:
+    import typing
+
+    return typing.get_type_hints(cls)
+
+
+def _build_typed(tp: Any, v: Any) -> Any:
+    """Recursively rebuild a typed value from its JSON form — the inverse
+    of dataclasses.asdict for the API object tree (the wire layer of the
+    HTTP front; reference: client-go decodes apiserver JSON the same
+    shape-directed way)."""
+    import typing
+
+    if v is None:
+        return None
+    origin = typing.get_origin(tp)
+    if origin is typing.Union:  # Optional[X] and friends
+        for arg in typing.get_args(tp):
+            if arg is type(None):
+                continue
+            return _build_typed(arg, v)
+        return v
+    if dataclasses.is_dataclass(tp):
+        hints = _field_hints(tp)
+        kwargs = {f.name: _build_typed(hints[f.name], v[f.name])
+                  for f in dataclasses.fields(tp) if f.name in v}
+        return tp(**kwargs)
+    if origin in (list, tuple, set):
+        args = typing.get_args(tp)
+        elem = args[0] if args else Any
+        seq = (_build_typed(elem, x) for x in v)
+        return origin(seq)
+    if origin is dict:
+        args = typing.get_args(tp)
+        vt = args[1] if len(args) == 2 else Any
+        return {k: _build_typed(vt, x) for k, x in v.items()}
+    return v  # scalars (str/int/float/bool) and untyped payloads
+
+
+def from_dict(kind: str, data: Dict[str, Any]) -> Any:
+    """JSON dict → API object of ``kind`` (inverse of to_dict)."""
+    cls = _KIND_CLASS.get(kind)
+    if cls is None:
+        raise TypeError(f"unknown kind {kind!r}")
+    return _build_typed(cls, data)
 
 
 def pod_requests(pod: Pod) -> ResourceList:

@@ -56,6 +56,13 @@ class Watcher:
     def wants(self, ev: WatchEvent) -> bool:
         return self._kinds is None or ev.kind in self._kinds
 
+    @property
+    def cursor(self) -> int:
+        """Resource version this watch has scanned to — includes events
+        skipped by the kind filter, so a resumed watch (the HTTP
+        long-poll) neither rescans them nor spuriously falls behind."""
+        return self._cursor
+
     def next_event(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
         """Next matching event after the cursor, or None on timeout/stop."""
         deadline = None if timeout is None else time.monotonic() + timeout
