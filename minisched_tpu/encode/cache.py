@@ -124,6 +124,10 @@ class NodeFeatureCache:
         self._a_capacity = a_cap
         self._a_free: List[int] = list(range(a_cap - 1, -1, -1))
         self._a_row: Dict[str, int] = {}  # pod key → assigned row
+        # row → pod key (inverse of _a_row): lets per-node victim lookups
+        # run as one vectorized mask over the assigned arrays instead of
+        # an O(all bound pods) dict walk under the cache lock.
+        self._a_key: List[Optional[str]] = [None] * a_cap
 
     # ---- node lifecycle -------------------------------------------------
 
@@ -173,6 +177,9 @@ class NodeFeatureCache:
                 if a is not None:
                     self._assigned.valid[a] = False
                     self._assigned.label_pairs[a] = 0
+                    self._assigned.requests[a] = 0.0
+                    self._assigned.priority[a] = 0
+                    self._a_key[a] = None
                     self._a_free.append(a)
                 self._drop_gang_member(k)
                 self._anti_drop_locked(k, i)
@@ -249,12 +256,17 @@ class NodeFeatureCache:
                 aa = np.asarray(a_rows, dtype=np.int64)
                 self._assigned.valid[aa] = True
                 self._assigned.node_row[aa] = ii
+                self._assigned.requests[aa] = reqs[kk]
+                self._assigned.priority[aa] = np.fromiter(
+                    (pod.spec.priority for _, _, pod in fast),
+                    dtype=np.int32, count=len(fast))
                 ns_memo: Dict[str, int] = {}
                 row_memo: Dict[tuple, np.ndarray] = {}
                 max_labels = self.cfg.max_labels
                 for (k, i, pod), a in zip(fast, a_rows):
                     self._bound[pod.key] = (i, reqs[k], (), [])
                     self._a_row[pod.key] = a
+                    self._a_key[a] = pod.key
                     group = gang_key(pod)
                     if group:
                         self._key_gang[pod.key] = group
@@ -324,8 +336,11 @@ class NodeFeatureCache:
 
         a = self._alloc_assigned_row()
         self._a_row[pod.key] = a
+        self._a_key[a] = pod.key
         self._assigned.valid[a] = True
         self._assigned.node_row[a] = i
+        self._assigned.requests[a] = req
+        self._assigned.priority[a] = pod.spec.priority
         self._assigned.ns_hash[a] = (F._h(pod.metadata.namespace)
                                      if pod.metadata.namespace else 0)
         self._assigned.label_pairs[a] = 0
@@ -353,6 +368,9 @@ class NodeFeatureCache:
             if a is not None:
                 self._assigned.valid[a] = False
                 self._assigned.label_pairs[a] = 0
+                self._assigned.requests[a] = 0.0
+                self._assigned.priority[a] = 0
+                self._a_key[a] = None
                 self._a_free.append(a)
             self._drop_gang_member(pod_key)
             self._anti_drop_locked(pod_key, i)
@@ -577,6 +595,37 @@ class NodeFeatureCache:
             if not rows:
                 self._anti_terms.pop(sig, None)
 
+    def victims_below(self, node_name: str, priority: int) -> List[tuple]:
+        """Bound pods on ``node_name`` with priority STRICTLY below
+        ``priority``: (pod_key, accounted request row, priority), sorted
+        ascending by priority — the DefaultPreemption victim pool (lowest
+        victims first, upstream's eviction order)."""
+        with self._lock:
+            i = self._index.get(node_name)
+            if i is None:
+                return []
+            cap = self._a_capacity
+            rows = np.nonzero(
+                self._assigned.valid[:cap]
+                & (self._assigned.node_row[:cap] == i)
+                & (self._assigned.priority[:cap] < priority))[0]
+            out = []
+            for a in rows.tolist():
+                key = self._a_key[a]
+                entry = self._bound.get(key) if key is not None else None
+                if entry is None:
+                    continue
+                out.append((key, entry[1].copy(),
+                            int(self._assigned.priority[a])))
+            out.sort(key=lambda t: t[2])
+            return out
+
+    def free_of(self, node_name: str) -> Optional[np.ndarray]:
+        """Current free-resource vector of one node (copy), or None."""
+        with self._lock:
+            i = self._index.get(node_name)
+            return None if i is None else self._feats.free[i].copy()
+
     def anti_forbidden_for(self, pod: Pod) -> List[Tuple[int, int]]:
         """(key_idx, domain) pairs the pod must avoid: domains holding a
         RUNNING pod whose required anti-affinity term matches this pod
@@ -641,6 +690,7 @@ class NodeFeatureCache:
                 g[: self._a_capacity] = x
             self._assigned = grown
             self._a_free += list(range(new_cap - 1, self._a_capacity - 1, -1))
+            self._a_key += [None] * (new_cap - self._a_capacity)
             self._a_capacity = new_cap
 
     def _alloc_assigned_row(self) -> int:

@@ -215,6 +215,10 @@ class AssignedPodFeatures(NamedTuple):
     node_row: np.ndarray     # (A,) i32 row of the node the pod is bound to
     ns_hash: np.ndarray      # (A,) i32 hash(namespace)
     label_pairs: np.ndarray  # (A,L) i32 hash(key=value) of the pod's labels
+    # Preemption inputs (upstream DefaultPreemption victim math): what a
+    # victim's eviction would release, and the priority bar it sits under.
+    requests: np.ndarray     # (A,R) f32 accounted requests
+    priority: np.ndarray     # (A,) i32
 
 
 class PodFeatures(NamedTuple):
@@ -350,6 +354,8 @@ def empty_assigned_features(a: int, cfg: EncodingConfig = DEFAULT_ENCODING
         node_row=np.zeros(a, dtype=np.int32),
         ns_hash=np.zeros(a, dtype=np.int32),
         label_pairs=np.zeros((a, cfg.max_labels), dtype=np.int32),
+        requests=np.zeros((a, NUM_RESOURCES), dtype=np.float32),
+        priority=np.zeros(a, dtype=np.int32),
     )
 
 

@@ -375,6 +375,10 @@ class Scheduler:
         # InterPodAffinity filter via encode.anti_forbid slots.
         self._anti_enabled = any(p.name == "InterPodAffinity"
                                  for p in plugin_set.plugins)
+        # PostFilter preemption (upstream DefaultPreemption): enabled by
+        # the marker plugin; terminally-unschedulable pods get a batched
+        # victim-candidate search before parking.
+        self._preempt_enabled = bool(plugin_set.postfilter_plugins)
         # Which encode-side fail-closed verdicts apply: only constraints
         # this profile's plugin set actually enforces may park a pod.
         self._fail_closed_plugins = {
@@ -712,6 +716,8 @@ class Scheduler:
         bulk_assume = not self.plugin_set.permit_plugins
         assume_items: List[tuple] = []
         assume_rows: List[int] = []
+        preempt_rows: List[int] = []          # deferred terminal verdicts
+        preempt_plugins: Dict[int, Set[str]] = {}
         # Python-int views: per-element numpy scalar indexing inside a
         # 10k-iteration loop costs real milliseconds on the commit path.
         chosen_l = chosen[:len(batch)].tolist()
@@ -767,6 +773,16 @@ class Scheduler:
             else:
                 plugins = {self.filter_names[f] for f in range(rejects.shape[0])
                            if rejects[f, i] > 0} or {BATCH_CAPACITY}
+                # PostFilter (DefaultPreemption): defer the terminal
+                # verdict — a batched victim-candidate search may free
+                # capacity by evicting lower-priority pods. Gang members
+                # never preempt (group-level victim math is out of scope;
+                # plugins/preemption.py docstring).
+                if (self._preempt_enabled
+                        and not qpi.pod.spec.pod_group):
+                    preempt_rows.append(i)
+                    preempt_plugins[i] = plugins
+                    continue
                 self._handle_failure(
                     qpi, plugins,
                     f"0/{self.cache.node_count()} nodes are available: "
@@ -776,6 +792,26 @@ class Scheduler:
         if assume_items:
             self.cache.account_bind_bulk(
                 assume_items, req_rows=eb.pf.requests[assume_rows])
+
+        if preempt_rows:
+            # AFTER assume accounting, with the step's post-batch free
+            # (decision.free_after): victim sets must cover the
+            # preemptor's need against capacity as it stands once this
+            # batch's own assignments are debited — sizing them against
+            # the pre-batch snapshot evicts workloads for nothing.
+            won = self._try_preempt(batch, preempt_rows, eb,
+                                    nf._replace(free=np.asarray(
+                                        decision.free_after)),
+                                    af, names)
+            for i in preempt_rows:
+                if i not in won:
+                    self._handle_failure(
+                        batch[i], preempt_plugins[i],
+                        f"0/{self.cache.node_count()} nodes are available: "
+                        f"rejected by {sorted(preempt_plugins[i])}; "
+                        "preemption found no candidates",
+                        retryable=False)
+
         if to_bind:
             # One bulk commit for all permit-free pods: a single store-lock
             # acquisition via bind_pods instead of one executor task + CAS
@@ -847,23 +883,8 @@ class Scheduler:
         stripped (sampling is disabled for gang batches), and sees the
         cluster's free capacity AFTER the sampled assignments
         (decision.free_after is full-size under sampling)."""
-        from ..encode.features import GangFeatures
-
         n_res = len(rows)
-        P2 = bucket_for(n_res, self.config.pod_bucket_min)
-
-        def take(a):
-            a = np.asarray(a)
-            out = np.zeros((P2,) + a.shape[1:], dtype=a.dtype)
-            out[:n_res] = a[rows]
-            return out
-
-        pf2 = type(eb.pf)(*[take(getattr(eb.pf, f))
-                            for f in eb.pf._fields])
-        gang2 = GangFeatures(
-            group=np.full(P2, -1, dtype=np.int32),
-            min_count=np.asarray(eb.gang.min_count))
-        eb2 = eb._replace(pf=pf2, gang=gang2)
+        eb2, P2 = self._slice_eb(eb, rows)
         nf2 = nf._replace(free=np.asarray(decision.free_after))
         d2: Decision = self._step(eb2, nf2, af,
                                   jax.random.fold_in(key, 0x5e5))
@@ -882,6 +903,130 @@ class Scheduler:
             if d2.spread_pre.shape[0]:
                 sp[rows] = sp2[:P2][:n_res]
                 sp[sp_p + rows] = sp2[P2:2 * P2][:n_res]
+
+    def _slice_eb(self, eb, rows):
+        """(eb_sub, P2): row-sliced pod features padded to a fresh bucket,
+        with the batch's group tables (gf/naf) SHARED so group ids stay
+        aligned, and gangs stripped (callers — the sampling residual pass
+        and preemption — exclude gang pods by construction)."""
+        from ..encode.features import GangFeatures
+
+        n = len(rows)
+        P2 = bucket_for(n, self.config.pod_bucket_min)
+
+        def take(a):
+            a = np.asarray(a)
+            out = np.zeros((P2,) + a.shape[1:], dtype=a.dtype)
+            out[:n] = a[rows]
+            return out
+
+        pf2 = type(eb.pf)(*[take(getattr(eb.pf, f))
+                            for f in eb.pf._fields])
+        gang2 = GangFeatures(
+            group=np.full(P2, -1, dtype=np.int32),
+            min_count=np.asarray(eb.gang.min_count))
+        return eb._replace(pf=pf2, gang=gang2), P2
+
+    # ---- preemption (upstream DefaultPreemption PostFilter) -------------
+
+    def _try_preempt(self, batch, rows, eb, nf, af, names) -> Set[int]:
+        """Batched candidate search (ops/preempt.py) + host-side minimal
+        victim commit for terminally-unschedulable pods. Returns the rows
+        successfully queued behind a preemption (victims evicted,
+        nominated_node recorded, preemptor requeued retryably)."""
+        from ..ops.preempt import build_preempt_op
+
+        op = build_preempt_op(self.plugin_set, cfg=self.cache.cfg)
+        eb2, _p2 = self._slice_eb(eb, rows)
+        chosen_d, ok_d, _cnt = op(eb2, nf, af)
+        chosen = np.asarray(chosen_d)
+        ok = np.asarray(ok_d)
+
+        won: Set[int] = set()
+        taken: Set[str] = set()  # victims already evicted this cycle
+        for j, i in enumerate(rows):
+            if not ok[j]:
+                continue
+            qpi = batch[i]
+            node_name = names[int(chosen[j])]
+            if node_name is None:
+                continue
+            # Re-check the preemptor BEFORE any eviction: a pod deleted
+            # (or bound by a competing scheduler) since the step snapshot
+            # must not cost real workloads their capacity (upstream
+            # re-verifies preemptor freshness the same way).
+            try:
+                fresh = self.store.get("Pod", qpi.pod.key)
+            except NotFoundError:
+                self.queue.forget(qpi.pod.key)
+                won.add(i)  # nothing further to do for this row
+                continue
+            if fresh.spec.node_name:
+                won.add(i)  # already bound elsewhere — no verdict needed
+                continue
+            victims = self._select_victims(qpi.pod, node_name, taken)
+            if victims is None:
+                continue  # candidates raced away — terminal verdict stands
+            if not victims:
+                # The node now fits outright (state moved since the
+                # step): no eviction needed, just retry promptly.
+                self._handle_failure(
+                    qpi, {BATCH_CAPACITY},
+                    f"capacity freed on {node_name} since the scheduling "
+                    "attempt; retrying", retryable=True)
+                won.add(i)
+                continue
+            for vk in victims:
+                try:
+                    self.store.delete("Pod", vk)
+                except NotFoundError:
+                    pass
+                taken.add(vk)
+                self.broadcaster.record(
+                    involved=f"Pod:{vk}", reason="Preempted",
+                    message=f"Preempted by {qpi.pod.key} on {node_name}",
+                    type_="Warning",
+                    namespace=vk.split("/", 1)[0])
+            try:
+                fresh.status.nominated_node_name = node_name
+                self.store.update(fresh)
+                qpi.pod = fresh
+            except (NotFoundError, ConflictError):
+                pass
+            self._handle_failure(
+                qpi, {"DefaultPreemption"},
+                f"preempted {len(victims)} lower-priority pod(s) on "
+                f"{node_name}; waiting for the freed capacity",
+                retryable=True)
+            log.info("preemption: %s evicted %d pod(s) on %s",
+                     qpi.pod.key, len(victims), node_name)
+            won.add(i)
+        return won
+
+    def _select_victims(self, pod, node_name: str,
+                        taken: Set[str]) -> Optional[List[str]]:
+        """Minimal victim prefix on ``node_name``: evict lowest-priority
+        pods first (upstream's order) until the node's free vector covers
+        the preemptor's request on every axis. None when the candidates
+        no longer suffice (state raced since the device search)."""
+        from ..encode import features as F
+        from ..state.objects import pod_requests
+
+        free = self.cache.free_of(node_name)
+        if free is None:
+            return None
+        need = F.resources_vector(pod_requests(pod))
+        victims: List[str] = []
+        acc = free
+        for key, req, _prio in self.cache.victims_below(
+                node_name, pod.spec.priority):
+            if key in taken:
+                continue
+            if np.all(acc >= need):
+                break
+            acc = acc + req
+            victims.append(key)
+        return victims if np.all(acc >= need) else None
 
     # Node lifecycle (informer thread) lives on the shared cluster state
     # (engine/clusterstate.py) — one cache, one re-adoption table, all

@@ -1,0 +1,101 @@
+"""Batched preemption candidate search — DefaultPreemption's device math.
+
+Upstream DefaultPreemption walks nodes per preemptor in Go, simulating
+removals pod by pod. The batched formulation evaluates every
+(failed pod, node) pair at once:
+
+  1. non-capacity feasibility: AND of every filter whose rejections
+     eviction cannot cure (``capacity_only=False``) — taints, selectors,
+     affinity, spread, unschedulable, names — over the full node axis;
+  2. victim release: for each failed pod p, the resources that evicting
+     ALL strictly-lower-priority bound pods on node n would free —
+     per-resource segment-sums of the assigned corpus (A-axis), one
+     (Pf, N) matrix per resource axis, never a (Pf, N, R) tensor;
+  3. fits: free + release covers p's request on every axis;
+  4. candidate nodes = (1) ∧ (3); choose the node minimizing the victim
+     COUNT (upstream's fewest-victims criterion; the engine then selects
+     the minimal victim prefix host-side, lowest priority first).
+
+Shapes: Pf = failed-pod bucket (small), N = nodes, A = assigned corpus.
+Cost is O(Pf·A + R·A + R·Pf·N) — linear in the corpus, no P×N plugin
+matrices beyond the (Pf, N) masks.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..encode.features import DEFAULT_ENCODING, EncodingConfig
+from ..plugins.base import PluginSet
+from .topology import group_topology_state
+
+_PREEMPT_CACHE: dict = {}
+
+
+def build_preempt_op(plugin_set: PluginSet, *,
+                     cfg: EncodingConfig = DEFAULT_ENCODING):
+    """Jitted ``op(eb_failed, nf, af) -> (chosen_node (Pf,) i32,
+    ok (Pf,) bool, victim_count (Pf,) f32)``.
+
+    eb_failed is a failed-pod sub-batch (rows beyond the live set padded
+    invalid); nf/af are the SAME full-axis snapshots the scheduling step
+    consumed, so the candidate search sees exactly the state the failure
+    verdict was computed against."""
+    key = (tuple(p.trace_key() for p in plugin_set.filter_plugins), cfg)
+    cached = _PREEMPT_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    hard_filters = [p for p in plugin_set.filter_plugins
+                    if not p.capacity_only]
+    needs_topology = any(p.needs_topology for p in hard_filters)
+    needs_node_affinity = any(p.needs_node_affinity for p in hard_filters)
+
+    def op(eb, nf, af):
+        pf = eb.pf
+        N = nf.valid.shape[0]
+
+        ctx = {"af": af, "gf": eb.gf, "naf": eb.naf}
+        if needs_topology:
+            num_domains = max(N, cfg.domain_buckets)
+            ctx.update(group_topology_state(nf, af, eb.gf, num_domains))
+        if needs_node_affinity:
+            from ..plugins.nodeaffinity import (group_preferred_score,
+                                                group_required_match)
+
+            ctx["na_req_match"] = group_required_match(eb.naf, nf)
+            ctx["na_pref_score"] = group_preferred_score(eb.naf, nf)
+
+        cand = pf.valid[:, None] & nf.valid[None, :]
+        for p in hard_filters:
+            cand = cand & p.filter(pf, nf, ctx)
+
+        # Victim pool per failed pod: assigned pods STRICTLY below its
+        # priority (upstream's victim eligibility).
+        lower = (af.valid[None, :]
+                 & (af.priority[None, :] < pf.priority[:, None]))  # (Pf,A)
+        lower_f = lower.astype(jnp.float32)
+        node_ids = jnp.clip(af.node_row, 0, N - 1)
+
+        def by_node(weights):  # (A,) → (N,) segment sum
+            return jax.ops.segment_sum(weights, node_ids, num_segments=N)
+
+        fits = cand
+        for r in range(pf.requests.shape[1]):  # static small resource loop
+            rel_r = jax.vmap(lambda m: by_node(m * af.requests[:, r])
+                             )(lower_f)                          # (Pf,N)
+            fits = fits & ((nf.free[None, :, r] + rel_r)
+                           >= pf.requests[:, r][:, None])
+        vcnt = jax.vmap(by_node)(lower_f)                        # (Pf,N)
+
+        ok = fits.any(axis=1) & pf.valid
+        score = jnp.where(fits, -vcnt, -jnp.inf)
+        chosen = jnp.argmax(score, axis=1).astype(jnp.int32)
+        chosen = jnp.where(ok, chosen, -1)
+        cnt = jnp.where(ok, jnp.take_along_axis(
+            vcnt, jnp.clip(chosen, 0, N - 1)[:, None], axis=1)[:, 0], 0.0)
+        return chosen, ok, cnt
+
+    jitted = jax.jit(op)
+    _PREEMPT_CACHE[key] = jitted
+    return jitted

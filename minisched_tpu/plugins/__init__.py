@@ -18,3 +18,4 @@ from .volumezone import VolumeZone  # noqa: F401
 from .nodevolumelimits import NodeVolumeLimits  # noqa: F401
 from .podtopologyspread import PodTopologySpread  # noqa: F401
 from .interpodaffinity import InterPodAffinity  # noqa: F401
+from .preemption import DefaultPreemption  # noqa: F401

@@ -45,6 +45,11 @@ class BatchedPlugin:
     # enabled plugin asks)
     needs_topology: bool = False
     needs_node_affinity: bool = False
+    # The filter rejects ONLY on free-resource-vs-request axes (the ones
+    # bind accounting credits back on eviction). Preemption's candidate
+    # math may assume such rejections are curable by evicting victims;
+    # every other filter stays a hard blocker for the preemptor.
+    capacity_only: bool = False
 
     # -- event interest (drives requeue gating, reference
     #    minisched/initialize.go:140-157 + nodenumber.go:66-70)
@@ -102,6 +107,11 @@ class BatchedPlugin:
     def is_permit(self) -> bool:
         return type(self).permit is not BatchedPlugin.permit
 
+    # PostFilter (upstream DefaultPreemption): marker capability — the
+    # engine runs the batched preemption pass for terminally-unschedulable
+    # pods when the profile enables a postfilter plugin.
+    is_postfilter: bool = False
+
 
 class PluginSet:
     """An ordered, weighted set of plugins forming one scheduling profile
@@ -116,6 +126,8 @@ class PluginSet:
         self.filter_plugins = [p for p in self.plugins if p.is_filter]
         self.score_plugins = [p for p in self.plugins if p.is_score]
         self.permit_plugins = [p for p in self.plugins if p.is_permit]
+        self.postfilter_plugins = [p for p in self.plugins
+                                   if p.is_postfilter]
 
     def weight_of(self, plugin: BatchedPlugin) -> float:
         return float(self.weights.get(plugin.name, plugin.default_weight))

@@ -35,6 +35,9 @@ class NodeResourcesFit(BatchedPlugin):
     default), "MostAllocated", or None to disable the score point."""
 
     name = "NodeResourcesFit"
+    # Rejections are purely free-vs-request on the accounted axes —
+    # exactly what evicting victims credits back (preemption-curable).
+    capacity_only = True
 
     def __init__(self, score_strategy: str | None = "LeastAllocated",
                  resources=DEFAULT_SCORED_RESOURCES):

@@ -1,0 +1,37 @@
+"""DefaultPreemption — the PostFilter extension point.
+
+Upstream kube-scheduler's DefaultPreemption plugin: when a pod is
+terminally unschedulable, find nodes where evicting strictly-lower-
+priority pods would make it feasible, evict the cheapest victim set, and
+record status.nominatedNodeName while the preemptor waits for the freed
+capacity. The REFERENCE has no preemption at all (its minisched wraps
+only Filter/Score/Permit — SURVEY §2); this is upstream-semantics
+capability beyond reference parity.
+
+The plugin itself is a marker (``is_postfilter``): the candidate math is
+batched on device (ops/preempt.py — per-(pod, node) victim-release
+feasibility over the assigned-pod corpus) and the engine commits the
+minimal victim set host-side (engine/scheduler.py preemption pass).
+
+Deviations from upstream, documented: no PodDisruptionBudget model (the
+simulator has no PDB objects); gang members do not preempt (coscheduling
+preemption needs group-level victim math); nominatedNodeName is recorded
+for observability but does not reserve the node against other pods — the
+preemptor re-enters the normal queue and races for the freed capacity,
+which the batch scheduler usually resolves in its favor within one cycle.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..state.events import ActionType, ClusterEvent, GVK
+from .base import BatchedPlugin
+
+
+class DefaultPreemption(BatchedPlugin):
+    name = "DefaultPreemption"
+    is_postfilter = True
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        # The preemptor revives when its victims' deletions land.
+        return [ClusterEvent(GVK.POD, ActionType.DELETE)]

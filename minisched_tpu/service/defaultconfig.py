@@ -63,6 +63,7 @@ from ..plugins.nodepreferavoidpods import NodePreferAvoidPods  # noqa: E402
 from ..plugins.nodevolumelimits import (AzureDiskLimits, EBSLimits,  # noqa: E402
                                         GCEPDLimits, NodeVolumeLimits)
 from ..plugins.podtopologyspread import PodTopologySpread  # noqa: E402
+from ..plugins.preemption import DefaultPreemption  # noqa: E402
 from ..plugins.tainttoleration import TaintToleration  # noqa: E402
 from ..plugins.volumebinding import VolumeBinding  # noqa: E402
 from ..plugins.volumerestrictions import VolumeRestrictions  # noqa: E402
@@ -83,6 +84,7 @@ register_plugin("AzureDiskLimits", AzureDiskLimits)
 register_plugin("NodePreferAvoidPods", NodePreferAvoidPods)
 register_plugin("PodTopologySpread", PodTopologySpread)
 register_plugin("InterPodAffinity", InterPodAffinity)
+register_plugin("DefaultPreemption", DefaultPreemption)
 
 
 # The upstream v1beta2 default filter/score plugin lists the reference
@@ -112,6 +114,8 @@ def full_scheduler_profile() -> Profile:
     for name, _w in DEFAULT_SCORE_PLUGINS:
         if name not in plugins:
             plugins.append(name)
+    # Upstream's default PostFilter (preemption) ships enabled.
+    plugins.append("DefaultPreemption")
     return Profile(name="full-scheduler", plugins=plugins,
                    weights={n: w for n, w in DEFAULT_SCORE_PLUGINS})
 

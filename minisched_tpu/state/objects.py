@@ -289,6 +289,10 @@ class PodStatus:
     # the per-pod schedule latency — the BASELINE "p50 schedule-one
     # latency" metric comes straight from these two stamps.
     scheduled_time: float = 0.0
+    # Node this pod preempted victims on (upstream status.nominatedNodeName,
+    # set by the DefaultPreemption postfilter): observability of the
+    # preemption decision while the pod waits for the victims' capacity.
+    nominated_node_name: str = ""
 
 
 @dataclass
