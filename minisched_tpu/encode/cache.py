@@ -599,7 +599,10 @@ class NodeFeatureCache:
         """Bound pods on ``node_name`` with priority STRICTLY below
         ``priority``: (pod_key, accounted request row, priority), sorted
         ascending by priority — the DefaultPreemption victim pool (lowest
-        victims first, upstream's eviction order)."""
+        victims first, upstream's eviction order). GANG members are never
+        offered as victims: evicting one would leave its group running
+        below quorum, violating the all-or-nothing contract (cascading
+        whole-gang eviction is out of scope)."""
         with self._lock:
             i = self._index.get(node_name)
             if i is None:
@@ -613,7 +616,7 @@ class NodeFeatureCache:
             for a in rows.tolist():
                 key = self._a_key[a]
                 entry = self._bound.get(key) if key is not None else None
-                if entry is None:
+                if entry is None or key in self._key_gang:
                     continue
                 out.append((key, entry[1].copy(),
                             int(self._assigned.priority[a])))

@@ -379,6 +379,13 @@ class Scheduler:
         # the marker plugin; terminally-unschedulable pods get a batched
         # victim-candidate search before parking.
         self._preempt_enabled = bool(plugin_set.postfilter_plugins)
+        # Outstanding nominations: pod key → (node name, request vector,
+        # expiry). Freed capacity stays reserved for its preemptor until
+        # it binds, vanishes, or the TTL lapses (a crashed retry must not
+        # pin capacity forever). Guarded by its own lock — the binder
+        # thread clears entries while the scheduling thread debits them.
+        self._nominations: Dict[str, tuple] = {}
+        self._nom_lock = threading.Lock()
         # Which encode-side fail-closed verdicts apply: only constraints
         # this profile's plugin set actually enforces may park a pod.
         self._fail_closed_plugins = {
@@ -595,6 +602,16 @@ class Scheduler:
             known_static=cached[0] if cached else None)
         af = self.cache.snapshot_assigned()
         nf = self._with_device_static(nf, static_v)
+        # Nominated-capacity protection (upstream nominatedNodeName
+        # semantics): capacity a preemption freed is RESERVED for its
+        # preemptor — reservations of pods NOT in this batch are debited
+        # from the snapshot's free so the batch cannot steal them; a
+        # nominee in the batch sees its own reservation as available.
+        if self._nominations:
+            reserved = self._nomination_debits(
+                {q.pod.key for q in batch}, names, nf)
+            if reserved is not None:
+                nf = nf._replace(free=nf.free - reserved)
         t_encode = time.perf_counter()
 
         self._step_counter += 1
@@ -959,9 +976,11 @@ class Scheduler:
                 fresh = self.store.get("Pod", qpi.pod.key)
             except NotFoundError:
                 self.queue.forget(qpi.pod.key)
+                self.drop_nomination(qpi.pod.key)
                 won.add(i)  # nothing further to do for this row
                 continue
             if fresh.spec.node_name:
+                self.drop_nomination(qpi.pod.key)
                 won.add(i)  # already bound elsewhere — no verdict needed
                 continue
             victims = self._select_victims(qpi.pod, node_name, taken)
@@ -981,6 +1000,13 @@ class Scheduler:
                     self.store.delete("Pod", vk)
                 except NotFoundError:
                     pass
+                else:
+                    # Account the eviction NOW (idempotent with the
+                    # informer's later delete-event unbind): a second
+                    # preemptor in this same cycle must see the freed
+                    # capacity, or the nomination debit double-counts
+                    # against stale free and over-evicts.
+                    self.cache.account_unbind(vk)
                 taken.add(vk)
                 self.broadcaster.record(
                     involved=f"Pod:{vk}", reason="Preempted",
@@ -993,6 +1019,15 @@ class Scheduler:
                 qpi.pod = fresh
             except (NotFoundError, ConflictError):
                 pass
+            # Reserve the freed capacity for the preemptor until it
+            # binds or the TTL lapses (upstream nominated-pod handling).
+            from ..encode import features as F2
+            from ..state.objects import pod_requests as _preq
+
+            with self._nom_lock:
+                self._nominations[qpi.pod.key] = (
+                    node_name, F2.resources_vector(_preq(qpi.pod)),
+                    time.monotonic() + self._NOMINATION_TTL_S)
             self._handle_failure(
                 qpi, {"DefaultPreemption"},
                 f"preempted {len(victims)} lower-priority pod(s) on "
@@ -1002,6 +1037,45 @@ class Scheduler:
                      qpi.pod.key, len(victims), node_name)
             won.add(i)
         return won
+
+    _NOMINATION_TTL_S = 60.0
+
+    def drop_nomination(self, pod_key: str) -> None:
+        """Release a preemptor's capacity reservation (pod bound, deleted,
+        or otherwise gone) — the informer's pod-delete path and the
+        failure funnel call this so a vanished preemptor cannot pin the
+        freed capacity for the rest of the TTL."""
+        if self._nominations:
+            with self._nom_lock:
+                self._nominations.pop(pod_key, None)
+
+    def _nomination_debits(self, batch_keys: Set[str], names, nf):
+        """(N,R) capacity reserved by OUT-OF-BATCH nominees (expired and
+        orphaned nominations pruned), or None when nothing to debit."""
+        now = time.monotonic()
+        debits = None
+        with self._nom_lock:
+            drop = []
+            row_of = None
+            for key, (node, req, exp) in self._nominations.items():
+                if exp < now:
+                    drop.append(key)
+                    continue
+                if key in batch_keys:
+                    continue  # the nominee itself sees its reservation
+                if row_of is None:
+                    row_of = {n: j for j, n in enumerate(names)
+                              if n is not None}
+                j = row_of.get(node)
+                if j is None:  # nominated node is gone
+                    drop.append(key)
+                    continue
+                if debits is None:
+                    debits = np.zeros_like(nf.free)
+                debits[j] += req
+            for k in drop:
+                del self._nominations[k]
+        return debits
 
     def _select_victims(self, pod, node_name: str,
                         taken: Set[str]) -> Optional[List[str]]:
@@ -1015,6 +1089,15 @@ class Scheduler:
         free = self.cache.free_of(node_name)
         if free is None:
             return None
+        # Capacity reserved by OTHER pods' nominations on this node is
+        # not available to this preemptor — sizing victims against raw
+        # free would double-book the node (and a node that only "fits"
+        # because of someone else's reservation must still evict).
+        with self._nom_lock:
+            now = time.monotonic()
+            for k, (n2, req2, exp) in self._nominations.items():
+                if n2 == node_name and k != pod.key and exp >= now:
+                    free = free - req2
         need = F.resources_vector(pod_requests(pod))
         victims: List[str] = []
         acc = free
@@ -1260,6 +1343,10 @@ class Scheduler:
         with self._metrics_lock:
             self._metrics["pods_bound"] += len(bound_keys)
         self.queue.forget_many(bound_keys)
+        if self._nominations:  # a bound nominee releases its reservation
+            with self._nom_lock:
+                for k in bound_keys:
+                    self._nominations.pop(k, None)
         ok = keyed
         if len(bound_keys) != len(keyed):  # rare: some skipped mid-flight
             ok = []
@@ -1308,6 +1395,7 @@ class Scheduler:
                 qpi.pod = fresh
         except NotFoundError:
             self.queue.forget(pod.key)
+            self.drop_nomination(pod.key)
             return
         if retryable:
             self.queue.requeue_backoff(qpi)

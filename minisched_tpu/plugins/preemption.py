@@ -14,11 +14,16 @@ feasibility over the assigned-pod corpus) and the engine commits the
 minimal victim set host-side (engine/scheduler.py preemption pass).
 
 Deviations from upstream, documented: no PodDisruptionBudget model (the
-simulator has no PDB objects); gang members do not preempt (coscheduling
-preemption needs group-level victim math); nominatedNodeName is recorded
-for observability but does not reserve the node against other pods — the
-preemptor re-enters the normal queue and races for the freed capacity,
-which the batch scheduler usually resolves in its favor within one cycle.
+simulator has no PDB objects); gang members neither preempt NOR are
+offered as victims (group-level victim math is out of scope — evicting
+one member would strand its gang below quorum); the device-side
+candidate search counts all lower-priority pods (including gang members)
+when sizing feasibility, so a candidate that only works by evicting gang
+pods fails at the host's victim-selection stage and the pod parks
+terminally. nominatedNodeName both records the decision AND reserves the
+freed capacity: the engine debits outstanding nominations from every
+other pod's view of the node until the preemptor binds, vanishes, or a
+TTL lapses (engine/scheduler.py ``_nomination_debits``).
 """
 from __future__ import annotations
 

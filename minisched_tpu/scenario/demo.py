@@ -7,7 +7,11 @@ reference's README scenario, driven through the same user-facing API.
   3. an all-or-nothing gang (pod_group/pod_group_min) that must wait for
      quorum before ANY member binds (BASELINE config 5),
   4. explain mode: per-pod × per-node × per-plugin verdicts published as
-     pod annotations (reference scheduler/plugin/resultstore capability).
+     pod annotations (reference scheduler/plugin/resultstore capability),
+     plus the full-N filter_verdict query beyond the top-k annotation,
+  5. priority preemption: a critical pod evicts lower-priority pods from
+     the only node with its scarce resource, with the freed capacity
+     reserved via nominated_node_name (upstream DefaultPreemption).
 
 Run: ``make demo`` (CPU mesh) or ``python -m minisched_tpu.scenario.demo``.
 """
@@ -76,6 +80,34 @@ def demo_scenario(c: Cluster) -> None:
     some_node = next(iter(verdicts))
     print(f"explain: web-0 filter verdicts on {some_node}: "
           f"{verdicts[some_node]}")
+
+    # Full-N coverage beyond the top-k annotation: any node is queryable.
+    rs = c.service.result_store
+    rs.drain(timeout=10)
+    any_node = next(n.metadata.name for n in c.list_nodes())
+    v = rs.filter_verdict("default/web-0", any_node)
+    print(f"explain: full-N verdict for web-0 on {any_node}: {v}")
+
+    # -- 5. priority preemption ----------------------------------------
+    # the only accelerator node is full of low-priority batch pods; a
+    # critical pod needing all 4 chips must evict them
+    c.create_node("edge-node", cpu=1000, accelerator=4)
+    c.create_objects([obj.Pod(
+        metadata=obj.ObjectMeta(name=f"batch-{i}", namespace="default"),
+        spec=obj.PodSpec(requests={"cpu": 100, "accelerator": 2},
+                         priority=1)) for i in range(2)])
+    for i in range(2):
+        c.wait_for_pod_bound(f"batch-{i}", timeout=20)
+    c.create_objects([obj.Pod(
+        metadata=obj.ObjectMeta(name="critical", namespace="default"),
+        spec=obj.PodSpec(requests={"cpu": 100, "accelerator": 4},
+                         priority=1000))])
+    crit = c.wait_for_pod_bound("critical", timeout=30)
+    evicted = [e.message for e in c.store.list("Event")
+               if e.reason == "Preempted"]
+    print(f"preemption: critical bound to {crit.spec.node_name} "
+          f"(nominated {crit.status.nominated_node_name}); "
+          f"evicted: {evicted}")
     print("demo OK")
 
 
@@ -83,7 +115,8 @@ def main() -> None:
     c = Cluster()
     c.start(profile=Profile(plugins=[
                 "NodeUnschedulable", "NodeResourcesFit",
-                "NodeResourcesLeastAllocated", "PodTopologySpread"]),
+                "NodeResourcesLeastAllocated", "PodTopologySpread",
+                "DefaultPreemption"]),
             config=SchedulerConfig(explain=True, backoff_initial_s=0.05,
                                    backoff_max_s=0.3, max_batch_size=32,
                                    batch_window_s=0.05))

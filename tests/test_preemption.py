@@ -156,3 +156,53 @@ def test_engine_preemption_disabled_without_plugin():
                     if q.metadata.name.startswith("prey")]) == 3
     finally:
         c.shutdown()
+
+
+def test_nominated_capacity_protected_from_racing_lower_priority_pod():
+    """After preemption frees capacity, a LOWER-priority pod arriving
+    before the preemptor's retry must not steal the reservation
+    (upstream nominatedNodeName semantics): the vip binds, the thief
+    pends."""
+    c = _cluster()
+    try:
+        c.create_node("nr-n0", cpu=300)
+        for i in range(3):
+            c.create_pod(f"base{i}", cpu=100, priority=10)
+        for i in range(3):
+            c.wait_for_pod_bound(f"base{i}", timeout=20)
+        c.create_pod("vip2", cpu=100, priority=100)
+        # wait until the preemption actually happened (a victim is gone),
+        # then race a low-priority thief at the freed slot
+        wait_until(lambda: len([p for p in c.list_pods()
+                                if p.metadata.name.startswith("base")]) == 2,
+                   timeout=20)
+        c.create_pod("thief", cpu=100, priority=1)
+        bound = c.wait_for_pod_bound("vip2", timeout=30)
+        assert bound.spec.node_name == "nr-n0"
+        # the thief must still be pending (it must not have taken the
+        # freed slot, and nothing else fits)
+        thief = c.get_pod("thief")
+        assert thief.spec.node_name == "", thief.spec.node_name
+    finally:
+        c.shutdown()
+
+
+def test_gang_members_are_never_victims():
+    """Evicting one gang member would strand its group below quorum —
+    gang pods are excluded from victim pools even when lower priority."""
+    c = _cluster()
+    try:
+        c.create_node("gv-n0", cpu=300)
+        for i in range(3):
+            c.create_pod(f"gmember{i}", cpu=100, priority=1,
+                         pod_group="sacred", pod_group_min=3)
+        for i in range(3):
+            c.wait_for_pod_bound(f"gmember{i}", timeout=20)
+        c.create_pod("bully", cpu=100, priority=100)
+        p = c.wait_for_pod_pending("bully", timeout=20)
+        assert "preemption found no candidates" in p.status.message
+        time.sleep(0.5)
+        assert len([q for q in c.list_pods()
+                    if q.metadata.name.startswith("gmember")]) == 3
+    finally:
+        c.shutdown()
