@@ -23,6 +23,19 @@ N_NODES = 14
 CHURN_S = 4.0
 
 
+def _guarded(errors):
+    """Thread wrapper: capture exceptions into ``errors`` (a raising
+    daemon thread would otherwise vanish silently)."""
+    def deco(fn):
+        def run():
+            try:
+                fn()
+            except Exception as e:  # pragma: no cover - failure path
+                errors.append(e)
+        return run
+    return deco
+
+
 def test_chaos_churn_preserves_invariants():
     c = Cluster()
     try:
@@ -37,14 +50,7 @@ def test_chaos_churn_preserves_invariants():
         rng_create, rng_delete = (np.random.default_rng(s) for s in (0, 1))
         stop = threading.Event()
         errors = []
-
-        def guard(fn):
-            def run():
-                try:
-                    fn()
-                except Exception as e:  # pragma: no cover - failure path
-                    errors.append(e)
-            return run
+        guard = _guarded(errors)
 
         def creator():
             for i in range(N_PODS):
@@ -191,5 +197,121 @@ def test_chaos_bind_delete_race_cannot_leak_capacity():
             c.create_pod(f"bd-final-{i}", cpu=100)
         for i in range(10):
             c.wait_for_pod_bound(f"bd-final-{i}", timeout=30)
+    finally:
+        c.shutdown()
+
+
+def test_chaos_preemption_under_churn():
+    """Preemption racing pod/node churn: high-priority pods keep evicting
+    while victims and nodes come and go. At quiescence no node is
+    over-committed, no gang member was ever evicted, and every
+    high-priority pod is settled."""
+    c = Cluster()
+    try:
+        c.start(profile=Profile(plugins=["NodeUnschedulable",
+                                         "NodeResourcesFit",
+                                         "NodeResourcesLeastAllocated",
+                                         "DefaultPreemption"]),
+                config=SchedulerConfig(backoff_initial_s=0.05,
+                                       backoff_max_s=0.2,
+                                       max_batch_size=64,
+                                       batch_window_s=0.0),
+                with_pv_controller=False)
+        for i in range(6):
+            c.create_node(f"pc-n{i}", cpu=400)
+        # a protected gang occupies one node's worth of capacity
+        for i in range(4):
+            c.create_pod(f"pc-g{i}", cpu=100, priority=1,
+                         pod_group="holy", pod_group_min=4)
+        for i in range(4):
+            c.wait_for_pod_bound(f"pc-g{i}", timeout=20)
+
+        stop = threading.Event()
+        errors = []
+        guard = _guarded(errors)
+
+        rng = np.random.default_rng(7)
+
+        def low_creator():
+            for i in range(60):
+                if stop.is_set():
+                    return
+                try:
+                    c.create_pod(f"pc-low{i}", cpu=100,
+                                 priority=int(rng.integers(1, 5)))
+                except AlreadyExistsError:
+                    pass
+                time.sleep(0.02)
+
+        def vip_creator():
+            for i in range(25):
+                if stop.is_set():
+                    return
+                try:
+                    c.create_pod(f"pc-vip{i}", cpu=100, priority=100)
+                except AlreadyExistsError:
+                    pass
+                time.sleep(0.05)
+
+        def node_churner():
+            epoch = 0
+            while not stop.is_set():
+                epoch += 1
+                name = f"pc-extra{epoch % 3}"
+                try:
+                    c.create_node(name, cpu=400)
+                except AlreadyExistsError:
+                    try:
+                        c.delete_node(name)
+                    except NotFoundError:
+                        pass
+                time.sleep(0.1)
+
+        threads = [threading.Thread(target=guard(f), daemon=True)
+                   for f in (low_creator, vip_creator, node_churner)]
+        for t in threads:
+            t.start()
+        time.sleep(3.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors, errors
+
+        deadline = time.monotonic() + 45
+        while time.monotonic() < deadline:
+            pods = c.store.list("Pod")
+            unsettled = [p for p in pods
+                         if not p.spec.node_name
+                         and not p.status.unschedulable_plugins]
+            if not unsettled:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(
+                f"cluster never quiesced: {[p.key for p in unsettled][:8]}")
+
+        pods = c.store.list("Pod")
+        nodes = {n.metadata.name: n for n in c.store.list("Node")}
+        # gang intact: preemption never evicted a member
+        gang = [p for p in pods if p.metadata.name.startswith("pc-g")]
+        assert len(gang) == 4 and all(p.spec.node_name for p in gang)
+        # no surviving node over-committed on any axis
+        used = {}
+        for p in pods:
+            if p.spec.node_name and p.spec.node_name in nodes:
+                u = used.setdefault(p.spec.node_name, {})
+                for k, v in p.spec.requests.items():
+                    u[k] = u.get(k, 0.0) + v
+        for name, u in used.items():
+            alloc = nodes[name].status.allocatable
+            for k, v in u.items():
+                assert v <= alloc.get(k, 0) + 1e-6, (
+                    f"node {name} over-committed on {k}")
+        # every vip either bound or pending with attribution (a vip may
+        # pend if churn deleted capacity faster than preemption freed it)
+        vips = [p for p in pods if p.metadata.name.startswith("pc-vip")]
+        assert vips and all(
+            p.spec.node_name or p.status.unschedulable_plugins
+            for p in vips)
     finally:
         c.shutdown()
