@@ -1,0 +1,108 @@
+"""Native runtime primitives (CPython C extensions, built on demand).
+
+The reference's whole runtime is compiled Go; the rebuild keeps the
+ACCELERATOR path in JAX/XLA/Pallas and implements its hottest HOST-path
+primitive natively: ``fastclone`` (fastclone.c), the structural clone
+behind the store's copy-on-read/ingestion isolation
+(state/objects.py::deepcopy_obj) — ~300k recursive clone calls per
+10k-pod submission on the create→bound critical path.
+
+Build model: no pybind11, no pip — plain CPython C API compiled with the
+system ``g++``/``cc`` into a per-Python-version cache next to this file
+on first import (one ``-O2 -shared -fPIC`` invocation, ~1 s). Any
+failure (no toolchain, sandboxed FS, exotic platform) degrades silently
+to the pure-Python implementation; ``load()`` returns None then and
+callers keep their fallback. MINISCHED_NO_NATIVE=1 disables the native
+path outright (tests use it to pin the fallback).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+
+log = logging.getLogger(__name__)
+
+_mod = None
+_tried = False
+_load_lock = threading.Lock()
+
+
+def _build_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "_build")
+
+
+def _so_path() -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_build_dir(), f"_fastclone{suffix}")
+
+
+def _compile() -> bool:
+    src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fastclone.c")
+    out = _so_path()
+    os.makedirs(_build_dir(), exist_ok=True)
+    include = sysconfig.get_paths()["include"]
+    for cc in ("g++", "cc", "gcc"):
+        try:
+            r = subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", f"-I{include}",
+                 src, "-o", out],
+                capture_output=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if r.returncode == 0:
+            return True
+        log.debug("fastclone build with %s failed: %s", cc,
+                  r.stderr.decode(errors="replace")[:400])
+    return False
+
+
+def load():
+    """The _fastclone module, building it on first use; None when native
+    acceleration is unavailable (callers must keep a fallback).
+    Thread-safe: concurrent first callers serialize on the build instead
+    of one observing a half-initialized state and pinning the process to
+    the fallback."""
+    with _load_lock:
+        return _load_locked()
+
+
+def _load_locked():
+    global _mod, _tried
+    if _mod is not None or _tried:
+        return _mod
+    _tried = True
+    if os.environ.get("MINISCHED_NO_NATIVE"):
+        return None
+    try:
+        so, src = _so_path(), os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "fastclone.c")
+        # Rebuild when the source is newer: _build/ is a per-machine
+        # cache — a stale binary must not silently outlive a source fix.
+        stale = (not os.path.exists(so)
+                 or os.path.getmtime(so) < os.path.getmtime(src))
+        if stale and not _compile():
+            return None
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "minisched_tpu.native._fastclone", _so_path())
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        # smoke-test before trusting it on the hot path
+        if mod.clone({"a": [1, "b", (2.0, None)]}) != \
+                {"a": [1, "b", (2.0, None)]}:
+            return None
+        _mod = mod
+        sys.modules.setdefault("minisched_tpu.native._fastclone", mod)
+        log.info("fastclone native extension loaded")
+    except Exception:
+        log.debug("fastclone unavailable; using the Python clone",
+                  exc_info=True)
+        _mod = None
+    return _mod

@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import itertools
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -459,6 +460,32 @@ def shallow_evolve(o: Any, **kw: Any) -> Any:
     return new
 
 
+# Every dataclass the API object tree can contain — the clone/codec type
+# universe (native fastclone registers exactly these). DERIVED from this
+# module's definitions so a newly added dataclass can never be silently
+# missing (a miss would demote every clone to the Python slow path).
+_WIRE_TYPES = tuple(
+    v for v in list(globals().values())
+    if isinstance(v, type) and dataclasses.is_dataclass(v))
+
+_native_clone = None
+_native_lock = threading.Lock()
+
+
+def _try_native_clone():
+    """Load the C fastclone (minisched_tpu/native) and register every
+    dataclass type the object tree uses. Returns the clone callable or
+    None (pure-Python fallback)."""
+    from ..native import load
+
+    mod = load()
+    if mod is None:
+        return None
+    for cls in _WIRE_TYPES:
+        mod.register(cls)
+    return mod.clone
+
+
 def deepcopy_obj(obj):
     """Structural deep copy of the pure-dataclass API objects.
 
@@ -466,9 +493,25 @@ def deepcopy_obj(obj):
     create/update/get behind a copy, so this sits on the ingestion hot
     path (50k-node clusters = 10^5 copies before the first scheduling
     cycle). Rebuilding via __dict__ skips deepcopy's memo machinery and
-    __init__, ~10x cheaper on these object trees; anything unexpected
+    __init__, ~10x cheaper on these object trees. When the native
+    fastclone extension is available (minisched_tpu/native — the same
+    recursion in C; the reference's runtime is compiled Go throughout),
+    the walk drops the per-node interpreter overhead too; an unexpected
+    type raises there and falls back to the Python walk, which itself
     falls back to copy.deepcopy."""
-    return _clone(obj)
+    global _native_clone
+    fn = _native_clone
+    if fn is None:
+        # One resolver at a time: a racing thread observing the load()'s
+        # in-progress state must not cache the slow fallback forever.
+        with _native_lock:
+            if _native_clone is None:
+                _native_clone = _try_native_clone() or _clone
+            fn = _native_clone
+    try:
+        return fn(obj)
+    except TypeError:
+        return _clone(obj)  # unregistered type: the Python walk handles it
 
 
 def to_dict(obj: Any) -> Dict[str, Any]:

@@ -292,9 +292,13 @@ def test_chaos_preemption_under_churn():
 
         pods = c.store.list("Pod")
         nodes = {n.metadata.name: n for n in c.store.list("Node")}
-        # gang intact: preemption never evicted a member
+        # gang intact AND never evicted: no Preempted event may name a
+        # member (final bindings alone would miss an evict-then-reschedule)
         gang = [p for p in pods if p.metadata.name.startswith("pc-g")]
         assert len(gang) == 4 and all(p.spec.node_name for p in gang)
+        assert not any(
+            e.involved_object.startswith("Pod:default/pc-g")
+            for e in c.store.list("Event") if e.reason == "Preempted")
         # no surviving node over-committed on any axis
         used = {}
         for p in pods:
