@@ -206,3 +206,43 @@ def test_gang_members_are_never_victims():
                     if q.metadata.name.startswith("gmember")]) == 3
     finally:
         c.shutdown()
+
+
+def test_preemption_composes_with_node_sampling():
+    """Sampling and preemption in one engine: the sampled step's residual
+    pass renders the terminal verdict, and preemption then still fires
+    off it — a high-priority pod evicts on a full cluster that sampling
+    alone would only have parked."""
+    c = Cluster()
+    c.start(profile=Profile(plugins=["NodeUnschedulable",
+                                     "NodeResourcesFit",
+                                     "NodeResourcesLeastAllocated",
+                                     "DefaultPreemption"]),
+            config=SchedulerConfig(backoff_initial_s=0.05,
+                                   backoff_max_s=0.2,
+                                   max_batch_size=128, batch_window_s=0.05,
+                                   percentage_of_nodes_to_score=10,
+                                   min_sample_nodes=16))
+    try:
+        # 64 nodes, every one exactly full of low-priority pods
+        c.create_objects([obj.Node(
+            metadata=obj.ObjectMeta(name=f"sp-n{i:03d}"),
+            status=obj.NodeStatus(allocatable={"cpu": 200, "pods": 110}))
+            for i in range(64)])
+        fillers = [obj.Pod(
+            metadata=obj.ObjectMeta(name=f"sp-f{i}", namespace="default"),
+            spec=obj.PodSpec(requests={"cpu": 100}, priority=1))
+            for i in range(128)]
+        c.create_objects(fillers)
+        assert wait_until(
+            lambda: all(p.spec.node_name for p in c.list_pods()),
+            timeout=60)
+        c.create_pod("sp-vip", cpu=200, priority=100)  # needs 2 evictions
+        bound = c.wait_for_pod_bound("sp-vip", timeout=30)
+        assert bound.status.nominated_node_name == bound.spec.node_name
+        # event recording is async: wait for the sink to drain
+        assert wait_until(lambda: len(
+            [e for e in c.store.list("Event")
+             if e.reason == "Preempted"]) == 2, timeout=10)
+    finally:
+        c.shutdown()
