@@ -242,8 +242,10 @@ def run_child() -> None:
     # ---- pallas kernel shape matrix (hardware) -------------------------
     # One headline shape is not evidence: sweep the kernel's tiling edges
     # — N at one lane tile, P tiny/odd (sub-POD_BLOCK padding), P > N,
-    # square, large-N — against the scan on REAL hardware, and record the
-    # off-tile shapes the kernel must refuse (scan fallback by contract).
+    # square, large-N, and the formerly-unsupported off-lane-tile N
+    # (16x64, 256x127, 256x129 — now lane-padded inside the wrapper, so
+    # every shape must report "equal") — against the scan on REAL
+    # hardware.
     try:
         if (in_budget("pallas_shapes")
                 and jax.default_backend() == "tpu"):
@@ -260,7 +262,10 @@ def run_child() -> None:
                            (256, 127), (256, 129)):
                 label = f"{sp}x{sn}"
                 if not pallas_supported(sn):
-                    table[label] = "unsupported(scan fallback)"
+                    # Every swept shape must be kernel-eligible since the
+                    # wrapper lane-pads; a refusal here is a regression.
+                    table[label] = "UNSUPPORTED(regression)"
+                    detail["error"] = "pallas_supported refused a shape"
                     continue
                 scores = rng.random((sp, sn)).astype(np.float32) * 100
                 scores[rng.random((sp, sn)) < 0.2] = float(NEG)

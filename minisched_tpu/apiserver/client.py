@@ -37,17 +37,22 @@ class RemoteStore:
                     req, timeout=timeout or self.timeout) as resp:
                 return json.loads(resp.read())
         except urllib.error.HTTPError as e:
+            reason = None
             try:
-                msg = json.loads(e.read()).get("error", str(e))
+                payload = json.loads(e.read())
+                msg = payload.get("error", str(e))
+                reason = payload.get("reason")
             except Exception:
                 msg = str(e)
             if e.code == 404:
                 raise NotFoundError(msg) from None
             if e.code == 409:
-                # the server folds AlreadyExists and Conflict into 409;
-                # disambiguate on the message like client-go does on
-                # status reasons
-                if "already exists" in msg:
+                # the server folds AlreadyExists and Conflict into 409
+                # and disambiguates with a structured ``reason`` field
+                # (the client-go status-reason analog); the message
+                # sniff is only a fallback for pre-reason servers.
+                if reason == "AlreadyExists" or (
+                        reason is None and "already exists" in msg):
                     raise AlreadyExistsError(msg) from None
                 raise ConflictError(msg) from None
             if e.code == 410:
@@ -76,10 +81,19 @@ class RemoteStore:
         out = self._call("GET", f"/apis/{kind}")
         return [obj.from_dict(kind, d) for d in out["items"]]
 
-    def update(self, o: Any) -> Any:
+    def update(self, o: Any, *, check_version: bool = False) -> Any:
+        """Mirrors ClusterStore.update's signature: unconditional
+        last-writer-wins by default (the drop-in contract), optimistic
+        concurrency when ``check_version`` — the body's resourceVersion
+        asserts "I am updating THAT revision" and a stale one raises
+        ConflictError. The unconditional path zeroes the rv on the wire
+        (the server treats rv != 0 as a version assertion)."""
         kind = obj.kind_of(o)
+        body = obj.to_dict(o)
+        if not check_version:
+            body["metadata"]["resource_version"] = 0
         return obj.from_dict(kind, self._call(
-            "PUT", f"/apis/{kind}/{o.key}", obj.to_dict(o)))
+            "PUT", f"/apis/{kind}/{o.key}", body))
 
     def delete(self, kind: str, key: str) -> None:
         self._call("DELETE", f"/apis/{kind}/{key}")

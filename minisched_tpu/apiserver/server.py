@@ -87,8 +87,15 @@ def _make_handler(store: ClusterStore):
             self.end_headers()
             self.wfile.write(body)
 
-        def _error(self, code: int, msg: str) -> None:
-            self._send(code, {"error": msg})
+        def _error(self, code: int, msg: str,
+                   reason: str | None = None) -> None:
+            # ``reason`` is the client-go status-reason analog: clients
+            # switch on it structurally instead of sniffing message text
+            # (409 folds AlreadyExists and Conflict into one code).
+            body = {"error": msg}
+            if reason is not None:
+                body["reason"] = reason
+            self._send(code, body)
 
         def _body(self):
             n = int(self.headers.get("Content-Length", "0"))
@@ -109,11 +116,11 @@ def _make_handler(store: ClusterStore):
             try:
                 fn()
             except NotFoundError as e:
-                self._error(404, str(e))
+                self._error(404, str(e), reason="NotFound")
             except AlreadyExistsError as e:
-                self._error(409, str(e))
+                self._error(409, str(e), reason="AlreadyExists")
             except ConflictError as e:
-                self._error(409, str(e))
+                self._error(409, str(e), reason="Conflict")
             except (KeyError, TypeError, ValueError) as e:
                 self._error(400, f"{type(e).__name__}: {e}")
             except Exception as e:  # pragma: no cover - server must answer
@@ -198,7 +205,13 @@ def _make_handler(store: ClusterStore):
                     return self._error(
                         400, f"body names {o.key!r} but URL targets "
                              f"{key!r}")
-                updated = store.update(o)
+                # Optimistic concurrency over the wire (the k8s update
+                # contract): a body carrying a resourceVersion asserts
+                # "I am updating THAT revision" — stale → 409 Conflict.
+                # rv 0 means the client didn't read first; take the
+                # unconditional path the in-process store also offers.
+                updated = store.update(
+                    o, check_version=o.metadata.resource_version != 0)
                 self._send(200, obj.to_dict(updated))
             self._guard(run)
 

@@ -83,12 +83,13 @@ class ResultStore:
         # FIFO-bounded by ``full_n_retain`` rows when given, else by a
         # BYTE budget (a fixed row count would silently cost ~0.8 GB at
         # 50k nodes; the budget scales the row cap with N). Rows are
-        # views into one shared per-batch (P,N) array — memory frees when
-        # a batch's last key evicts, so worst-case residency is about one
-        # extra batch array beyond the budget.
+        # COPIES out of the per-batch (P,N) array (views would pin the
+        # whole batch array while the budget counts only the row), so
+        # real residency tracks the budgeted bytes.
         self._filter_bits: Dict[str, tuple] = {}
         self._full_n_retain = full_n_retain
         self._full_n_budget = full_n_budget_bytes
+        self._warned_overflow = False
         self._retry_initial = retry_initial_s
         self._retry_steps = retry_steps
         self._worker: Optional[threading.Thread] = None
@@ -189,17 +190,37 @@ class ResultStore:
 
         # Full-N failing-plugin bitmask: one uint32 per (pod, node) —
         # loop over F keeps the working set at (P,N), never (F,P,N)x4.
+        # Only the first 32 filters fit the mask; the fnames stored with
+        # each row are truncated to the RECORDED plugins so filter_verdict
+        # never fabricates PASSED for an unrecorded overflow plugin.
         fail_bits = col_of = None
+        bit_fnames = fnames[:32]
+        if len(fnames) > 32 and not self._warned_overflow:
+            self._warned_overflow = True  # once — fires per batch otherwise
+            log.warning(
+                "full-N filter bitmask records only the first 32 of %d "
+                "filter plugins; verdicts for the rest come from the "
+                "top-k annotations only", len(fnames))
+        retain = self._full_n_retain
+        first_kept = 0
         if filter_masks.shape[0]:
-            fail_bits = np.zeros(filter_masks.shape[1:], dtype=np.uint32)
-            for f in range(min(filter_masks.shape[0], 32)):
-                fail_bits |= (~filter_masks[f]).astype(np.uint32) << f
+            if retain is None:
+                row_bytes = max(1, filter_masks.shape[2] * 4)
+                retain = max(64, self._full_n_budget // row_bytes)
+            # Rows below ``first_kept`` would be FIFO-evicted before this
+            # batch finishes inserting — don't even compute their
+            # bitmasks (at 10k pods x 50k nodes with the default budget
+            # ~93% of the OR-loop's work would be discarded otherwise).
+            # Slice by len(pods), NOT filter_masks.shape[1]: the mask's P
+            # axis is the padded bucket, and the pad rows beyond the live
+            # pods need no bits either.
+            first_kept = max(0, len(pods) - retain)
+            kept = filter_masks[:, first_kept:len(pods), :]
+            fail_bits = np.zeros(kept.shape[1:], dtype=np.uint32)
+            for f in range(len(bit_fnames)):
+                fail_bits |= (~kept[f]).astype(np.uint32) << f
             col_of = {n: j for j, n in enumerate(names) if n is not None}
 
-        retain = self._full_n_retain
-        if retain is None and fail_bits is not None:
-            row_bytes = max(1, fail_bits.shape[1] * 4)
-            retain = max(64, self._full_n_budget // row_bytes)
         keys = []
         with self._lock:
             for i, pod in enumerate(pods):
@@ -207,8 +228,14 @@ class ResultStore:
                 keys.append(pod.key)
                 if fail_bits is not None:
                     self._filter_bits.pop(pod.key, None)  # refresh order
-                    self._filter_bits[pod.key] = (col_of, fail_bits[i],
-                                                  fnames)
+                    if i >= first_kept:
+                        # .copy(): a retained VIEW would pin the whole
+                        # kept-rows array while the byte budget only
+                        # accounts the row — copies keep real residency
+                        # equal to the budgeted bytes.
+                        self._filter_bits[pod.key] = (
+                            col_of, fail_bits[i - first_kept].copy(),
+                            bit_fnames)
             if fail_bits is not None:
                 while len(self._filter_bits) > retain:
                     self._filter_bits.pop(next(iter(self._filter_bits)))

@@ -47,18 +47,31 @@ def _compile() -> bool:
     out = _so_path()
     os.makedirs(_build_dir(), exist_ok=True)
     include = sysconfig.get_paths()["include"]
+    # Compile to a per-pid temp and os.replace() into place: concurrent
+    # builders (pytest-xdist, two services on one host) each produce a
+    # complete file and atomically win/lose the rename — no reader can
+    # ever dlopen a half-written .so.
+    tmp = f"{out}.tmp.{os.getpid()}"
     for cc in ("g++", "cc", "gcc"):
         try:
             r = subprocess.run(
                 [cc, "-O2", "-shared", "-fPIC", f"-I{include}",
-                 src, "-o", out],
+                 src, "-o", tmp],
                 capture_output=True, timeout=120)
         except (OSError, subprocess.TimeoutExpired):
             continue
         if r.returncode == 0:
-            return True
+            try:
+                os.replace(tmp, out)
+                return True
+            except OSError:
+                break
         log.debug("fastclone build with %s failed: %s", cc,
                   r.stderr.decode(errors="replace")[:400])
+    try:
+        os.unlink(tmp)
+    except OSError:
+        pass
     return False
 
 
@@ -79,30 +92,61 @@ def _load_locked():
     _tried = True
     if os.environ.get("MINISCHED_NO_NATIVE"):
         return None
-    try:
-        so, src = _so_path(), os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "fastclone.c")
-        # Rebuild when the source is newer: _build/ is a per-machine
-        # cache — a stale binary must not silently outlive a source fix.
-        stale = (not os.path.exists(so)
-                 or os.path.getmtime(so) < os.path.getmtime(src))
-        if stale and not _compile():
-            return None
-        import importlib.util
+    so, src = _so_path(), os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "fastclone.c")
+    # Two attempts: a cached .so that fails to load or smoke-test (e.g.
+    # written by a pre-atomic-rename build, or ABI drift) is rebuilt
+    # once and retried instead of latching this process to the Python
+    # fallback — silently losing the native speedup for its lifetime.
+    for attempt in range(2):
+        try:
+            # Rebuild when the source is newer: _build/ is a per-machine
+            # cache — a stale binary must not silently outlive a source
+            # fix. Second attempt always rebuilds.
+            stale = (attempt > 0 or not os.path.exists(so)
+                     or os.path.getmtime(so) < os.path.getmtime(src))
+            if stale and not _compile():
+                return None
+            import importlib.util
 
-        spec = importlib.util.spec_from_file_location(
-            "minisched_tpu.native._fastclone", _so_path())
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        # smoke-test before trusting it on the hot path
-        if mod.clone({"a": [1, "b", (2.0, None)]}) != \
-                {"a": [1, "b", (2.0, None)]}:
-            return None
-        _mod = mod
-        sys.modules.setdefault("minisched_tpu.native._fastclone", mod)
-        log.info("fastclone native extension loaded")
-    except Exception:
-        log.debug("fastclone unavailable; using the Python clone",
-                  exc_info=True)
-        _mod = None
+            # The retry must load under the CANONICAL module name (the
+            # PyInit_ symbol is derived from it) but from a DISTINCT
+            # path: CPython's extension cache is keyed by (name, path)
+            # and retains successfully-initialized modules, so a module
+            # that passed init but failed the smoke test would be
+            # re-yielded from cache if the path were reused.
+            load_path = so
+            if attempt:
+                import shutil
+
+                # Per-pid copy (two processes retrying concurrently must
+                # not dlopen each other's half-written copy) with a
+                # recognized extension suffix (.so) — the loader is
+                # picked by suffix and an unknown one yields a None
+                # spec. Removed after exec_module below.
+                load_path = f"{so}.r{attempt}.{os.getpid()}.so"
+                shutil.copy2(so, load_path)
+            try:
+                spec = importlib.util.spec_from_file_location(
+                    "minisched_tpu.native._fastclone", load_path)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+            finally:
+                if load_path != so:
+                    try:
+                        os.unlink(load_path)
+                    except OSError:
+                        pass
+            # smoke-test before trusting it on the hot path
+            if mod.clone({"a": [1, "b", (2.0, None)]}) != \
+                    {"a": [1, "b", (2.0, None)]}:
+                raise RuntimeError("fastclone smoke-test mismatch")
+            _mod = mod
+            sys.modules.setdefault("minisched_tpu.native._fastclone", mod)
+            log.info("fastclone native extension loaded")
+            return _mod
+        except Exception:
+            log.debug("fastclone load attempt %d failed", attempt,
+                      exc_info=True)
+            _mod = None
     return _mod

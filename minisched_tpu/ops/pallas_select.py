@@ -44,7 +44,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .select import AssignResult, seed_from_key, tie_noise_from_cols
 
-POD_BLOCK = 8  # pods per grid step == the f32 sublane tile height
+POD_BLOCK = 8   # pods per grid step == the f32 sublane tile height
+LANE_TILE = 128  # node-axis pad quantum == the f32 lane tile width
 
 
 def _kernel(scores_ref, req_ref, free0_ref, seed_ref,
@@ -120,28 +121,37 @@ def greedy_assign_pallas(scores: jnp.ndarray, requests: jnp.ndarray,
         scores = jnp.pad(scores, ((0, pad), (0, 0)),
                          constant_values=-3.0e38)  # == select.NEG in f32
         requests = jnp.pad(requests, ((0, pad), (0, 0)))
-    P_pad = scores.shape[0]
-    seed = seed_from_key(key).reshape(1, 1)
     free_t = free0.T            # (R, N): resources on sublanes, nodes on lanes
+    if N % LANE_TILE:
+        # Pad the node axis to the lane tile so EVERY node count runs the
+        # kernel (off-tile N used to fall back to the 2-11x slower scan).
+        # Pad columns score NEG → never in the argmax tie set, never
+        # chosen, never debit capacity; chosen indices stay < N.
+        pad_n = LANE_TILE - N % LANE_TILE
+        scores = jnp.pad(scores, ((0, 0), (0, pad_n)),
+                         constant_values=-3.0e38)
+        free_t = jnp.pad(free_t, ((0, 0), (0, pad_n)))
+    P_pad, N_pad = scores.shape
+    seed = seed_from_key(key).reshape(1, 1)
 
     chosen, ok, free_t_after = pl.pallas_call(
         _kernel,
         grid=(P_pad // POD_BLOCK,),
         in_specs=[
-            pl.BlockSpec((POD_BLOCK, N), lambda g: (g, 0)),  # score rows
+            pl.BlockSpec((POD_BLOCK, N_pad), lambda g: (g, 0)),  # scores
             pl.BlockSpec((POD_BLOCK, R), lambda g: (g, 0)),  # request rows
-            pl.BlockSpec((R, N), lambda g: (0, 0)),          # initial free
+            pl.BlockSpec((R, N_pad), lambda g: (0, 0)),      # initial free
             pl.BlockSpec(memory_space=pltpu.SMEM),           # tie-break seed
         ],
         out_specs=[
             pl.BlockSpec((POD_BLOCK, 1), lambda g: (g, 0)),
             pl.BlockSpec((POD_BLOCK, 1), lambda g: (g, 0)),
-            pl.BlockSpec((R, N), lambda g: (0, 0)),  # free accumulator
+            pl.BlockSpec((R, N_pad), lambda g: (0, 0)),  # free accumulator
         ],
         out_shape=[
             jax.ShapeDtypeStruct((P_pad, 1), jnp.int32),
             jax.ShapeDtypeStruct((P_pad, 1), jnp.int32),
-            jax.ShapeDtypeStruct((R, N), jnp.float32),
+            jax.ShapeDtypeStruct((R, N_pad), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             # scores block (double-buffered) + free0 + the free accumulator
@@ -154,12 +164,14 @@ def greedy_assign_pallas(scores: jnp.ndarray, requests: jnp.ndarray,
 
     return AssignResult(chosen=chosen[:P, 0],
                         assigned=ok[:P, 0].astype(bool),
-                        free_after=free_t_after.T)
+                        free_after=free_t_after[:, :N].T)
 
 
 def pallas_supported(n_nodes: int, backend: str | None = None) -> bool:
-    """The kernel needs a lane-tiled node axis; used at trace time (the
-    pod axis self-pads to POD_BLOCK)."""
+    """True when the kernel path is available — any node count on TPU:
+    both axes self-pad inside greedy_assign_pallas (pods to POD_BLOCK,
+    nodes to LANE_TILE with NEG-scored pad columns), so off-tile shapes
+    no longer fall back to the lax.scan path."""
     if backend is None:
         backend = jax.default_backend()
-    return backend == "tpu" and n_nodes % 128 == 0
+    return backend == "tpu" and n_nodes >= 1

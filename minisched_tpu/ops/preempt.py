@@ -4,9 +4,14 @@ Upstream DefaultPreemption walks nodes per preemptor in Go, simulating
 removals pod by pod. The batched formulation evaluates every
 (failed pod, node) pair at once:
 
-  1. non-capacity feasibility: AND of every filter whose rejections
-     eviction cannot cure (``capacity_only=False``) — taints, selectors,
-     affinity, spread, unschedulable, names — over the full node axis;
+  1. non-capacity feasibility: AND of every filter marked
+     ``capacity_only=False`` — taints, selectors, affinity, spread,
+     unschedulable, names — over the full node axis. Deviation from
+     upstream (documented in plugins/preemption.py): upstream's
+     per-victim-set simulation can cure anti-affinity/spread rejections
+     by evicting the repelling pod; here ALL non-capacity rejections are
+     intentionally treated as incurable, trading that curability for the
+     one-shot batched cost model below;
   2. victim release: for each failed pod p, the resources that evicting
      ALL strictly-lower-priority bound pods on node n would free —
      per-resource segment-sums of the assigned corpus (A-axis), one

@@ -13,9 +13,17 @@ batched on device (ops/preempt.py — per-(pod, node) victim-release
 feasibility over the assigned-pod corpus) and the engine commits the
 minimal victim set host-side (engine/scheduler.py preemption pass).
 
-Deviations from upstream, documented: no PodDisruptionBudget model (the
-simulator has no PDB objects); gang members neither preempt NOR are
-offered as victims (group-level victim math is out of scope — evicting
+Deviations from upstream, documented: every non-``capacity_only`` filter
+rejection is treated as INCURABLE by eviction — upstream DefaultPreemption
+simulates victim removal and therefore CAN cure inter-pod anti-affinity
+and topology-spread rejections by evicting the repelling/crowding pod,
+so a pod that upstream would place via such an eviction parks terminally
+here. This is intentional: curing those filters requires re-running the
+topology/affinity group state per candidate victim set (a per-(pod,node)
+combinatorial simulation the batched one-shot candidate search trades
+away for O(Pf·A + R·Pf·N) cost — ops/preempt.py). No PodDisruptionBudget
+model (the simulator has no PDB objects); gang members neither preempt
+NOR are offered as victims (group-level victim math is out of scope — evicting
 one member would strand its gang below quorum); the device-side
 candidate search counts all lower-priority pods (including gang members)
 when sizing feasibility, so a candidate that only works by evicting gang

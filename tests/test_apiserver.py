@@ -138,3 +138,45 @@ def test_put_key_body_mismatch_rejected(remote):
         rs._call("PUT", "/apis/Pod/default/pm-a",
                  __import__("minisched_tpu.state.objects",
                             fromlist=["to_dict"]).to_dict(a))
+
+
+def test_409_reason_field_disambiguates(remote):
+    """The server labels 409s with a structured reason (the client-go
+    status-reason analog) and the client switches on it — message text
+    that happens to contain 'already exists' cannot misclassify a
+    Conflict (ADVICE r3)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    store, rs = remote
+    rs.create(_node("r-n0"))
+
+    def raw_reason(method, path, body):
+        req = urllib.request.Request(
+            rs.address + path, data=json.dumps(body).encode(),
+            method=method, headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=5)
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+            return json.loads(e.read()).get("reason")
+        raise AssertionError("expected 409")
+
+    n = obj.to_dict(store.get("Node", "r-n0"))
+    assert raw_reason("POST", "/apis/Node", n) == "AlreadyExists"
+    stale = dict(n)
+    stale["metadata"] = dict(n["metadata"],
+                             resource_version=1, name="r-n0")
+    # bump the real object so the PUT is stale
+    cur = store.get("Node", "r-n0")
+    store.update(cur)
+    assert raw_reason("PUT", "/apis/Node/r-n0", stale) == "Conflict"
+    # and the typed client maps them onto distinct exception types
+    with pytest.raises(AlreadyExistsError):
+        rs.create(_node("r-n0"))
+    with pytest.raises(ConflictError):
+        rs.update(obj.from_dict("Node", stale), check_version=True)
+    # default update keeps the in-process drop-in contract:
+    # unconditional last-writer-wins even with a stale local copy
+    rs.update(obj.from_dict("Node", stale))

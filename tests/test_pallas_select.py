@@ -74,6 +74,28 @@ def test_gang_assign_with_pallas_inner():
 
 
 def test_pallas_supported_gate():
-    assert not pallas_supported(127, backend="tpu")   # not lane-tiled
+    # Any node count is kernel-eligible on TPU — the wrapper lane-pads
+    # off-tile N (VERDICT r3 #4 closed the 16x64/256x127 scan holes).
+    assert pallas_supported(127, backend="tpu")
+    assert pallas_supported(64, backend="tpu")
     assert pallas_supported(50176, backend="tpu")
     assert not pallas_supported(50176, backend="cpu")
+
+
+@pytest.mark.parametrize("P,N", [(16, 64), (256, 127), (256, 129), (3, 1)])
+def test_kernel_matches_scan_off_tile_shapes(P, N):
+    """The previously 'unsupported(scan fallback)' off-lane-tile shapes
+    now run the kernel via internal node-axis padding and stay
+    bit-identical to the scan — pad columns must never be chosen, never
+    debit capacity, and free_after must slice back to (N, R)."""
+    key = jax.random.PRNGKey(11)
+    scores, req, free0 = _case(key, P=P, N=N)
+    ref = greedy_assign(scores, req, free0, key)
+    out = greedy_assign_pallas(scores, req, free0, key, interpret=True)
+    assert np.array_equal(np.asarray(ref.chosen), np.asarray(out.chosen))
+    assert np.array_equal(np.asarray(ref.assigned),
+                          np.asarray(out.assigned))
+    assert np.allclose(np.asarray(ref.free_after),
+                       np.asarray(out.free_after))
+    assert out.free_after.shape == free0.shape
+    assert int(np.asarray(out.chosen).max()) < N

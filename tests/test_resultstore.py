@@ -271,3 +271,83 @@ def test_filter_verdict_retention_bound_and_delete():
     assert rs.filter_verdict("default/rb5", "na") is not None
     rs.delete_data("default/rb5")
     assert rs.filter_verdict("default/rb5", "na") is None
+
+
+def test_filter_bitmask_truncates_fnames_beyond_32():
+    """A profile with >32 filter plugins records only the first 32 in the
+    uint32 bitmask; filter_verdict must enumerate ONLY the recorded
+    plugins rather than fabricating PASSED for the overflow ones
+    (ADVICE r3: (b >> f) & 1 is always 0 for f >= 32)."""
+    class _Named:
+        def __init__(self, name):
+            self.name = name
+
+    class _ManyFilters:
+        def __init__(self, n):
+            self.filter_plugins = [_Named(f"F{i:02d}") for i in range(n)]
+            self.score_plugins = []
+
+        def weight_of(self, p):
+            return 1.0
+
+    store = ClusterStore()
+    p = store.create(_pod("trunc0"))
+    rs = ResultStore(store, flush=False)
+    names = ["na", "nb"]
+    F = 35
+    fm = np.ones((F, 1, 2), dtype=bool)
+    fm[33, 0, 1] = False  # a failure only an overflow plugin sees
+    raw = np.zeros((0, 1, 2), dtype=np.float32)
+    rs.record_batch([p], names, FakeDecision(fm, raw, raw), _ManyFilters(F))
+    v = rs.filter_verdict(p.key, "nb")
+    assert v is not None and len(v) == 32
+    assert "F33" not in v and "F34" not in v  # not fabricated as PASSED
+    assert all(k == f"F{i:02d}" for i, k in enumerate(sorted(v)))
+
+
+def test_filter_bitmask_rows_are_copies_not_views():
+    """Retained verdict rows must not alias the shared per-batch (P,N)
+    array (ADVICE r3: a view pins the whole ~2 GB batch array while the
+    byte budget counts only the row)."""
+    from minisched_tpu.explain.resultstore import FAILED
+
+    store = ClusterStore()
+    p = store.create(_pod("copy0"))
+    plugin_set = PluginSet([NodeUnschedulable()], {})
+    rs = ResultStore(store, flush=False)
+    names = ["na", "nb"]
+    fm = np.ones((1, 1, 2), dtype=bool)
+    fm[0, 0, 1] = False
+    raw = np.zeros((0, 1, 2), dtype=np.float32)
+    dec = FakeDecision(fm, raw, raw)
+    rs.record_batch([p], names, dec, plugin_set)
+    row = rs._filter_bits[p.key][1]
+    assert row.base is None, "retained row aliases the batch array"
+    assert rs.filter_verdict(p.key, "nb") == {"NodeUnschedulable": FAILED}
+
+
+def test_filter_bitmask_retention_skips_doomed_rows():
+    """When one batch exceeds the retain cap, only the last `retain` rows
+    are inserted (the rest would be FIFO-evicted immediately) — and a
+    pod's STALE verdict from an earlier attempt is still dropped."""
+    store = ClusterStore()
+    plugin_set = PluginSet([NodeUnschedulable()], {})
+    rs = ResultStore(store, flush=False, full_n_retain=3)
+    names = ["na"]
+    pods = [store.create(_pod(f"doom{i}")) for i in range(8)]
+    # first: give pod 0 a verdict so we can observe it go stale
+    fm1 = np.zeros((1, 1, 1), dtype=bool)
+    raw1 = np.zeros((0, 1, 1), dtype=np.float32)
+    rs.record_batch([pods[0]], names, FakeDecision(fm1, raw1, raw1),
+                    plugin_set)
+    assert rs.filter_verdict(pods[0].key, "na") is not None
+    # then: one batch of 8 > retain=3 — only doom5..7 survive, and
+    # doom0's old row must NOT survive either (it was re-attempted)
+    fm = np.zeros((1, 8, 1), dtype=bool)
+    raw = np.zeros((0, 8, 1), dtype=np.float32)
+    rs.record_batch(pods, names, FakeDecision(fm, raw, raw), plugin_set)
+    assert len(rs._filter_bits) == 3
+    for i in range(5):
+        assert rs.filter_verdict(pods[i].key, "na") is None
+    for i in range(5, 8):
+        assert rs.filter_verdict(pods[i].key, "na") is not None
