@@ -54,6 +54,23 @@ class SchedulerConfig:
     # minFeasibleNodesToFind), and only bother sampling at all when the
     # cluster is at least twice this size.
     min_sample_nodes: int = 256
+    # Multi-chip: a SINGLE-PROCESS jax.sharding.Mesh
+    # (parallel.mesh.make_mesh) to run the scheduling step over. The
+    # (P,N) plugin matrices partition over the ("pod", "node") axes and
+    # XLA inserts the collectives (parallel/sharded.py); ``assignment``
+    # selects the sharded assignment stage — "greedy" (the default) is
+    # the exact chunked-gather scan (bit-identical to single-device),
+    # "auction" the faster priority-tiered auction. None = single
+    # device. (A multi-PROCESS hybrid mesh would leave the engine's
+    # decision readback non-addressable from one host; the store/
+    # informer stack is single-process — multi-host serving composes by
+    # sharding CLUSTERS across schedulers, not one engine across hosts.)
+    # Node-axis sampling is DISABLED on a mesh: the sampled gather would
+    # have to re-partition a data-dependent node subset every batch,
+    # defeating the static shardings — and the mesh exists for clusters
+    # big enough that the node axis is worth splitting, where each
+    # shard's slice is already the sample-sized problem.
+    mesh: object = None
 
 
 def config_from_env() -> SchedulerConfig:
@@ -66,6 +83,23 @@ def config_from_env() -> SchedulerConfig:
             raise EmptyEnvError(f"env {name} is empty")
         return v
 
+    mesh = None
+    mesh_devices = int(os.environ.get("MINISCHED_MESH_DEVICES", "0"))
+    if mesh_devices:
+        # Lazy jax import: the env tier must stay importable without
+        # touching the backend (tests hard-pin JAX_PLATFORMS first).
+        import jax
+
+        from .parallel.mesh import make_mesh
+
+        devs = jax.devices()
+        if len(devs) < mesh_devices:
+            # Silently truncating would run a smaller layout than the
+            # operator asked for — fail the misconfiguration loudly.
+            raise ValueError(
+                f"MINISCHED_MESH_DEVICES={mesh_devices} but only "
+                f"{len(devs)} devices are visible")
+        mesh = make_mesh(devs[:mesh_devices])
     return SchedulerConfig(
         max_batch_size=int(_req("MINISCHED_MAX_BATCH", "1024")),
         explain=_req("MINISCHED_EXPLAIN", "0") == "1",
@@ -75,4 +109,5 @@ def config_from_env() -> SchedulerConfig:
         platform=os.environ.get("MINISCHED_PLATFORM", ""),
         percentage_of_nodes_to_score=int(
             _req("MINISCHED_PCT_NODES_TO_SCORE", "0")),
+        mesh=mesh,
     )

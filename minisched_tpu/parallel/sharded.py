@@ -27,14 +27,22 @@ def build_sharded_step(plugin_set: PluginSet, mesh, eb_template, nf_template,
     shaped EncodedBatch / NodeFeatures / AssignedPodFeatures). Returns
     ``step(eb, nf, af, key) -> Decision`` with inputs auto-partitioned.
 
-    The DEFAULT assignment on a mesh is the priority-tiered auction: its
+    This builder's DEFAULT assignment is the priority-tiered auction: its
     bidding rounds are dense (P,N)/(P,) math that partitions under plain
     GSPMD with one collective per round, and the priority bands preserve
     the greedy contract's cross-priority faithfulness (ops/auction.py) —
     the chunked-gather greedy scan (``assignment="greedy"``) is exact
     sequential semantics but pays a cross-shard argmax chain measured at
-    ~5x single-device; keep it for bit-exact parity runs.
+    ~5x single-device; keep it for bit-exact parity runs. (The PRODUCT
+    engine passes SchedulerConfig.assignment, whose default is "greedy"
+    — exactness first; opt into "auction" for throughput.)
     """
+    if assignment not in ("greedy", "auction"):
+        # Mirror build_step's validation — an unknown value must not
+        # silently select the greedy branch below.
+        raise ValueError(
+            f"unknown assignment strategy {assignment!r}; "
+            "expected 'greedy' or 'auction'")
     eb_sh, nf_sh, af_sh = feature_shardings(mesh, eb_template, nf_template,
                                             af_template)
     key_sh = NamedSharding(mesh, P())  # replicated PRNG key
