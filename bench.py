@@ -78,6 +78,7 @@ def run_child() -> None:
 
         detail["platform"] = jax.devices()[0].platform
         detail["device"] = str(jax.devices()[0])
+        detail["device_kind"] = getattr(jax.devices()[0], "device_kind", "")
         detail["host_cores"] = os.cpu_count()
     except Exception as e:  # backend init failed → no numbers possible
         detail["error"] = f"backend init: {type(e).__name__}: {e}"[:500]
@@ -164,6 +165,13 @@ def run_child() -> None:
         "device_s": round(min(times["device"]), 4),
         "commit_s": round(min(times["commit"]), 4),
     })
+    # Machine-efficiency accounting (round-3 verdict #3): wall-clock
+    # alone can't show whether the step is near what the chip could do.
+    # device_s includes the decision readback; the model covers the
+    # 2 filters + 2 scorers of the headline profile.
+    detail["roofline_headline"] = roofline(
+        min(times["device"]), p_pad, n_pad, 2, 2,
+        detail.get("device_kind", ""))
     # Anchor: the Go loop takes >60 s for this config (BASELINE.json) —
     # i.e. ≤ n_pods/60 pods/s. vs_baseline = speedup over that anchor.
     result["value"] = round(raw_pps, 1)
@@ -211,6 +219,56 @@ def run_child() -> None:
         jax.block_until_ready(dw.chosen)
         return round(time.perf_counter() - t0, 4), dw
 
+    # ---- config-4 THROUGH THE ENGINE: the north star on the profile ----
+    # that's actually hard (round-3 verdict #1). Topology spread +
+    # inter-pod affinity + fit + preemption enabled, 50k x 10k, burst AND
+    # sustained streaming — create→bound through the real product path.
+    try:
+        from bench_workload import C4_PLUGINS, make_c4_workload
+
+        if in_budget("engine_c4_sched_s"):
+            c4e_nodes, c4e_pods = make_c4_workload(n_nodes, n_pods)
+            detail.update(engine_bench(
+                n_nodes, n_pods, c4e_nodes, c4e_pods, C4_PLUGINS,
+                prefix="engine_c4"))
+            # The verdict's named key: p50 create→bound on the c4 profile.
+            if "engine_c4_p50_latency_s" in detail:
+                detail["engine_c4_p50"] = detail["engine_c4_p50_latency_s"]
+        if in_budget("stream_c4_pods_per_sec"):
+            c4e_nodes, c4e_pods = make_c4_workload(n_nodes, n_pods)
+            detail.update(engine_bench(
+                n_nodes, n_pods, c4e_nodes, c4e_pods, C4_PLUGINS,
+                batch_size=max(256, n_pods // 5), prefix="stream_c4",
+                window_s=0.25))
+    except Exception as e:
+        detail["engine_c4_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(result))
+    sys.stdout.flush()
+
+    # ---- skew-constrained streaming: the convergence worst case --------
+    # DoNotSchedule max_skew=1 over 16 zones — every placement is gated
+    # by the intra-batch skew arbitration. With the exact sequential-
+    # semantics arbitration (Decision.spread_cdom tables) a burst drains
+    # in a handful of cycles; the pre-batch-min approximation admitted
+    # only ~(domains x max_skew) pods per cycle (round-3 verdict weak #1
+    # measured 9,968/10,000 revocations in one cycle). Reported:
+    # cycles-to-drain (batches), failed attempts (revocations), and
+    # effective pods/s for this worst case.
+    try:
+        if in_budget("skew_stream_pods_per_sec"):
+            sk_nodes, sk_pods = make_c4_workload(
+                n_nodes, n_pods, max_skew=1, hard=True)
+            detail.update(engine_bench(
+                n_nodes, n_pods, sk_nodes, sk_pods, C4_PLUGINS,
+                batch_size=max(256, n_pods // 5), prefix="skew_stream",
+                window_s=0.25, backoff_s=0.05))
+            if "skew_stream_batches" in detail:
+                detail["skew_stream_cycles"] = detail["skew_stream_batches"]
+    except Exception as e:
+        detail["skew_stream_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(result))
+    sys.stdout.flush()
+
     # ---- pallas vs scan: equality + timings (TPU only) -----------------
     try:
         from minisched_tpu.ops.pallas_select import pallas_supported
@@ -234,6 +292,32 @@ def run_child() -> None:
             detail["pallas_equals_scan"] = bool(eq)
             if not eq:
                 detail["error"] = "pallas kernel disagrees with lax.scan"
+            # Kernel-only roofline: time the kernel STANDALONE at the
+            # headline shape (synthetic inputs) — its traffic floor is
+            # one streaming read of the (P,N) score matrix (the free
+            # matrix stays resident in VMEM), ~22 flops/elem for the
+            # R-row fits reduce + argmax + masked update.
+            from minisched_tpu.ops.pallas_select import greedy_assign_pallas
+            from minisched_tpu.ops.select import NEG as _NEG
+
+            import jax.numpy as jnp
+            rng_k = np.random.default_rng(3)
+            ks = rng_k.random((p_pad, n_pad)).astype(np.float32) * 100
+            ks[rng_k.random((p_pad, n_pad)) < 0.2] = float(_NEG)
+            kreq = (rng_k.integers(1, 4, (p_pad, 9)) * 100).astype(
+                np.float32)
+            kfree = (rng_k.integers(1, 5, (n_pad, 9)) * 250).astype(
+                np.float32)
+            kargs = (jnp.array(ks), jnp.array(kreq), jnp.array(kfree),
+                     jax.random.PRNGKey(9))
+            kfn = jax.jit(greedy_assign_pallas)
+            jax.block_until_ready(kfn(*kargs).chosen)
+            t0 = time.perf_counter()
+            jax.block_until_ready(kfn(*kargs).chosen)
+            detail["pallas_kernel_s"] = round(time.perf_counter() - t0, 4)
+            detail["roofline_pallas_kernel"] = roofline(
+                detail["pallas_kernel_s"], p_pad, n_pad, 0, 0,
+                detail.get("device_kind", ""), flops_per_elem=22.0)
         else:
             detail["pallas_equals_scan"] = "skipped (platform/tiling)"
     except Exception as e:
@@ -421,6 +505,12 @@ def run_child() -> None:
             detail["config4_device_s"], d4 = warm_and_time(
                 step4, eb4, nf4, af4, key)
             detail["config4_scheduled"] = int(np.asarray(d4.assigned).sum())
+            # 4 filter points + 2 score points + ~6 extra (P,N) passes of
+            # topology/affinity slot math (chunked, so HBM-resident).
+            detail["roofline_config4"] = roofline(
+                detail["config4_device_s"], _pad_to(c4_pods),
+                _pad_to(c4_nodes), 4, 2,
+                detail.get("device_kind", ""), extra_passes=6)
     except Exception as e:
         detail["config4_error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(result))
@@ -466,13 +556,173 @@ def run_child() -> None:
                     100.0 * (s1 - s0) / s0, 1)
     except Exception as e:
         detail["explain_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(result))
+    sys.stdout.flush()
+
+    # ---- preemption candidate search at scale (verdict #7a) ------------
+    # 50k nodes, >=100k-pod assigned corpus, a 256-row failed bucket
+    # through the batched candidate op with the topology-heavy filter set
+    # — the steady-state serving shape ops/preempt.py's cost model
+    # (O(Pf·A + R·A + R·Pf·N)) describes but round 3 never measured.
+    try:
+        if in_budget("preempt_device_s"):
+            from minisched_tpu.ops.preempt import build_preempt_op
+            from minisched_tpu.plugins import (InterPodAffinity,
+                                               NodeResourcesFit,
+                                               NodeUnschedulable,
+                                               PluginSet, PodTopologySpread)
+            from minisched_tpu.state.objects import (ObjectMeta, Pod,
+                                                     PodSpec)
+
+            a_n = int(os.environ.get("MINISCHED_BENCH_PREEMPT_CORPUS",
+                                     str(max(100_000, 2 * n_pods))))
+            pcache = NodeFeatureCache(capacity=max(64, n_nodes))
+            pnodes = make_nodes()
+            for node in pnodes:
+                pcache.upsert_node(node)
+            t0 = time.perf_counter()
+            for i in range(a_n):
+                vp = Pod(metadata=ObjectMeta(name=f"vic-{i}",
+                                             namespace="bench",
+                                             labels={"app": "bench"}),
+                         spec=PodSpec(requests={"cpu": 250.0},
+                                      priority=0))
+                pcache.account_bind(
+                    vp, node_name=pnodes[i % n_nodes].metadata.name)
+            detail["preempt_corpus_build_s"] = round(
+                time.perf_counter() - t0, 2)
+            detail["preempt_corpus"] = a_n
+            ps_p = PluginSet([NodeUnschedulable(),
+                              NodeResourcesFit(score_strategy=None),
+                              PodTopologySpread(), InterPodAffinity()])
+            hi = [Pod(metadata=ObjectMeta(name=f"hi-{i}",
+                                          namespace="bench"),
+                      spec=PodSpec(requests={"cpu": 4000.0},
+                                   priority=100))
+                  for i in range(256)]
+            ebp = encode_pods(hi, 256, registry=pcache.registry)
+            nfp, _ = pcache.snapshot(pad=n_pad)
+            afp = pcache.snapshot_assigned()
+            pop = build_preempt_op(ps_p)
+            chosen_p, ok_p, _cnt = pop(ebp, nfp, afp)
+            jax.block_until_ready(chosen_p)
+            t0 = time.perf_counter()
+            chosen_p, ok_p, _cnt = pop(ebp, nfp, afp)
+            jax.block_until_ready(chosen_p)
+            detail["preempt_device_s"] = round(time.perf_counter() - t0, 4)
+            detail["preempt_candidates_found"] = int(np.asarray(ok_p).sum())
+    except Exception as e:
+        detail["preempt_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(result))
+    sys.stdout.flush()
+
+    # ---- full-N filter-bitmask retention at headline scale (#7b) -------
+    # Host-side: ingest one 10k x 50k explain batch into the ResultStore
+    # and measure what the byte-budgeted verdict retention ACTUALLY holds
+    # (rows are copies since round 4 — residency must track the budget,
+    # not the 2 GB batch array).
+    try:
+        if in_budget("explain_bitmask_mb"):
+            from minisched_tpu.explain.resultstore import ResultStore
+
+            class _K:
+                __slots__ = ("key",)
+
+                def __init__(self, k):
+                    self.key = k
+
+            class _PS:
+                filter_plugins = [type("F", (), {"name": "NodeResourcesFit"})()]
+                score_plugins = []
+
+                @staticmethod
+                def weight_of(p):
+                    return 1.0
+
+            class _D:
+                pass
+
+            bm_p, bm_n = n_pods, n_pad
+            d_fake = _D()
+            rng_b = np.random.default_rng(1)
+            d_fake.filter_masks = rng_b.random((1, bm_p, bm_n)) > 0.1
+            d_fake.raw_scores = np.zeros((0, bm_p, bm_n), np.float32)
+            d_fake.norm_scores = d_fake.raw_scores
+            names_b = [f"n{i}" for i in range(bm_n)]
+            # top_k = N skips the per-pod annotation top-k selection (a
+            # (P,N) float64 argpartition — not what this phase measures);
+            # only the bitmask ingest path runs.
+            rs_b = ResultStore(ClusterStore(), flush=False, top_k=bm_n)
+            t0 = time.perf_counter()
+            rs_b.record_batch([_K(f"bench/bm{i}") for i in range(bm_p)],
+                              names_b, d_fake, _PS())
+            detail["explain_bitmask_ingest_s"] = round(
+                time.perf_counter() - t0, 3)
+            held = sum(v[1].nbytes for v in rs_b._filter_bits.values())
+            detail["explain_bitmask_mb"] = round(held / 1e6, 1)
+            detail["explain_bitmask_budget_mb"] = round(
+                rs_b._full_n_budget / 1e6, 1)
+            detail["explain_bitmask_rows"] = len(rs_b._filter_bits)
+            if held > rs_b._full_n_budget * 1.05:
+                detail["error"] = "bitmask retention exceeded its budget"
+    except Exception as e:
+        detail["bitmask_error"] = f"{type(e).__name__}: {e}"[:300]
 
     emit_and_exit(0)
 
 
+_HBM_PEAK_GBPS = {
+    # chip generation → HBM bandwidth (GB/s); conservative public numbers
+    "v4": 1228.0, "v5 lite": 819.0, "v5e": 819.0, "v5p": 2765.0,
+    "v6 lite": 1640.0, "v6e": 1640.0,
+}
+
+
+def roofline(seconds: float, p: int, n: int, n_filters: int,
+             n_scorers: int, device_kind: str, *, extra_passes: int = 0,
+             flops_per_elem: float = 6.0) -> dict:
+    """Coarse, EXPLICIT machine-efficiency accounting for one step.
+
+    Traffic model (f32, fusion-optimistic): each filter materializes one
+    (P,N) pass (write+read of the running mask is fused; feature reads
+    are O(N·R), negligible), each scorer two passes (score + normalize
+    reduction re-read), the weighted total one write, and the assignment
+    stage one streaming read of the score matrix — plus ``extra_passes``
+    for profile-specific (P,N) temps (topology/affinity slot math).
+    FLOPs ≈ flops_per_elem per (P,N) element per plugin pass (compares,
+    selects, multiply-adds — VPU work; the step has no MXU matmuls, so
+    the relevant peak is HBM bandwidth, not TensorCore FLOPs). The point
+    is auditability (which regime each phase is in, and whether a change
+    regressed arithmetic intensity), not cycle accuracy."""
+    passes = n_filters + 2 * n_scorers + 2 + extra_passes
+    if n_filters == 0 and n_scorers == 0:
+        # kernel-only accounting: one streaming read of the score matrix
+        passes = 1 + extra_passes
+    bytes_moved = passes * p * n * 4.0
+    flops = passes * p * n * flops_per_elem
+    kind = (device_kind or "").lower()
+    peak = next((v for k, v in _HBM_PEAK_GBPS.items() if k in kind), 819.0)
+    gbps = bytes_moved / max(seconds, 1e-9) / 1e9
+    return {
+        "model": f"{passes} fused (PxN) f32 passes "
+                 f"({n_filters}F+2x{n_scorers}S+2+{extra_passes} extra), "
+                 f"{flops_per_elem} flops/elem",
+        "bytes_gb": round(bytes_moved / 1e9, 2),
+        "achieved_gbps": round(gbps, 1),
+        "pct_hbm_peak": round(100.0 * gbps / peak, 1),
+        "hbm_peak_gbps": peak,
+        "achieved_gflops": round(flops / max(seconds, 1e-9) / 1e9, 1),
+        "regime": ("bandwidth-bound (VPU elementwise; no MXU matmuls)"
+                   if gbps / peak > 0.25 else
+                   "latency/overhead-bound (under 25% of HBM peak — "
+                   "dispatch, scan sequentialization, or readback "
+                   "dominates)"),
+    }
+
+
 def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                  batch_size=None, prefix="engine", window_s=15.0,
-                 explain=False) -> dict:
+                 explain=False, backoff_s=None) -> dict:
     """Schedule the same workload through the REAL engine: store + informers
     + queue + batched cycle + bulk bind; throughput from scheduler.metrics().
     Two passes — the first eats XLA compiles for the engine's pad buckets,
@@ -505,10 +755,14 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
         # instead of fragmenting into partial batches that each pay a
         # fresh XLA compile. Gathering terminates exactly when all
         # n_pods are queued; the window is only the stall-tolerant cap.
-        sched = svc.start_scheduler(
-            profile, SchedulerConfig(max_batch_size=batch_size,
-                                     batch_window_s=window_s,
-                                     explain=explain))
+        cfg = SchedulerConfig(max_batch_size=batch_size,
+                              batch_window_s=window_s, explain=explain)
+        if backoff_s is not None:
+            # Skew-style convergence workloads retry revoked pods across
+            # cycles; the reference's 1 s initial backoff would dominate
+            # the measured drain time rather than the scheduler.
+            cfg.backoff_initial_s = backoff_s
+        sched = svc.start_scheduler(profile, cfg)
         # Cold-start boundary: the scheduler has synced the 50k-node
         # cluster; everything after this point is steady-state serving.
         # engine_total_s includes this bootstrap, engine_sched_s (the
@@ -576,6 +830,10 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                 f"{prefix}_commit_s": round(m["commit_s_total"], 4),
                 f"{prefix}_gap_s": round(m.get("gap_s_total", 0.0), 4),
                 f"{prefix}_bind_conflicts": int(m["bind_conflicts"]),
+                # revocations + terminal failures summed over cycles —
+                # the skew-convergence diagnostic (how much work the
+                # arbitration threw back)
+                f"{prefix}_failed_attempts": int(m["pods_failed"]),
             }
     return out
 

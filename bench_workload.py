@@ -45,6 +45,44 @@ BENCH_PLUGINS = ["NodeUnschedulable", "NodeResourcesFit",
                  "NodeResourcesLeastAllocated",
                  "NodeResourcesBalancedAllocation"]
 
+# BASELINE config 4's plugin set, as a PRODUCT profile: topology spread +
+# inter-pod affinity (the masked-psum group/domain math) over the fit
+# filter, with upstream's default PostFilter (preemption) enabled.
+C4_PLUGINS = ["NodeUnschedulable", "NodeResourcesFit", "PodTopologySpread",
+              "InterPodAffinity", "DefaultPreemption"]
+
+
+def make_c4_workload(n_nodes: int, n_pods: int, seed: int = 0, *,
+                     max_skew: int = 8, hard: bool = False):
+    """(make_nodes, make_pods) for the config-4 profile: the standard
+    node mix (16 zones), pods labeled app=bench with a topology-spread
+    constraint over zone (DoNotSchedule when ``hard`` — the
+    skew-convergence worst case — else ScheduleAnyway) and preferred
+    inter-pod affinity on every other pod."""
+    from minisched_tpu.state.objects import (
+        Affinity, LabelSelector, PodAffinity, PodAffinityTerm,
+        TopologySpreadConstraint, WeightedPodAffinityTerm)
+
+    make_nodes, base_pods = make_workload(n_nodes, n_pods, seed)
+    sel = LabelSelector(match_labels={"app": "bench"})
+    when = "DoNotSchedule" if hard else "ScheduleAnyway"
+
+    def make_pods():
+        pods = base_pods()
+        for i, p in enumerate(pods):
+            p.metadata.labels["app"] = "bench"
+            p.spec.topology_spread_constraints = [TopologySpreadConstraint(
+                max_skew=max_skew, topology_key="zone",
+                when_unsatisfiable=when, label_selector=sel)]
+            if i % 2 == 0:
+                p.spec.affinity = Affinity(pod_affinity=PodAffinity(
+                    preferred=[WeightedPodAffinityTerm(
+                        weight=10, term=PodAffinityTerm(
+                            label_selector=sel, topology_key="zone"))]))
+        return pods
+
+    return make_nodes, make_pods
+
 
 def bench_plugin_set():
     """The benchmark profile as a constructed PluginSet. Fit scores

@@ -71,6 +71,15 @@ class SchedulerConfig:
     # big enough that the node axis is worth splitting, where each
     # shard's slice is already the sample-sized problem.
     mesh: object = None
+    # Intra-cycle repair for topology-revoked pods: after the batch's
+    # survivors are assumed, re-run the step on the revoked rows against
+    # the refreshed counts up to this many times before falling back to
+    # the requeue/backoff path. A skew-constrained burst (hard
+    # DoNotSchedule under contention) otherwise drains at roughly
+    # (domains x max_skew) pods per QUEUE cycle, each paying backoff
+    # latency; repair iterations drain the same tranches within one
+    # cycle. 0 disables.
+    spread_repair_iters: int = 8
 
 
 def config_from_env() -> SchedulerConfig:

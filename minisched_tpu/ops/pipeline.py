@@ -56,6 +56,15 @@ class Decision(NamedTuple):
     spread_dom: jnp.ndarray       # (P,G) i32 chosen node's domain id (-1
     #                               = node lacks the key / unassigned)
     spread_min: jnp.ndarray       # (G,) f32 pre-batch min over domains
+    # Full per-domain tables for EXACT host-side skew arbitration (the
+    # engine replays admissions sequentially against a running count
+    # table + running min, matching what a sequential scheduler would
+    # see): fetched on demand only when the batch carries hard
+    # DoNotSchedule constraints. (G,D)/(G,D) when topology runs, else
+    # zero-size:
+    spread_cdom: jnp.ndarray      # (G,D) f32 pre-batch matching count per
+    #                               domain
+    spread_dexist: jnp.ndarray    # (G,D) bool domain exists on some node
     # explain mode only (else zero-size placeholders):
     filter_masks: jnp.ndarray     # (F,P,N) bool per-plugin pass mask
     raw_scores: jnp.ndarray       # (S,P,N) f32 pre-normalize
@@ -335,11 +344,15 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             spread_dom = jnp.where(
                 live, nf.topo_domains[gkey][:, safe_row].T, -1)  # (P,G)
             spread_min = ctx["min_count"]                        # (G,)
+            spread_cdom = ctx["counts_dom"]                      # (G,D)
+            spread_dexist = ctx["dom_exists"]                    # (G,D)
         else:
             G = eb.gf.valid.shape[0]
             spread_pre = jnp.zeros((0, G), dtype=jnp.float32)
             spread_dom = jnp.full((0, G), -1, dtype=jnp.int32)
             spread_min = jnp.zeros((0,), dtype=jnp.float32)
+            spread_cdom = jnp.zeros((0, 0), dtype=jnp.float32)
+            spread_dexist = jnp.zeros((0, 0), dtype=bool)
 
         if explain:
             filter_stack = (jnp.stack(masks) if masks
@@ -378,6 +391,8 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             spread_pre=spread_pre,
             spread_min=spread_min,
             spread_dom=spread_dom,
+            spread_cdom=spread_cdom,
+            spread_dexist=spread_dexist,
             filter_masks=filter_stack,
             raw_scores=raw_stack,
             norm_scores=norm_stack,

@@ -43,9 +43,10 @@ def build_preempt_op(plugin_set: PluginSet, *,
     ok (Pf,) bool, victim_count (Pf,) f32)``.
 
     eb_failed is a failed-pod sub-batch (rows beyond the live set padded
-    invalid); nf/af are the SAME full-axis snapshots the scheduling step
-    consumed, so the candidate search sees exactly the state the failure
-    verdict was computed against."""
+    invalid); nf/af are full-axis snapshots — the engine passes a FRESH
+    post-assume snapshot (survivors and in-cycle repairs debited), and
+    the host victim-selection stage re-validates every candidate against
+    live cache state before any eviction."""
     key = (tuple(p.trace_key() for p in plugin_set.filter_plugins), cfg)
     cached = _PREEMPT_CACHE.get(key)
     if cached is not None:

@@ -77,6 +77,8 @@ def build_sharded_step(plugin_set: PluginSet, mesh, eb_template, nf_template,
         spread_pre=NamedSharding(mesh, P(POD_AXIS, None)),
         spread_dom=NamedSharding(mesh, P(POD_AXIS, None)),
         spread_min=NamedSharding(mesh, P()),
+        spread_cdom=NamedSharding(mesh, P()),
+        spread_dexist=NamedSharding(mesh, P()),
         filter_masks=stack_both, raw_scores=stack_both, norm_scores=stack_both)
 
     return jax.jit(stepfn, in_shardings=(eb_sh, nf_sh, af_sh, key_sh),
