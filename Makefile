@@ -26,10 +26,23 @@ start:
 start-remote:
 	$(CPU_MESH) $(PY) -m minisched_tpu.scenario.remote
 
+# The reference's true process shape (scheduler/scheduler.go:54-75): a
+# store-only apiserver subprocess; the ENGINE runs in the client process
+# as a pure network client (informers long-poll /watch, bindings commit
+# through /bind), then the README scenario runs over the same wire.
+start-client-engine:
+	$(CPU_MESH) $(PY) -m minisched_tpu.scenario.remote --client-engine
+
 # Advanced-feature demo: zone spread (with intra-batch skew arbitration),
 # gang quorum, explain annotations.
 demo:
 	$(CPU_MESH) $(PY) -m minisched_tpu.scenario.demo
+
+# Regenerate README's measured-numbers block from the committed
+# BENCH_TPU.json + the plugin registry (tests/test_docs_numbers.py fails
+# the suite when the committed prose drifts from the artifact).
+docs:
+	$(CPU_MESH) $(PY) tools/gen_docs.py
 
 # Headline benchmark (BASELINE.md): 50k nodes x 10k pods on whatever
 # accelerator jax picks. MINISCHED_BENCH_{NODES,PODS,REPEATS} override.

@@ -11,12 +11,16 @@ same way).
 from __future__ import annotations
 
 import json
+import logging
 import urllib.error
 import urllib.request
 from typing import Any, List, Optional, Tuple
 
-from ..errors import AlreadyExistsError, ConflictError, NotFoundError
+from ..errors import (AlreadyExistsError, ConflictError, NotFoundError,
+                      WatchFellBehindError)
 from ..state import objects as obj
+
+log = logging.getLogger(__name__)
 
 
 class RemoteStore:
@@ -35,7 +39,17 @@ class RemoteStore:
         try:
             with urllib.request.urlopen(
                     req, timeout=timeout or self.timeout) as resp:
-                return json.loads(resp.read())
+                body = resp.read()
+            try:
+                return json.loads(body)
+            except json.JSONDecodeError:
+                # A truncated/mangled 200 body is a TRANSPORT failure —
+                # it must surface as the retryable RuntimeError class,
+                # never as a ValueError the watch path could mistake for
+                # the 410 fell-behind signal.
+                raise RuntimeError(
+                    f"apiserver returned malformed JSON "
+                    f"({len(body)} bytes)") from None
         except urllib.error.HTTPError as e:
             reason = None
             try:
@@ -56,7 +70,7 @@ class RemoteStore:
                     raise AlreadyExistsError(msg) from None
                 raise ConflictError(msg) from None
             if e.code == 410:
-                raise ValueError(msg) from None  # watch fell behind
+                raise WatchFellBehindError(msg) from None
             raise RuntimeError(f"apiserver {e.code}: {msg}") from None
 
     # ---- store verbs ----------------------------------------------------
@@ -98,13 +112,52 @@ class RemoteStore:
     def delete(self, kind: str, key: str) -> None:
         self._call("DELETE", f"/apis/{kind}/{key}")
 
+    def bind_pod(self, pod_key: str, node_name: str) -> Any:
+        """The binding subresource (store.bind_pod CAS contract: 409 if
+        already bound, 404 for a missing pod/node)."""
+        return obj.from_dict("Pod", self._call(
+            "POST", f"/bind/{pod_key}", {"node": node_name}))
+
+    def bind_pods(self, assignments) -> List[str]:
+        """Bulk binding commit; returns the newly-bound keys (store
+        bind_pods skip-and-report contract)."""
+        if not assignments:
+            return []
+        out = self._call("POST", "/bind",
+                         [[k, n] for k, n in assignments])
+        return out["bound"]
+
+    def snapshot(self, kinds: Optional[List[str]] = None):
+        """Atomic list + watch cursor (GET /snapshot): the reflector's
+        list-then-watch-from-listRV contract over the wire."""
+        q = "/snapshot"
+        if kinds:
+            q += "?kinds=" + ",".join(kinds)
+        out = self._call("GET", q)
+        items = {k: [obj.from_dict(k, d) for d in v]
+                 for k, v in out["items"].items()}
+        return items, out["cursor"]
+
+    def list_and_watch(self, kinds: Optional[List[str]] = None):
+        """(initial lists, watcher) with the SAME shape the in-process
+        ClusterStore returns — so the informer factory (and therefore
+        the whole scheduler engine) can attach to a remote apiserver as
+        a pure network client (reference scheduler/scheduler.go:54-75:
+        the scheduler reaches its apiserver exclusively through
+        client-go list+watch)."""
+        items, cursor = self.snapshot(kinds)
+        return items, RemoteWatcher(self, kinds, cursor)
+
     def watch_events(self, cursor: int, kinds: Optional[List[str]] = None,
-                     timeout: float = 5.0) -> Tuple[List[dict], int]:
-        """One long-poll: events after ``cursor`` (dicts with type/kind/
-        object/old/rv; objects decoded) and the new cursor. Raises
-        ValueError when the cursor fell behind (re-list and restart —
-        the k8s reflector contract)."""
-        q = f"/watch?from={cursor}&timeout={timeout}"
+                     timeout: float = 5.0,
+                     limit: int = 1024) -> Tuple[List[dict], int]:
+        """One long-poll: up to ``limit`` events after ``cursor`` (dicts
+        with type/kind/object/old/rv; objects decoded) and the new
+        cursor — the server advances the cursor only past what it
+        returned, so a small limit never skips events. Raises
+        WatchFellBehindError when the cursor fell behind (re-list and
+        restart — the k8s reflector contract)."""
+        q = f"/watch?from={cursor}&timeout={timeout}&limit={limit}"
         if kinds:
             q += "&kinds=" + ",".join(kinds)
         out = self._call("GET", q, timeout=timeout + self.timeout)
@@ -122,3 +175,69 @@ class RemoteStore:
             return bool(self._call("GET", "/healthz").get("ok"))
         except Exception:
             return False
+
+
+class RemoteWatcher:
+    """Watcher-shaped adapter over the HTTP long-poll — the drop-in the
+    informer factory needs (next_events / stop / cursor), so the engine's
+    watch pump runs unchanged against a remote store.
+
+    The fell-behind contract carries through: a cursor past the server's
+    retained log answers 410 → watch_events raises ValueError → the
+    informer re-lists through ``list_and_watch`` (the same recovery it
+    performs in-process). Each ``next_events`` call is one HTTP
+    long-poll; an idle engine therefore polls at its drain interval
+    (~5 req/s at the informer's 0.2 s timeout) — chatty but stateless,
+    the trade the reference's httptest apiserver makes too."""
+
+    def __init__(self, rs: RemoteStore, kinds: Optional[List[str]],
+                 cursor: int):
+        from ..state.store import WatchEvent
+
+        self._rs = rs
+        self._kinds = kinds
+        self._cursor = cursor
+        self._stopped = False
+        self._mk = WatchEvent
+
+    @property
+    def cursor(self) -> int:
+        return self._cursor
+
+    def next_events(self, max_n: int,
+                    timeout: Optional[float] = None) -> list:
+        if self._stopped:
+            return []
+        try:
+            events, self._cursor = self._rs.watch_events(
+                self._cursor, kinds=self._kinds,
+                timeout=min(timeout if timeout is not None else 5.0, 30.0),
+                limit=max_n)
+        except WatchFellBehindError:
+            raise  # 410 — the informer's re-list contract
+        except Exception:
+            # Transient network failure (connection reset, server accept
+            # backlog overflow, a 5xx, a stalled long-poll): the informer
+            # dispatch loop only handles ValueError, so ANY other
+            # exception would kill the watch pump permanently — the
+            # engine would then pend every future pod with healthz still
+            # green. Back off briefly and report an empty poll; the
+            # cursor is untouched, so nothing is skipped and the next
+            # poll resumes exactly where this one failed.
+            import time as _time
+
+            log.warning("remote watch poll failed; retrying",
+                        exc_info=True)
+            _time.sleep(0.5)
+            return []
+        return [self._mk(type=e["type"], kind=e["kind"],
+                         object=e["object"], old_object=e.get("old"),
+                         resource_version=e["rv"])
+                for e in events]
+
+    def next_event(self, timeout: Optional[float] = None):
+        evs = self.next_events(1, timeout=timeout)
+        return evs[0] if evs else None
+
+    def stop(self) -> None:
+        self._stopped = True

@@ -18,6 +18,14 @@ store's existing CRUD + versioned watch:
            → {"events": [{type, kind, object, old, rv}], "cursor": rv}
              long-poll; 410 Gone when the cursor fell behind the retained
              log (client re-lists, exactly the k8s watch contract)
+    GET    /snapshot?kinds=a,b           → {"items": {kind: [...]},
+           "cursor": rv} — ATOMIC list + watch cursor (the client-go
+           reflector's list-then-watch-from-listRV contract); lets a
+           remote informer attach with no gap and no double delivery
+    POST   /bind/{key}                   → bind one pod ({"node": name};
+           the binding subresource: CAS, 409 if already bound)
+    POST   /bind                         → bulk bind ([[key, node], ...]
+           body → {"bound": [keys]}; already-bound/gone pods skipped)
     GET    /healthz
 
 Errors map to status codes: 404 NotFound, 409 AlreadyExists/Conflict,
@@ -135,6 +143,8 @@ def _make_handler(store: ClusterStore):
                 return self._send(200, {"ok": True})
             if kind == "watch":
                 return self._guard(lambda: self._watch(q))
+            if kind == "snapshot":
+                return self._guard(lambda: self._snapshot(q))
             if kind is None:
                 return self._error(404, "no route")
 
@@ -154,11 +164,12 @@ def _make_handler(store: ClusterStore):
             frm = int(q.get("from", ["0"])[0])
             kinds = q.get("kinds", [""])[0]
             timeout = min(float(q.get("timeout", ["5"])[0]), 30.0)
+            limit = min(int(q.get("limit", ["1024"])[0]), 4096)
             w = None
             try:
                 w = store.watch(kinds=kinds.split(",") if kinds else None,
                                 from_version=frm)
-                evs = w.next_events(1024, timeout=timeout)
+                evs = w.next_events(limit, timeout=timeout)
                 # The watcher's own cursor, NOT the last matching event's
                 # rv: it advanced past kind-filtered events too, so the
                 # client neither rescans them next poll nor spuriously
@@ -177,8 +188,32 @@ def _make_handler(store: ClusterStore):
                     "rv": e.resource_version} for e in evs]
             self._send(200, {"events": out, "cursor": cursor})
 
+        def _snapshot(self, q):
+            """Atomic list + cursor: taken under one store lock via
+            list_and_watch (the watcher only donates its start cursor)."""
+            kinds = q.get("kinds", [""])[0]
+            lists, w = store.list_and_watch(
+                kinds=kinds.split(",") if kinds else None)
+            cursor = w.cursor
+            w.stop()
+            self._send(200, {
+                "items": {k: [obj.to_dict(o) for o in objs]
+                          for k, objs in lists.items()},
+                "cursor": cursor})
+
         def do_POST(self):
             kind, key, q = self._route()
+            if kind == "bind":
+                def run():
+                    if key:  # single: the CAS contract, typed errors
+                        node = (self._body() or {}).get("node", "")
+                        self._send(200, obj.to_dict(
+                            store.bind_pod(key, node)))
+                    else:    # bulk: skip-and-report contract
+                        pairs = [(p[0], p[1]) for p in self._body()]
+                        self._send(200,
+                                   {"bound": store.bind_pods(pairs)})
+                return self._guard(run)
             if kind is None:
                 return self._error(404, "no route")
 

@@ -810,12 +810,16 @@ class Scheduler:
                 # In-cycle repair candidates: re-placed against refreshed
                 # counts after the survivors are assumed (_repair_spread)
                 # instead of paying a full queue round-trip + backoff per
-                # tranche. Gang members are excluded (repairing one
-                # member alone breaks gang atomicity) and so are pods
-                # holding unused RWO claims (a repair could move them off
-                # the node their claim was arbitrated against).
+                # tranche. Excluded: gang members (repairing one member
+                # alone breaks gang atomicity), pods holding unused RWO
+                # claims (a repair could move them off the node their
+                # claim was arbitrated against), and fail-closed pods —
+                # repair would BIND a placement the encoder could not
+                # represent faithfully; they must reach the fail_closed
+                # parking below via the normal revoked path.
                 if (self.config.spread_repair_iters
                         and not qpi.pod.spec.pod_group
+                        and qpi.pod.key not in fail_closed
                         and not (st is not None
                                  and CLAIM_UNUSED in st[1])):
                     repair_rows.append(i)
@@ -1125,7 +1129,13 @@ class Scheduler:
                     {batch[i].pod.key for i in rows}, names, nf)
                 if reserved is not None:
                     nf = nf._replace(free=nf.free - reserved)
-            eb2, _P2 = self._slice_eb(eb, np.asarray(rows, dtype=np.int64))
+            # Pad to the MAIN batch's bucket: repair tranches shrink
+            # through many sizes, and a per-tranche pow2 ladder would pay
+            # one fresh XLA compile (~7 s for the topology profile) per
+            # size; the batch's own bucket is already compiled, so repair
+            # costs only device time (the padded rows are invalid).
+            eb2, _P2 = self._slice_eb(eb, np.asarray(rows, dtype=np.int64),
+                                      bucket=eb.pf.valid.shape[0])
             self._step_counter += 1
             d2 = step_fn(eb2, nf, af,
                          jax.random.fold_in(self._key, self._step_counter))
@@ -1172,21 +1182,21 @@ class Scheduler:
             if items:
                 self.cache.account_bind_bulk(
                     items, req_rows=eb2.pf.requests[req_rows])
-            if len(next_rows) == n_r:  # no progress; stop burning steps
-                rows = next_rows
-                break
             rows = next_rows
+            if len(next_rows) == n_r:  # no progress; stop burning steps
+                break
         return out_bind, rows, n_admitted
 
-    def _slice_eb(self, eb, rows):
-        """(eb_sub, P2): row-sliced pod features padded to a fresh bucket,
-        with the batch's group tables (gf/naf) SHARED so group ids stay
-        aligned, and gangs stripped (callers — the sampling residual pass
-        and preemption — exclude gang pods by construction)."""
+    def _slice_eb(self, eb, rows, bucket: Optional[int] = None):
+        """(eb_sub, P2): row-sliced pod features padded to a fresh bucket
+        (or the caller-pinned ``bucket``), with the batch's group tables
+        (gf/naf) SHARED so group ids stay aligned, and gangs stripped
+        (callers — the sampling residual pass, preemption, and spread
+        repair — exclude gang pods by construction)."""
         from ..encode.features import GangFeatures
 
         n = len(rows)
-        P2 = bucket_for(n, self.config.pod_bucket_min)
+        P2 = bucket or bucket_for(n, self.config.pod_bucket_min)
 
         def take(a):
             a = np.asarray(a)

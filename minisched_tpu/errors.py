@@ -11,3 +11,12 @@ class ConflictError(RuntimeError):
 
 class AlreadyExistsError(RuntimeError):
     """Create of an object whose key already exists."""
+
+
+class WatchFellBehindError(ValueError):
+    """A watch cursor fell behind the store's retained event log — the
+    client must re-list and restart (the k8s 410 Gone contract).
+    Subclasses ValueError so consumers written against the in-process
+    Watcher (which raises plain ValueError) keep working; the wire
+    client raises THIS type so a malformed-response ValueError (e.g.
+    json.JSONDecodeError) can never be mistaken for a deliberate 410."""

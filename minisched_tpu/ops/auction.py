@@ -51,6 +51,16 @@ config 5).
 Measured on one v5e core at P=10240, N=50176, R=9 (inside jit, as the
 pipeline always runs it): 91 ms to full assignment (4 rounds) — on par
 with the pallas greedy kernel (87 ms) while remaining GSPMD-partitionable.
+
+Measured optimality (tests/test_auction.py::test_auction_quality_bound):
+the non-displacing variant forgoes Bertsekas' reassignment step, so the
+textbook n·eps bound does NOT apply; over random capacity-1 assignment
+instances the worst observed aggregate was 94.8% of the brute-force
+optimum (pinned at >= 93%), and on plateaued contended workloads — the
+regime the mode exists for — it beat the greedy scan's aggregate by
+0.9-3.5% while occasionally stranding one feasible pod to
+non-displacement (pinned: >= 98% of greedy's aggregate, assigned count
+within 2).
 """
 from __future__ import annotations
 

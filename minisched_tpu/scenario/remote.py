@@ -5,10 +5,17 @@ sched.go:42-68 drives it through client-go).
 
 ``--serve``: boot store + scheduler service + HTTP apiserver and print
 the listening address (the simulator process).
+``--serve-store-only``: boot ONLY store + HTTP apiserver — no engine;
+a remote client is expected to bring its own scheduler.
 default: spawn the server as a SUBPROCESS, then run the README scenario
 (sched.go:70-143) purely through HTTP via RemoteStore — 9 unschedulable
 nodes, pod1 pends with NodeUnschedulable recorded, node10 arrives, pod1
 binds to node10 — and shut the server down.
+``--client-engine``: the reference's actual process shape
+(scheduler/scheduler.go:54-75 — the scheduler is a PURE apiserver
+client): spawn a store-only server, then run the ENGINE in this client
+process over RemoteStore (informers long-polling /watch, bindings
+through /bind) and drive the same scenario.
 """
 from __future__ import annotations
 
@@ -19,9 +26,10 @@ import time
 from ..state import objects as obj
 
 
-def serve() -> None:
-    """Simulator process: store + scheduler + HTTP front; prints the
-    address, serves until stdin closes (parent exit kills us)."""
+def serve(store_only: bool = False) -> None:
+    """Simulator process: store (+ scheduler unless ``store_only``) +
+    HTTP front; prints the address, serves until stdin closes (parent
+    exit kills us)."""
     from ..apiserver import APIServer
     from ..config import SchedulerConfig
     from ..service.service import SchedulerService
@@ -30,9 +38,11 @@ def serve() -> None:
     import os
 
     store = ClusterStore()
-    svc = SchedulerService(store)
-    svc.start_scheduler(config=SchedulerConfig(
-        backoff_initial_s=0.1, backoff_max_s=0.5, batch_window_s=0.0))
+    svc = None
+    if not store_only:
+        svc = SchedulerService(store)
+        svc.start_scheduler(config=SchedulerConfig(
+            backoff_initial_s=0.1, backoff_max_s=0.5, batch_window_s=0.0))
     api = APIServer(store,
                     host=os.environ.get("MINISCHED_API_HOST", "127.0.0.1"),
                     port=int(os.environ.get("MINISCHED_API_PORT", "0"))
@@ -44,7 +54,8 @@ def serve() -> None:
         pass
     finally:
         api.shutdown()
-        svc.shutdown_scheduler()
+        if svc is not None:
+            svc.shutdown_scheduler()
 
 
 def _wait(pred, timeout: float = 30.0, interval: float = 0.1):
@@ -102,17 +113,48 @@ def run_remote_scenario(address: str) -> None:
     print("remote scenario OK")
 
 
+def run_client_engine_scenario(address: str) -> None:
+    """The SCHEDULER as a pure apiserver client (reference
+    scheduler/scheduler.go:54-75): the engine in THIS process attaches
+    to a store-only server over RemoteStore — informers long-poll
+    /watch, failures update pods over PUT, bindings commit through
+    /bind — then the README scenario runs against the same wire."""
+    from ..apiserver import RemoteStore
+    from ..config import SchedulerConfig
+    from ..service.service import SchedulerService
+
+    rs = RemoteStore(address)
+    _wait(rs.healthz, timeout=15)
+    svc = SchedulerService(rs)
+    svc.start_scheduler(config=SchedulerConfig(
+        backoff_initial_s=0.1, backoff_max_s=0.5, batch_window_s=0.0))
+    try:
+        run_remote_scenario(address)
+        print("client-engine scenario OK (engine attached over the wire)")
+    finally:
+        svc.shutdown_scheduler()
+
+
 def main() -> None:
     if "--serve" in sys.argv:
         serve()
         return
+    if "--serve-store-only" in sys.argv:
+        serve(store_only=True)
+        return
+    client_engine = "--client-engine" in sys.argv
+    serve_flag = ("--serve-store-only" if client_engine else "--serve")
     proc = subprocess.Popen(
-        [sys.executable, "-m", "minisched_tpu.scenario.remote", "--serve"],
+        [sys.executable, "-m", "minisched_tpu.scenario.remote", serve_flag],
         stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
     try:
         line = proc.stdout.readline().strip()
         assert line.startswith("LISTENING "), line
-        run_remote_scenario(line.split(" ", 1)[1])
+        address = line.split(" ", 1)[1]
+        if client_engine:
+            run_client_engine_scenario(address)
+        else:
+            run_remote_scenario(address)
     finally:
         try:
             proc.stdin.close()  # server exits when the pipe closes

@@ -121,8 +121,23 @@ class InformerFactory:
                 log.error(
                     "informer fell behind watch log; re-listing and "
                     "redelivering adds (deletes in the gap are lost)")
-                initial, self._watcher = self.store.list_and_watch(
-                    kinds=list(self._handlers) or None)
+                # The re-list itself is a network call when the store is
+                # a RemoteStore (engine-over-the-wire mode); a transient
+                # failure here — e.g. the server still restarting, which
+                # is exactly when 410s happen — must retry, not kill the
+                # watch pump (the engine would then pend every future
+                # pod with healthz green). In-process stores never throw
+                # here, so the loop is wire-only in practice.
+                while not self._stop.is_set():
+                    try:
+                        initial, self._watcher = self.store.list_and_watch(
+                            kinds=list(self._handlers) or None)
+                        break
+                    except Exception:
+                        log.exception("informer re-list failed; retrying")
+                        self._stop.wait(0.5)
+                else:
+                    return
                 # Redeliver in SYNC_ORDER like the initial sync: a Pod bound
                 # to a Node created in the gap must see that Node's add
                 # first, or bind accounting is silently dropped (unknown

@@ -241,3 +241,59 @@ def test_sharded_default_is_priority_faithful(capsys):
     assigned = np.asarray(d.assigned)
     assert assigned[:16].all()      # every high-priority pod placed
     assert not assigned[16:].any()  # no low-priority pod took a slot
+
+
+def test_auction_quality_bound():
+    """Quantified optimality audit (round-3 verdict #8).
+
+    (a) vs brute-force OPTIMAL on capacity-1 assignment instances: the
+    non-displacing variant forgoes Bertsekas' reassignment step, so the
+    theoretical n·eps bound does not apply; measured over 8 seeds the
+    worst aggregate was 94.8% of optimal (seed 5). Pinned at >= 93%.
+    (b) vs greedy on plateaued contended workloads (the regime the mode
+    exists for): measured 100.9-103.5% of greedy's aggregate across 6
+    seeds, occasionally stranding one feasible pod (non-displacement).
+    Pinned at >= 98% aggregate and assigned count within 2.
+    The measured bounds are documented in ops/auction.py."""
+    import itertools
+
+    worst_frac = 1.0
+    for seed in range(8):
+        rng = np.random.default_rng(seed)
+        P, N = 6, 8
+        scores = (rng.random((P, N)) * 100).astype(np.float32)
+        req = np.ones((P, 4), np.float32) * 100
+        free = np.ones((N, 4), np.float32) * 100  # exactly 1 pod/node
+        key = jax.random.PRNGKey(seed)
+        a = auction_assign(jnp.array(scores), jnp.array(req),
+                           jnp.array(free), key)
+        ch, ok = np.asarray(a.chosen), np.asarray(a.assigned)
+        assert ok.all()  # N > P, all feasible: everything must place
+        at = sum(scores[i, ch[i]] for i in range(P))
+        opt = max(sum(scores[i, p[i]] for i in range(P))
+                  for p in itertools.permutations(range(N), P))
+        worst_frac = min(worst_frac, at / opt)
+    assert worst_frac >= 0.93, f"auction fell to {worst_frac:.3f} of optimal"
+
+    for seed in range(6):
+        rng = np.random.default_rng(100 + seed)
+        P, N = 64, 16
+        scores = (np.round(rng.random((P, N)) * 4) * 25).astype(np.float32)
+        scores[rng.random((P, N)) < 0.15] = float(NEG)
+        req = np.ones((P, 4), np.float32) * 100
+        free = np.ones((N, 4), np.float32) * 400  # 4 slots/node
+        key = jax.random.PRNGKey(seed)
+        a = auction_assign(jnp.array(scores), jnp.array(req),
+                           jnp.array(free), key)
+        g = greedy_assign(jnp.array(scores), jnp.array(req),
+                          jnp.array(free), key)
+
+        def agg(res):
+            ch, ok = np.asarray(res.chosen), np.asarray(res.assigned)
+            return (sum(scores[i, ch[i]] for i in range(P) if ok[i]),
+                    int(ok.sum()))
+
+        at, an = agg(a)
+        gt, gn = agg(g)
+        assert at >= 0.98 * gt, (seed, at, gt)
+        assert an >= gn - 2, (seed, an, gn)

@@ -1,0 +1,142 @@
+"""The scheduler engine as a PURE network client of the control plane
+(reference scheduler/scheduler.go:54-75 + k8sapiserver/k8sapiserver.go:
+43-71: scheduler and apiserver are separable processes by construction;
+the scheduler reaches state exclusively through REST + watch).
+
+RemoteStore implements the informer-facing surface (list_and_watch →
+RemoteWatcher over the /watch long-poll, /snapshot for the atomic
+list+cursor, /bind for the binding subresource), so SchedulerService
+runs unchanged against a store it can only reach over HTTP."""
+import time
+
+import pytest
+
+from minisched_tpu.apiserver import APIServer, RemoteStore
+from minisched_tpu.config import SchedulerConfig
+from minisched_tpu.service.service import SchedulerService
+from minisched_tpu.state import objects as obj
+from minisched_tpu.state.store import ClusterStore
+
+
+def _node(name, unschedulable=False, cpu=4000.0):
+    return obj.Node(metadata=obj.ObjectMeta(name=name),
+                    spec=obj.NodeSpec(unschedulable=unschedulable),
+                    status=obj.NodeStatus(allocatable={
+                        "cpu": cpu, "memory": 16 << 30, "pods": 110.0}))
+
+
+def _pod(name, cpu=100.0):
+    return obj.Pod(metadata=obj.ObjectMeta(name=name, namespace="default"),
+                   spec=obj.PodSpec(requests={"cpu": cpu,
+                                              "memory": 1 << 30}))
+
+
+def _wait(pred, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+@pytest.fixture
+def wire(request):
+    max_log = getattr(request, "param", 100_000)
+    store = ClusterStore(max_log=max_log)
+    api = APIServer(store).start()
+    rs = RemoteStore(api.address)
+    svc = SchedulerService(rs)
+    svc.start_scheduler(config=SchedulerConfig(
+        backoff_initial_s=0.05, backoff_max_s=0.3))
+    yield store, rs, svc
+    svc.shutdown_scheduler()
+    api.shutdown()
+
+
+def test_engine_over_wire_readme_scenario(wire):
+    """Pend-with-recorded-plugin → revive on node event → bind, with the
+    engine's only store access an HTTP socket."""
+    _store, rs, _svc = wire
+    rs.create_many([_node(f"node{i}", unschedulable=True)
+                    for i in range(9)])
+    rs.create(_pod("pod1"))
+    pending = _wait(lambda: (
+        p := rs.get("Pod", "default/pod1")).status.unschedulable_plugins
+        and p or None)
+    assert pending.status.unschedulable_plugins == ["NodeUnschedulable"]
+    assert pending.spec.node_name == ""
+    rs.create(_node("node10"))
+    bound = _wait(lambda: (
+        p := rs.get("Pod", "default/pod1")).spec.node_name and p or None,
+        timeout=45.0)
+    assert bound.spec.node_name == "node10"
+
+
+def test_engine_over_wire_burst(wire):
+    _store, rs, _svc = wire
+    rs.create_many([_node(f"bn{i}") for i in range(8)])
+    rs.create_many([_pod(f"bp{i:03d}") for i in range(120)])
+    _wait(lambda: all(p.spec.node_name for p in rs.list("Pod")),
+          timeout=60.0)
+
+
+@pytest.mark.parametrize("wire", [16], indirect=True)
+def test_engine_over_wire_survives_watch_fell_behind(wire):
+    """A burst bigger than the server's retained watch log answers 410
+    to the engine's next poll; the informer must re-list through
+    /snapshot and keep scheduling (the reflector recovery, now over the
+    wire). max_log=16 via fixture param."""
+    store, rs, _svc = wire
+    rs.create(_node("first"))
+    # One bulk transaction appends 80 events; the retained log holds 16,
+    # so the engine's cursor is guaranteed behind on its next poll.
+    store.create_many([_node(f"gap{i:02d}", unschedulable=True)
+                       for i in range(79)])
+    rs.create(_pod("after-gap"))
+    bound = _wait(lambda: (
+        p := rs.get("Pod", "default/after-gap")).spec.node_name and p
+        or None, timeout=60.0)
+    # 'first' is the only schedulable node — binding there proves the
+    # re-list delivered the full node set (gap nodes included) AND the
+    # engine kept running after the 410.
+    assert bound.spec.node_name == "first"
+
+
+def test_remote_bind_subresource_contract():
+    store = ClusterStore()
+    api = APIServer(store).start()
+    try:
+        rs = RemoteStore(api.address)
+        rs.create(_node("n0"))
+        rs.create(_pod("p0"))
+        bound = rs.bind_pod("default/p0", "n0")
+        assert bound.spec.node_name == "n0"
+        from minisched_tpu.errors import ConflictError, NotFoundError
+        with pytest.raises(ConflictError):
+            rs.bind_pod("default/p0", "n0")  # already bound
+        with pytest.raises(NotFoundError):
+            rs.bind_pod("default/ghost", "n0")
+        rs.create_many([_pod(f"bk{i}") for i in range(3)])
+        keys = rs.bind_pods([(f"default/bk{i}", "n0") for i in range(3)]
+                            + [("default/ghost", "n0")])
+        assert sorted(keys) == [f"default/bk{i}" for i in range(3)]
+    finally:
+        api.shutdown()
+
+
+def test_remote_snapshot_is_atomic_cursor():
+    store = ClusterStore()
+    api = APIServer(store).start()
+    try:
+        rs = RemoteStore(api.address)
+        rs.create_many([_node(f"s{i}") for i in range(5)])
+        items, cursor = rs.snapshot(["Node"])
+        assert len(items["Node"]) == 5
+        rs.create(_node("after"))
+        events, _ = rs.watch_events(cursor, kinds=["Node"], timeout=2.0)
+        # exactly the post-snapshot event — no gap, no double delivery
+        assert [e["object"].metadata.name for e in events] == ["after"]
+    finally:
+        api.shutdown()
