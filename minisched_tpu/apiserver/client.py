@@ -42,7 +42,7 @@ class RemoteStore:
                 body = resp.read()
             try:
                 return json.loads(body)
-            except json.JSONDecodeError:
+            except ValueError:  # JSONDecodeError AND UnicodeDecodeError
                 # A truncated/mangled 200 body is a TRANSPORT failure —
                 # it must surface as the retryable RuntimeError class,
                 # never as a ValueError the watch path could mistake for

@@ -233,6 +233,12 @@ class PodFeatures(NamedTuple):
     requests: np.ndarray     # (P,R) f32 (includes the implicit pods:1 slot)
     name_suffix: np.ndarray  # (P,) i32
     priority: np.ndarray     # (P,) i32
+    # The pod's OWN namespace hash + label pair hashes — lets the device
+    # evaluate "does batch pod i match selector group g" (the in-scan
+    # spread-cap membership updates, ops/spreadcap.py) exactly like
+    # group_assigned_match does for the running corpus.
+    ns_hash: np.ndarray      # (P,) i32
+    label_pairs: np.ndarray  # (P,L) i32 hash(key=value)
     na_group: np.ndarray     # (P,) i32 node-affinity group, -1 = unconstrained
     tol_pairs: np.ndarray    # (P,K) i32
     tol_keys: np.ndarray     # (P,K) i32
@@ -754,6 +760,8 @@ def encode_pods(pods: List[Pod], p_pad: int,
         requests=np.zeros((P, NUM_RESOURCES), dtype=np.float32),
         name_suffix=np.full(P, -1, dtype=np.int32),
         priority=np.zeros(P, dtype=np.int32),
+        ns_hash=np.zeros(P, dtype=np.int32),
+        label_pairs=np.zeros((P, cfg.max_labels), dtype=np.int32),
         na_group=np.full(P, -1, dtype=np.int32),
         tol_pairs=np.zeros((P, cfg.max_tolerations), dtype=np.int32),
         tol_keys=np.zeros((P, cfg.max_tolerations), dtype=np.int32),
@@ -790,6 +798,16 @@ def encode_pods(pods: List[Pod], p_pad: int,
         f.requests[i] = resources_vector(obj.pod_requests(pod))
         f.name_suffix[i] = name_suffix_digit(pod.metadata.name)
         f.priority[i] = pod.spec.priority
+        ns = pod.metadata.namespace
+        f.ns_hash[i] = _h(ns) if ns else 0
+        labels = pod.metadata.labels
+        if len(labels) > cfg.max_labels and overflow is not None:
+            overflow.append(
+                f"pod {pod.key} labels: {len(labels)} > {cfg.max_labels}")
+        for j, kv in enumerate(labels.items()):
+            if j >= cfg.max_labels:
+                break
+            f.label_pairs[i, j] = pair_hash(*kv)
         f.na_group[i] = na_builder.group_of(pod)
         if pod.spec.pod_group:
             gid = gang_ids.setdefault(obj.gang_key(pod), len(gang_mins))

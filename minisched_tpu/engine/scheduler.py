@@ -1056,7 +1056,11 @@ class Scheduler:
         want = max(cfg.min_sample_nodes, (n_real * pct) // 100,
                    2 * batch_len)
         k = bucket_for(want, cfg.node_bucket_min)
-        if k >= n_pad:
+        if k >= n_pad // 2:
+            # A sample over half the cluster saves less than the gather +
+            # residual machinery costs (measured: a 10k-pod batch at 50k
+            # nodes sampled K=32768 ran SLOWER than the full axis) —
+            # sampling exists for small batches against huge clusters.
             return None, None
         return build_step(self.plugin_set, explain=False,
                           assignment=cfg.assignment, sample_nodes=k), k

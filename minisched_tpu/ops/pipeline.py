@@ -231,6 +231,30 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
                     ctx[k2] = ctx[k2][:, sample_idx]
             N = sample_nodes
 
+        # In-scan hard-spread enforcement (ops/spreadcap.py): only the
+        # default greedy scan can carry the running domain counts — the
+        # auction's parallel rounds and the sharded chunked-gather scan
+        # keep the static filter verdict (+ host arbitration/repair).
+        # Explain mode keeps it OFF too: the recorded per-node filter
+        # verdicts must reflect upstream's static skew reasoning, not a
+        # deferred always-pass. And SAMPLED steps keep it off: the
+        # running min would cover only the sampled nodes' domains while
+        # the filter's global-min check stands down — hard DoNotSchedule
+        # would fail open device-side (the host arbitration would catch
+        # it, but as revocation churn). The engine disables sampling for
+        # gang batches already; hard-spread batches simply keep the
+        # static filter + exact-arbitration/repair backstop when
+        # sampled.
+        caps = None
+        if (needs_topology and "counts_dom" in ctx and not explain
+                and sample_idx is None
+                and assignment == "greedy" and assign_fn is None):
+            from .spreadcap import build_domain_caps
+
+            caps = build_domain_caps(eb.pf, eb.gf, nf,
+                                     ctx["counts_dom"], ctx["dom_exists"])
+            ctx["spread_scan_groups"] = caps.scan_groups
+
         def evaluate(pf_sub):
             """Filters + scores for a pod sub-batch against the full node
             axis → (masked_total, feasible_counts, reject_counts (F,C),
@@ -327,7 +351,29 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
                 if use_pallas:
                     from .pallas_select import greedy_assign_pallas
 
-                    greedy_fn = greedy_assign_pallas
+                    if caps is not None:
+                        # The kernel can't carry domain counts; batches
+                        # that actually contain enforceable hard-spread
+                        # slots take the caps-scan, everything else the
+                        # kernel — decided at RUN time (lax.cond), so a
+                        # topology profile only pays the scan when a
+                        # hard constraint is really present.
+                        from .select import greedy_assign as _ga
+
+                        def greedy_fn(sc, rq, fr, k, _caps=caps):
+                            return jax.lax.cond(
+                                _caps.any_enforced,
+                                lambda a: _ga(*a, caps=_caps),
+                                lambda a: greedy_assign_pallas(*a),
+                                (sc, rq, fr, k))
+                    else:
+                        greedy_fn = greedy_assign_pallas
+                elif caps is not None:
+                    import functools
+
+                    from .select import greedy_assign as _ga
+
+                    greedy_fn = functools.partial(_ga, caps=caps)
             # Gang-aware joint assignment (ops/gang.py); with no gangs in
             # the batch this reduces to plain capacity-aware greedy
             # assignment.
@@ -432,7 +478,27 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             out = state["fn"](eb, nf, af, key)
             state["ok_shapes"].add(shape)
             return out
-        except Exception:
+        except Exception as e:
+            if (isinstance(e, ValueError)
+                    and "buffers but compiled program expected" in str(e)):
+                # jax 0.9 cpp-pjit dispatch anomaly (regression-pinned in
+                # tests/test_spreadcap.py): a call whose trace-level
+                # jaxpr is IDENTICAL to an already-compiled signature is
+                # handed an executable with a different kept-argument
+                # count. Clearing the jit cache forces a clean recompile
+                # for every bucket — expensive but rare, and strictly
+                # better than failing the scheduling cycle. Checked
+                # INSIDE the generic handler so every other first-call
+                # exception still reaches the pallas fallback below.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "jit dispatch buffer mismatch (%s); clearing the "
+                    "step cache and retrying", e)
+                state["fn"].clear_cache()
+                out = state["fn"](eb, nf, af, key)
+                state["ok_shapes"].add(shape)
+                return out
             # Only a bucket that has NEVER run falls back — that's the
             # lowering/compile-failure case this guard exists for. Once
             # this bucket has produced a batch, an exception is a

@@ -74,19 +74,32 @@ class AssignResult(NamedTuple):
 
 
 def greedy_assign(scores: jnp.ndarray, requests: jnp.ndarray,
-                  free0: jnp.ndarray, key: jax.Array) -> AssignResult:
+                  free0: jnp.ndarray, key: jax.Array,
+                  caps=None) -> AssignResult:
     """Assign pods to nodes in row order (caller pre-sorts by priority).
 
     scores:   (P,N) f32 with NEG on infeasible pairs
     requests: (P,R) f32 per-pod resource requests
     free0:    (N,R) f32 free resources entering the batch
+    caps:     optional ops.spreadcap.DomainCaps — carry per-(group,
+              domain) RUNNING counts through the scan and mask each
+              pod's choice by the hard-spread skew they imply
+              (sequential DoNotSchedule semantics at choice time; the
+              static filter's frozen verdict is skipped for enforced
+              slots). None (the default) is bitwise-identical to the
+              historical scan and what the pallas kernel mirrors.
     """
     P, N = scores.shape
     seed = seed_from_key(key)
 
-    def body(free, inp):
+    def body(carry, inp):
+        free, counts = carry
         i, req, srow = inp
         fits = jnp.all(free >= req[None, :], axis=1)  # (N,)
+        if caps is not None:
+            from .spreadcap import caps_mask
+
+            fits = fits & caps_mask(caps, counts, i)
         s = jnp.where(fits, srow, NEG)
         m = jnp.max(s)
         ok = m > NEG
@@ -95,8 +108,15 @@ def greedy_assign(scores: jnp.ndarray, requests: jnp.ndarray,
         idx = jnp.argmax(jnp.where(tie, noise, -1.0)).astype(jnp.int32)
         safe = jnp.where(ok, idx, 0)
         free = free.at[safe].add(jnp.where(ok, -req, 0.0))
-        return free, (jnp.where(ok, idx, -1), ok)
+        if caps is not None:
+            from .spreadcap import caps_update
 
-    free_after, (chosen, assigned) = jax.lax.scan(
-        body, free0, (jnp.arange(P, dtype=jnp.int32), requests, scores))
+            counts = caps_update(caps, counts, i, safe, ok)
+        return (free, counts), (jnp.where(ok, idx, -1), ok)
+
+    counts0 = (caps.counts0 if caps is not None
+               else jnp.zeros((0, 0), dtype=jnp.float32))
+    (free_after, _), (chosen, assigned) = jax.lax.scan(
+        body, (free0, counts0),
+        (jnp.arange(P, dtype=jnp.int32), requests, scores))
     return AssignResult(chosen, assigned, free_after)

@@ -39,6 +39,7 @@ class PodTopologySpread(BatchedPlugin):
     def filter(self, pf, nf, ctx) -> jnp.ndarray:
         C = pf.spread_group.shape[1]
         P, N = pf.valid.shape[0], nf.valid.shape[0]
+        scan_g = ctx.get("spread_scan_groups")
         ok = jnp.ones((P, N), dtype=bool)
         for c in range(C):  # static small loop; (P,N) transient per slot
             g = pf.spread_group[:, c]
@@ -48,6 +49,13 @@ class PodTopologySpread(BatchedPlugin):
             gsafe = jnp.clip(g, 0, ctx["min_count"].shape[0] - 1)
             skew_after = counts + 1.0 - ctx["min_count"][gsafe][:, None]
             within = skew_after <= pf.spread_max_skew[:, c][:, None]
+            if scan_g is not None:
+                # Slots the greedy scan enforces with RUNNING counts
+                # (ops/spreadcap.py) skip the frozen pre-batch check —
+                # the running-count verdict can legally admit nodes this
+                # static one would reject. Missing-key rejection (dom_ok)
+                # stays static either way.
+                within = within | scan_g[gsafe][:, None]
             ok = ok & jnp.where(active[:, None], dom_ok & within, True)
         return ok
 
