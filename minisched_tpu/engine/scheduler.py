@@ -1232,6 +1232,9 @@ class Scheduler:
 
         won: Set[int] = set()
         taken: Set[str] = set()  # victims already evicted this cycle
+        # One live PDB accounting pass shared by every preemptor of the
+        # cycle (earlier evictions debit the budgets later ones see).
+        pdb_state = self._pdb_state()
         for j, i in enumerate(rows):
             if not ok[j]:
                 continue
@@ -1254,7 +1257,8 @@ class Scheduler:
                 self.drop_nomination(qpi.pod.key)
                 won.add(i)  # already bound elsewhere — no verdict needed
                 continue
-            victims = self._select_victims(qpi.pod, node_name, taken)
+            victims = self._select_victims(qpi.pod, node_name, taken,
+                                           pdb_state)
             if victims is None:
                 continue  # candidates raced away — terminal verdict stands
             if not victims:
@@ -1348,17 +1352,62 @@ class Scheduler:
                 del self._nominations[k]
         return debits
 
-    def _select_victims(self, pod, node_name: str,
-                        taken: Set[str]) -> Optional[List[str]]:
+    def _pdb_state(self) -> Optional[List[list]]:
+        """Live PodDisruptionBudget accounting for one preemption pass:
+        ``[namespace, selector, allowed_disruptions]`` rows, where
+        allowed = currently-bound matching pods − min_available (the
+        upstream disruptionsAllowed computed from live state — the
+        simulator has no PDB status controller). None when no PDBs
+        exist, so the common no-PDB path costs nothing."""
+        pdbs = self.store.list("PodDisruptionBudget")
+        if not pdbs:
+            return None
+        counts = [0] * len(pdbs)
+
+        def visit(p):
+            if not p.spec.node_name:
+                return
+            for i, b in enumerate(pdbs):
+                if (p.metadata.namespace == b.metadata.namespace
+                        and (b.spec.selector is None
+                             or b.spec.selector.matches(p.metadata.labels))):
+                    counts[i] += 1
+
+        # Read-only visitor: counting labels over a 100k-pod corpus via
+        # list() would deep-copy every object tree per preemption cycle.
+        # RemoteStore (engine-over-the-wire) has no visitor; its list()
+        # objects are already private decoded copies.
+        fe = getattr(self.store, "for_each", None)
+        if fe is not None:
+            fe("Pod", visit)
+        else:
+            for p in self.store.list("Pod"):
+                visit(p)
+        return [[b.metadata.namespace, b.spec.selector,
+                 c - int(b.spec.min_available)]
+                for b, c in zip(pdbs, counts)]
+
+    def _select_victims(self, pod, node_name: str, taken: Set[str],
+                        pdb_state: Optional[List[list]] = None,
+                        ) -> Optional[List[str]]:
         """Minimal victim prefix on ``node_name``: evict lowest-priority
         pods first (upstream's order) until the node's free vector covers
         the preemptor's request on every axis. None when the candidates
-        no longer suffice (state raced since the device search)."""
+        no longer suffice (state raced since the device search).
+
+        PodDisruptionBudgets (upstream policy/v1): a victim whose
+        eviction would drop a matching budget below min_available is
+        skipped in the first pass and permitted only when no
+        non-violating victim set suffices — upstream DefaultPreemption's
+        minimize-violations ordering (violating victims rank last but
+        preemption is not forbidden outright). On success the shared
+        ``pdb_state`` rows are debited so later preemptors in the SAME
+        cycle see the budget the earlier evictions consumed."""
         from ..encode import features as F
         from ..state.objects import pod_requests
 
-        free = self.cache.free_of(node_name)
-        if free is None:
+        free0 = self.cache.free_of(node_name)
+        if free0 is None:
             return None
         # Capacity reserved by OTHER pods' nominations on this node is
         # not available to this preemptor — sizing victims against raw
@@ -1368,19 +1417,67 @@ class Scheduler:
             now = time.monotonic()
             for k, (n2, req2, exp) in self._nominations.items():
                 if n2 == node_name and k != pod.key and exp >= now:
-                    free = free - req2
+                    free0 = free0 - req2
         need = F.resources_vector(pod_requests(pod))
-        victims: List[str] = []
-        acc = free
-        for key, req, _prio in self.cache.victims_below(
-                node_name, pod.spec.priority):
-            if key in taken:
-                continue
-            if np.all(acc >= need):
-                break
-            acc = acc + req
-            victims.append(key)
-        return victims if np.all(acc >= need) else None
+        cands = [(k, r) for k, r, _p in self.cache.victims_below(
+            node_name, pod.spec.priority) if k not in taken]
+
+        # Candidate pod identity (namespace, labels) fetched ONCE — not
+        # per pass per candidate; store.get deep-copies the object tree.
+        meta: Dict[str, tuple] = {}
+        if pdb_state:
+            for key, _req in cands:
+                try:
+                    vp = self.store.get("Pod", key)
+                except NotFoundError:
+                    continue
+                meta[key] = (vp.metadata.namespace, vp.metadata.labels)
+
+        def attempt(allow_violations: bool):
+            acc = free0
+            victims: List[str] = []
+            budgets = [list(b) for b in (pdb_state or [])]
+            deferred: List[tuple] = []
+            for key, req in cands:
+                if np.all(acc >= need):
+                    break
+                if budgets:
+                    m = meta.get(key)
+                    if m is None:
+                        continue
+                    hit = [b for b in budgets
+                           if b[0] == m[0]
+                           and (b[1] is None or b[1].matches(m[1]))]
+                    if any(b[2] <= 0 for b in hit):
+                        if allow_violations:
+                            # violating victims rank LAST (upstream's
+                            # minimize-violations order): taken below
+                            # only if the non-violating set is short
+                            deferred.append((key, req, hit))
+                        continue
+                    for b in hit:
+                        b[2] -= 1
+                acc = acc + req
+                victims.append(key)
+            for key, req, hit in deferred:
+                if np.all(acc >= need):
+                    break
+                for b in hit:
+                    b[2] -= 1
+                acc = acc + req
+                victims.append(key)
+            return (victims, budgets) if np.all(acc >= need) else None
+
+        got = attempt(False)
+        if got is None and pdb_state:
+            got = attempt(True)
+        if got is None:
+            return None
+        victims, budgets = got
+        if pdb_state is not None:
+            for row, new in zip(pdb_state, budgets):
+                row[2] = new[2]
+        return victims
 
     # Node lifecycle (informer thread) lives on the shared cluster state
     # (engine/clusterstate.py) — one cache, one re-adoption table, all

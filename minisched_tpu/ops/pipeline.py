@@ -496,9 +496,15 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
                     "jit dispatch buffer mismatch (%s); clearing the "
                     "step cache and retrying", e)
                 state["fn"].clear_cache()
-                out = state["fn"](eb, nf, af, key)
-                state["ok_shapes"].add(shape)
-                return out
+                try:
+                    out = state["fn"](eb, nf, af, key)
+                    state["ok_shapes"].add(shape)
+                    return out
+                except Exception:
+                    # recovery failed — fall THROUGH to the never-run-
+                    # bucket pallas->scan fallback below rather than
+                    # failing the scheduling cycle here
+                    pass
             # Only a bucket that has NEVER run falls back — that's the
             # lowering/compile-failure case this guard exists for. Once
             # this bucket has produced a batch, an exception is a

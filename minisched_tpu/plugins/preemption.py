@@ -21,9 +21,14 @@ so a pod that upstream would place via such an eviction parks terminally
 here. This is intentional: curing those filters requires re-running the
 topology/affinity group state per candidate victim set (a per-(pod,node)
 combinatorial simulation the batched one-shot candidate search trades
-away for O(Pf·A + R·Pf·N) cost — ops/preempt.py). No PodDisruptionBudget
-model (the simulator has no PDB objects); gang members neither preempt
-NOR are offered as victims (group-level victim math is out of scope — evicting
+away for O(Pf·A + R·Pf·N) cost — ops/preempt.py). PodDisruptionBudgets
+ARE modeled (policy/v1 min_available form, state/objects.py): a victim
+whose eviction would drop a matching budget below min_available is
+chosen only when no non-violating victim set suffices — upstream
+DefaultPreemption's minimize-violations ordering (engine
+_select_victims; budgets are debited across every preemptor of a
+cycle). Gang members neither preempt
+nor are offered as victims (group-level victim math is out of scope — evicting
 one member would strand its gang below quorum); the device-side
 candidate search counts all lower-priority pods (including gang members)
 when sizing feasibility, so a candidate that only works by evicting gang

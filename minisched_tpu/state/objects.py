@@ -385,16 +385,46 @@ class Event:
         return f"{self.metadata.namespace}/{self.metadata.name}"
 
 
+@dataclass
+class PDBSpec:
+    """upstream policy/v1 PodDisruptionBudgetSpec, the min_available
+    form (max_unavailable reduces to it given the matched count; only
+    min_available is modeled — the simulator has no desired-replica
+    source to resolve percentages against)."""
+
+    min_available: int = 0
+    selector: Optional[LabelSelector] = None
+
+
+@dataclass
+class PodDisruptionBudget:
+    """upstream policy/v1 PodDisruptionBudget: bounds VOLUNTARY
+    disruptions (here: preemption evictions) of the matching pods. The
+    reference has no preemption and therefore no PDBs; this models the
+    upstream semantics DefaultPreemption honors — victims whose eviction
+    would drop a budget below min_available are chosen only as a last
+    resort (plugins/preemption.py)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PDBSpec = field(default_factory=PDBSpec)
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
 KIND_OF = {
     Pod: "Pod",
     Node: "Node",
     PersistentVolume: "PersistentVolume",
     PersistentVolumeClaim: "PersistentVolumeClaim",
     Event: "Event",
+    PodDisruptionBudget: "PodDisruptionBudget",
 }
 
 NAMESPACED = {"Pod": True, "Node": False, "PersistentVolume": False,
-              "PersistentVolumeClaim": True, "Event": True}
+              "PersistentVolumeClaim": True, "Event": True,
+              "PodDisruptionBudget": True}
 
 
 def kind_of(obj: Any) -> str:

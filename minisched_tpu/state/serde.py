@@ -13,6 +13,7 @@ _KIND_TYPES = {
     "PersistentVolume": obj.PersistentVolume,
     "PersistentVolumeClaim": obj.PersistentVolumeClaim,
     "Event": obj.Event,
+    "PodDisruptionBudget": obj.PodDisruptionBudget,
 }
 
 _HINT_CACHE: Dict[type, Dict[str, Any]] = {}

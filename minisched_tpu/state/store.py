@@ -122,7 +122,8 @@ class Watcher:
 class ClusterStore:
     """Thread-safe typed object store with versioned watch log."""
 
-    KINDS = ("Pod", "Node", "PersistentVolume", "PersistentVolumeClaim", "Event")
+    KINDS = ("Pod", "Node", "PersistentVolume", "PersistentVolumeClaim",
+             "Event", "PodDisruptionBudget")
 
     def __init__(self, max_log: int = 100_000):
         self._cond = threading.Condition()
@@ -384,6 +385,17 @@ class ClusterStore:
         return out, cursor
 
     # ---- Snapshot / restore (etcd durability analog) -------------------
+
+    def for_each(self, kind: str, fn) -> None:
+        """READ-ONLY visitor over the stored objects of ``kind`` WITHOUT
+        the copy-on-read isolation — for aggregate scans (e.g. the
+        engine's PodDisruptionBudget counting) where list()'s per-object
+        deep copy would dominate. ``fn`` runs under the store lock and
+        MUST NOT mutate or retain the objects (the read-only contract
+        watch/list_and_watch snapshots already carry)."""
+        with self._cond:
+            for o in self._objects[kind].values():
+                fn(o)
 
     def snapshot(self) -> Dict[str, Any]:
         with self._cond:
