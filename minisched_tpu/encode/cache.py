@@ -189,19 +189,32 @@ class NodeFeatureCache:
 
     # ---- pod accounting -------------------------------------------------
 
-    def account_bind(self, pod: Pod, node_name: str = "") -> None:
+    def account_bind(self, pod: Pod, node_name: str = "") -> bool:
         """Pod became bound: subtract its requests from the node's free row
         and add it to the assigned-pod corpus. ``node_name`` overrides
         ``pod.spec.node_name`` for the assume path, where the engine
         accounts a still-pending pod onto its selected node without
-        mutating (or copying) the queued object."""
-        with self._lock:
-            self._account_bind_locked(pod, node_name)
-            self.version += 1
+        mutating (or copying) the queued object.
 
-    def account_bind_bulk(self, items, req_rows=None) -> None:
+        Returns False when the named node has NO row (deleted between the
+        engine's snapshot and this assume, or a pod bound to a node the
+        cache never saw) — the accounting did NOT happen and the caller
+        must react (requeue the pod, or park it for re-adoption when a
+        same-named node returns). A silent miss here is how a pod becomes
+        permanently invisible to capacity/topology accounting."""
+        with self._lock:
+            ok = self._account_bind_locked(pod, node_name)
+            self.version += 1
+            return ok
+
+    def account_bind_bulk(self, items, req_rows=None) -> List[int]:
         """Assume a whole batch in one lock acquisition: ``items`` is a
-        list of (pod, node_name). ``req_rows`` optionally supplies the
+        list of (pod, node_name). Returns the positions in ``items`` whose
+        named node had NO row (deleted between snapshot and assume) — those
+        pods were NOT accounted and the caller must requeue or park them
+        (see ``account_bind``).
+
+        ``req_rows`` optionally supplies the
         encoder's request rows (encode.PodFeatures.requests) so the
         dominant per-pod cost — rebuilding the request vector — is skipped.
         Only volume-free pods may reuse their encoded row: for pods with
@@ -222,6 +235,7 @@ class NodeFeatureCache:
             reqs = (None if req_rows is None
                     else np.array(req_rows, dtype=np.float32, copy=True))
             fast: List[tuple] = []  # (request row k, node row i, pod)
+            missed: List[int] = []
             batch_seen: set = set()  # in-batch duplicate keys: sequential
             # accounting early-returns on the second occurrence (it is
             # already in _bound); mirror that by skipping it outright —
@@ -234,12 +248,14 @@ class NodeFeatureCache:
                 if (reqs is None or pod.spec.volumes or pod.spec.ports
                         or self._pod_has_anti(pod)
                         or pod.key in self._bound):
-                    self._account_bind_locked(
-                        pod, node_name,
-                        None if reqs is None else reqs[k].copy())
+                    if not self._account_bind_locked(
+                            pod, node_name,
+                            None if reqs is None else reqs[k].copy()):
+                        missed.append(k)
                     continue
                 i = self._index.get(node_name or pod.spec.node_name)
                 if i is None:
+                    missed.append(k)
                     continue
                 fast.append((k, i, pod))
             if fast:
@@ -290,12 +306,17 @@ class NodeFeatureCache:
                             f"{max_labels} slots")
                     self._assigned.label_pairs[a] = row
             self.version += 1
+            return missed
 
     def _account_bind_locked(self, pod: Pod, node_name: str = "",
-                             req: Optional[np.ndarray] = None) -> None:
+                             req: Optional[np.ndarray] = None) -> bool:
+        """Returns False on a node-row miss (NOT accounted); True when the
+        pod is accounted — including the idempotent already-bound case."""
         i = self._index.get(node_name or pod.spec.node_name)
-        if i is None or pod.key in self._bound:
-            return
+        if i is None:
+            return False
+        if pod.key in self._bound:
+            return True
         if req is None:
             req = F.resources_vector(pod_requests(pod))
         ports = [p.host_port for p in pod.spec.ports if p.host_port]
@@ -351,6 +372,7 @@ class NodeFeatureCache:
                 f"{self.cfg.max_labels} slots")
         for j, (k, v) in enumerate(labels[:self.cfg.max_labels]):
             self._assigned.label_pairs[a, j] = F.pair_hash(k, v)
+        return True
 
     def account_unbind(self, pod_key: str) -> None:
         """Bound pod deleted/unbound: return its requests to the node."""

@@ -96,6 +96,17 @@ class SharedClusterState:
         if gone:
             self._orphaned_binds.setdefault(name, set()).update(gone)
 
+    def on_bind_miss(self, pod) -> None:
+        """A bound pod's node has no cache row (bound to a node that was
+        deleted, or that the cache never saw — e.g. a pre-bound pod to a
+        not-yet-created node). Park it for re-adoption: if a same-named
+        node appears, ``on_node_added`` re-accounts it; until then it is
+        correctly absent from capacity/topology counts (the node does not
+        exist)."""
+        if pod.spec.node_name:
+            self._orphaned_binds.setdefault(
+                pod.spec.node_name, set()).add(pod.key)
+
     def on_bound_pod_deleted(self, pod) -> None:
         self.cache.account_unbind(pod.key)
         orphans = self._orphaned_binds.get(pod.spec.node_name)
@@ -126,7 +137,8 @@ def _add_all_event_handlers(state: SharedClusterState,
             if pod.spec.pod_group:
                 move_all(ClusterEvent(GVK.POD, ActionType.ADD))
         else:
-            state.cache.account_bind(pod)
+            if not state.cache.account_bind(pod):
+                state.on_bind_miss(pod)
             move_all(ClusterEvent(GVK.POD, ActionType.ADD))
 
     def pod_update(old, new):
@@ -138,7 +150,8 @@ def _add_all_event_handlers(state: SharedClusterState,
         elif not old.spec.node_name:
             # became bound: idempotent accounting (an engine assumes the
             # pod at selection time; this is the confirm path)
-            state.cache.account_bind(new)
+            if not state.cache.account_bind(new):
+                state.on_bind_miss(new)
         else:
             move_all(ClusterEvent(GVK.POD, ActionType.UPDATE))
 
@@ -171,7 +184,8 @@ def _add_all_event_handlers(state: SharedClusterState,
         for idx, batch in per_engine.items():
             engines[idx].queue.add_many(batch)
         if bound:
-            state.cache.account_bind_bulk(bound)
+            for m in state.cache.account_bind_bulk(bound):
+                state.on_bind_miss(bound[m][0])
         if move:
             move_all(ClusterEvent(GVK.POD, ActionType.ADD))
 
@@ -192,7 +206,8 @@ def _add_all_event_handlers(state: SharedClusterState,
             else:
                 move = True
         if became_bound:
-            state.cache.account_bind_bulk(became_bound)
+            for m in state.cache.account_bind_bulk(became_bound):
+                state.on_bind_miss(became_bound[m][0])
         if move:
             move_all(ClusterEvent(GVK.POD, ActionType.UPDATE))
 

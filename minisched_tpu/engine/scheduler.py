@@ -871,6 +871,7 @@ class Scheduler:
         assigned_l = assigned[:len(batch)].tolist()
         gang_rejected_l = gang_rejected[:len(batch)].tolist()
         feasible_l = feasible[:len(batch)].tolist()
+        n_ghost = 0  # assigned rows lost to a mid-cycle node deletion
         for i, qpi in enumerate(batch):
             if i in revoked:
                 continue
@@ -893,7 +894,9 @@ class Scheduler:
                     assume_rows.append(i)
                     to_bind.append((qpi, node_name))
                 else:
-                    pair = self._start_binding_cycle(qpi, node_name)
+                    pair, ghost = self._start_binding_cycle(qpi, node_name)
+                    if ghost:
+                        n_ghost += 1
                     if pair is not None:
                         to_bind.append(pair)
             elif gang_rejected_l[i]:
@@ -937,8 +940,28 @@ class Scheduler:
                     retryable=False)
 
         if assume_items:
-            self.cache.account_bind_bulk(
+            missed = self.cache.account_bind_bulk(
                 assume_items, req_rows=eb.pf.requests[assume_rows])
+            if missed:
+                # The chosen node's cache row vanished between the cycle's
+                # snapshot and this assume (node deleted mid-cycle). Bind
+                # would commit the pod to a ghost node the model can never
+                # account — and if a same-named node later returned, the
+                # pod would silently distort its capacity AND its topology
+                # domain counts (observed as a hard-skew violation under
+                # node churn). Requeue instead; next cycle's snapshot has
+                # live nodes only.
+                n_ghost += len(missed)
+                dead_keys = set()
+                for m in missed:
+                    pod, node_name = assume_items[m]
+                    dead_keys.add(pod.key)
+                    self._handle_failure(
+                        batch[assume_rows[m]], {BATCH_CAPACITY},
+                        f"chosen node {node_name} was deleted during the "
+                        "scheduling cycle", retryable=True)
+                to_bind = [(q, n) for q, n in to_bind
+                           if q.pod.key not in dead_keys]
 
         n_repaired = 0
         if repair_rows:
@@ -989,7 +1012,7 @@ class Scheduler:
         t_commit = time.perf_counter()
         n_assigned = (int(assigned[:len(batch)].sum())
                       - sum(1 for i in revoked if assigned[i])
-                      + n_repaired)
+                      - n_ghost + n_repaired)
         with self._metrics_lock:
             m = self._metrics
             m["batches"] += 1
@@ -1161,6 +1184,8 @@ class Scheduler:
                 exact_tables=lambda: (np.asarray(d2.spread_cdom),
                                       np.asarray(d2.spread_dexist)))
             items, req_rows, next_rows = [], [], []
+            iter_rows: List[int] = []  # batch row per ``items`` entry
+            iter_bind: List[tuple] = []
             for j in range(n_r):
                 i = rows[j]
                 if assigned2[j] and j not in rev2:
@@ -1172,11 +1197,17 @@ class Scheduler:
                     if bulk:
                         items.append((batch[i].pod, node_name))
                         req_rows.append(j)
-                        out_bind.append((batch[i], node_name))
+                        iter_rows.append(i)
+                        iter_bind.append((batch[i], node_name))
                     else:
-                        pair = self._start_binding_cycle(batch[i],
-                                                         node_name)
-                        if pair is not None:
+                        pair, ghost = self._start_binding_cycle(
+                            batch[i], node_name)
+                        if ghost:
+                            # not placed at all — the row goes back into
+                            # the loop like a bulk-path miss
+                            n_admitted -= 1
+                            next_rows.append(i)
+                        elif pair is not None:
                             out_bind.append(pair)
                 else:
                     # still contended (rev2) or currently infeasible —
@@ -1184,8 +1215,19 @@ class Scheduler:
                     # iteration's admissions raise the domain min
                     next_rows.append(i)
             if items:
-                self.cache.account_bind_bulk(
+                missed = self.cache.account_bind_bulk(
                     items, req_rows=eb2.pf.requests[req_rows])
+                if missed:
+                    # Chosen node deleted mid-cycle (see the main cycle's
+                    # assume-miss path): not accounted, must not bind —
+                    # push back into the loop; the next iteration's fresh
+                    # snapshot no longer offers the dead node.
+                    n_admitted -= len(missed)
+                    dead = set(missed)  # membership filter below
+                    next_rows.extend(iter_rows[m] for m in missed)
+                    iter_bind = [p for m, p in enumerate(iter_bind)
+                                 if m not in dead]
+                out_bind.extend(iter_bind)
             rows = next_rows
             if len(next_rows) == n_r:  # no progress; stop burning steps
                 break
@@ -1649,14 +1691,25 @@ class Scheduler:
     # ---- permit + binding cycle ----------------------------------------
 
     def _start_binding_cycle(self, qpi: QueuedPodInfo, node_name: str):
-        """Assume + permit. Returns (qpi, node_name) when the pod is
-        permit-free so the caller can bulk-commit the whole batch in one
-        store transaction; returns None when the pod was parked for a
-        permit wait (bound later, per-pod) or failed permit."""
+        """Assume + permit. Returns (pair, ghost): ``pair`` is
+        (qpi, node_name) when the pod is permit-free so the caller can
+        bulk-commit the whole batch in one store transaction, None when
+        the pod was parked for a permit wait (bound later, per-pod) or
+        failed permit; ``ghost`` is True when the pod was NOT placed at
+        all because its chosen node's row vanished mid-cycle (the caller
+        must not count it as assigned)."""
         pod = qpi.pod
         # Assume the pod onto the node immediately so the next batch's
         # snapshot sees the capacity taken (upstream assume/forget model).
-        self.cache.account_bind(pod, node_name=node_name)
+        if not self.cache.account_bind(pod, node_name=node_name):
+            # Node row deleted between snapshot and assume — binding now
+            # would commit a ghost placement the model can never account
+            # (see the bulk-assume miss path). Requeue for a fresh cycle.
+            self._handle_failure(
+                qpi, {BATCH_CAPACITY},
+                f"chosen node {node_name} was deleted during the "
+                "scheduling cycle", retryable=True)
+            return None, True
 
         waits = []
         for plugin in self.plugin_set.permit_plugins:
@@ -1671,7 +1724,7 @@ class Scheduler:
                     qpi, {plugin.name},
                     f"pod rejected by permit plugin {plugin.name}",
                     retryable=False)
-                return None
+                return None, False
             if status == "wait":
                 waits.append((plugin.name, delay, timeout))
 
@@ -1683,8 +1736,8 @@ class Scheduler:
                 self.waiting_pods[pod.key] = wp
             max_timeout = max(t for _, _, t in waits)
             self._binder.submit(self._wait_and_bind, qpi, wp, max_timeout)
-            return None
-        return qpi, node_name
+            return None, False
+        return (qpi, node_name), False
 
     def _wait_and_bind(self, qpi: QueuedPodInfo, wp: WaitingPod,
                        max_timeout: float) -> None:

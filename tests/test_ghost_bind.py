@@ -1,0 +1,147 @@
+"""Node-deleted-mid-cycle accounting races (the ghost-bind family).
+
+The batched cycle evaluates a SNAPSHOT of the node axis; a node deleted
+between that snapshot and the assume/bind commit used to be accounted
+nowhere: ``_account_bind_locked`` silently no-opped on the missing row,
+the binder committed the pod to the store anyway, and if a same-named
+node later returned (churn), the pod stayed permanently invisible to
+capacity AND topology counts — observed in chaos as a hard-skew
+violation (max_skew=1 burst ending 26/18/10/18 across four zones). The
+reference never faces this: its sequential cycle re-lists nodes per pod
+(reference minisched/minisched.go:40) and binds through the apiserver,
+which accepts ghost bindings exactly like our store does.
+
+Contract under test:
+  * cache accounting reports misses instead of swallowing them;
+  * the ENGINE never ghost-binds — an assume-miss requeues the pod and a
+    later cycle places it on a live node;
+  * externally ghost-bound pods (pre-bound clients, reference apiserver
+    parity) are parked and RE-ADOPTED into the accounting when a
+    same-named node appears.
+"""
+import threading
+import time
+
+import numpy as np
+
+from minisched_tpu.config import SchedulerConfig
+from minisched_tpu.encode import NodeFeatureCache
+from minisched_tpu.scenario import Cluster
+from minisched_tpu.scenario.runner import wait_until
+from minisched_tpu.service.defaultconfig import Profile
+from minisched_tpu.state import objects as obj
+
+
+def _node(name, cpu=4000):
+    return obj.Node(metadata=obj.ObjectMeta(name=name),
+                    status=obj.NodeStatus(allocatable={
+                        "cpu": cpu, "memory": 16 << 30, "pods": 110}))
+
+
+def _pod(name, node_name="", cpu=100):
+    return obj.Pod(
+        metadata=obj.ObjectMeta(name=name, namespace="default"),
+        spec=obj.PodSpec(requests={"cpu": cpu}, node_name=node_name))
+
+
+def test_account_bind_reports_node_row_miss():
+    cache = NodeFeatureCache()
+    cache.upsert_node(_node("n1"))
+    assert cache.account_bind(_pod("a"), node_name="n1") is True
+    # idempotent re-account of a bound pod is still "accounted"
+    assert cache.account_bind(_pod("a"), node_name="n1") is True
+    assert cache.account_bind(_pod("b"), node_name="ghost") is False
+    assert cache.assigned_count() == 1
+
+
+def test_account_bind_bulk_reports_missed_positions():
+    cache = NodeFeatureCache()
+    cache.upsert_node(_node("n1"))
+    items = [(_pod("a"), "n1"), (_pod("b"), "ghost"), (_pod("c"), "n1"),
+             (_pod("d"), "gone")]
+    missed = cache.account_bind_bulk(items)
+    assert missed == [1, 3]
+    assert cache.assigned_count() == 2
+    # fast path (req_rows supplied, no volumes/ports) reports misses too
+    cache2 = NodeFeatureCache()
+    cache2.upsert_node(_node("n1"))
+    reqs = np.zeros((2, 16), dtype=np.float32)
+    missed2 = cache2.account_bind_bulk(
+        [(_pod("a"), "ghost"), (_pod("b"), "n1")],
+        req_rows=reqs[:, :cache2.snapshot()[0].free.shape[1]])
+    assert missed2 == [0]
+    assert cache2.assigned_count() == 1
+
+
+def test_engine_requeues_instead_of_ghost_binding():
+    """Delete the only snapshot-visible node between snapshot and assume:
+    the pod must NOT bind to the ghost; it requeues and binds to a node
+    created afterwards, with accounting consistent."""
+    c = Cluster()
+    try:
+        c.start(profile=Profile(plugins=["NodeUnschedulable",
+                                         "NodeResourcesFit"]),
+                config=SchedulerConfig(backoff_initial_s=0.05,
+                                       backoff_max_s=0.2,
+                                       batch_window_s=0.05),
+                with_pv_controller=False)
+        c.create_node("doomed", cpu=64000)
+        sched = c.service.scheduler
+        cache = sched.cache
+        orig = cache.snapshot_versioned
+        fired = threading.Event()
+
+        def racy_snapshot(*a, **kw):
+            out = orig(*a, **kw)
+            if not fired.is_set() and cache.row_of("doomed") is not None:
+                fired.set()
+                c.delete_node("doomed")
+                # wait for the informer to process the delete so the
+                # row is gone BEFORE the cycle reaches its assume —
+                # the deterministic worst-case interleaving
+                wait_until(lambda: cache.row_of("doomed") is None, 5.0)
+            return out
+
+        cache.snapshot_versioned = racy_snapshot
+        try:
+            c.create_pod("p1", cpu=100)
+            wait_until(fired.is_set, 5.0)
+            # pod must not be bound to the deleted node
+            time.sleep(0.3)
+            assert c.get_pod("p1").spec.node_name == ""
+            c.create_node("alive", cpu=64000)
+            pod = c.wait_for_pod_bound("p1", timeout=10.0)
+            assert pod.spec.node_name == "alive"
+        finally:
+            cache.snapshot_versioned = orig
+        # accounting consistent: the pod is debited on the live node
+        free = cache.free_of("alive")
+        assert free is not None
+        assert cache.assigned_count() == 1
+    finally:
+        c.shutdown()
+
+
+def test_ghost_bound_pod_adopted_when_node_returns():
+    """A pod bound (externally) to a node the cache has never seen is
+    parked and re-accounted when a same-named node appears — capacity
+    and the assigned corpus both reflect it."""
+    c = Cluster()
+    try:
+        c.start(profile=Profile(plugins=["NodeUnschedulable",
+                                         "NodeResourcesFit"]),
+                with_pv_controller=False)
+        cache = c.service.scheduler.cache
+        # externally pre-bound pod to a nonexistent node (the store, like
+        # the real apiserver, accepts it)
+        c.store.create(_pod("ghosted", node_name="later", cpu=700))
+        wait_until(lambda: True, 0.1)
+        assert cache.assigned_count() == 0
+        c.create_node("later", cpu=4000)
+        wait_until(lambda: cache.assigned_count() == 1, 5.0)
+        assert cache.assigned_count() == 1
+        free = cache.free_of("later")
+        cpu_axis = obj.RESOURCE_INDEX["cpu"]
+        assert free is not None and abs(free[cpu_axis] - 3300.0) < 1e-3
+    finally:
+        c.shutdown()
