@@ -22,9 +22,12 @@ start:
 
 # README scenario over the WIRE: a subprocess boots store + scheduler +
 # HTTP apiserver; the client drives it purely through the socket
-# (reference k8sapiserver + client-go pairing).
+# (reference k8sapiserver + client-go pairing). Runs with bearer-token
+# auth + flow control on, proving the reference's loopback-auth shape
+# (k8sapiserver.go:139-153, :203-208).
 start-remote:
-	$(CPU_MESH) $(PY) -m minisched_tpu.scenario.remote
+	MINISCHED_API_TOKEN=dev-loopback-token MINISCHED_API_MAX_INFLIGHT=64 \
+	  $(CPU_MESH) $(PY) -m minisched_tpu.scenario.remote
 
 # The reference's true process shape (scheduler/scheduler.go:54-75): a
 # store-only apiserver subprocess; the ENGINE runs in the client process

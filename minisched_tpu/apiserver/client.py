@@ -7,35 +7,85 @@ status codes back onto the store's exception types — so scenario code
 written against the in-process store drives a remote simulator unchanged
 (reference sched.go:42-68 drives its apiserver through client-go the
 same way).
+
+client-go parity knobs:
+  * ``token`` — bearer token sent as ``Authorization: Bearer ...`` (the
+    reference's loopback restclient.Config carries one,
+    k8sapiserver.go:139-153); a 401 raises ``UnauthorizedError``.
+  * ``qps``/``burst`` — client-side token-bucket rate limiting, default
+    5000/5000 exactly like the reference's restclient.Config
+    (k8sapiserver.go:57-62); ``qps=0`` disables.
+  * a 429 (server flow control) is honored by sleeping ``Retry-After``
+    and retrying, the client-go default behavior.
 """
 from __future__ import annotations
 
 import json
 import logging
+import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Any, List, Optional, Tuple
 
 from ..errors import (AlreadyExistsError, ConflictError, NotFoundError,
-                      WatchFellBehindError)
+                      UnauthorizedError, WatchFellBehindError)
 from ..state import objects as obj
 
 log = logging.getLogger(__name__)
 
 
+class _TokenBucket:
+    """client-go flowcontrol.NewTokenBucketRateLimiter analog: ``burst``
+    capacity refilled at ``qps`` tokens/s; ``take`` blocks until a token
+    is available (client-go's Wait)."""
+
+    def __init__(self, qps: float, burst: int):
+        self.qps = float(qps)
+        self.capacity = float(max(burst, 1))
+        self._tokens = self.capacity
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def take(self) -> None:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.capacity,
+                               self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return
+            # Sleep under the lock: queued callers drain strictly at the
+            # refill rate, which is the limiter contract. The token that
+            # matures at the end of the sleep is the one consumed.
+            wait = (1.0 - self._tokens) / self.qps
+            time.sleep(wait)
+            self._tokens = 0.0
+            self._last = time.monotonic()
+
+
 class RemoteStore:
-    def __init__(self, address: str, timeout: float = 10.0):
+    def __init__(self, address: str, timeout: float = 10.0,
+                 token: Optional[str] = None,
+                 qps: float = 5000.0, burst: int = 5000):
         self.address = address.rstrip("/")
         self.timeout = timeout
+        self.token = token
+        self._limiter = _TokenBucket(qps, burst) if qps > 0 else None
 
     # ---- wire plumbing --------------------------------------------------
 
     def _call(self, method: str, path: str, body=None,
-              timeout: Optional[float] = None):
+              timeout: Optional[float] = None, _retries: int = 2):
+        if self._limiter is not None:
+            self._limiter.take()
         data = None if body is None else json.dumps(body).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.token is not None:
+            headers["Authorization"] = f"Bearer {self.token}"
         req = urllib.request.Request(
-            self.address + path, data=data, method=method,
-            headers={"Content-Type": "application/json"})
+            self.address + path, data=data, method=method, headers=headers)
         try:
             with urllib.request.urlopen(
                     req, timeout=timeout or self.timeout) as resp:
@@ -52,6 +102,7 @@ class RemoteStore:
                     f"({len(body)} bytes)") from None
         except urllib.error.HTTPError as e:
             reason = None
+            retry_after = e.headers.get("Retry-After") if e.headers else None
             try:
                 payload = json.loads(e.read())
                 msg = payload.get("error", str(e))
@@ -60,6 +111,19 @@ class RemoteStore:
                 msg = str(e)
             if e.code == 404:
                 raise NotFoundError(msg) from None
+            if e.code == 401:
+                raise UnauthorizedError(msg) from None
+            if e.code == 429 and _retries > 0:
+                # server flow control: honor Retry-After and retry
+                # (client-go's default 429 handling)
+                try:
+                    delay = min(max(0.0, float(retry_after or 1.0)), 5.0)
+                except ValueError:
+                    delay = 1.0
+                time.sleep(delay)
+                return self._call(method, path, body=None if data is None
+                                  else json.loads(data), timeout=timeout,
+                                  _retries=_retries - 1)
             if e.code == 409:
                 # the server folds AlreadyExists and Conflict into 409
                 # and disambiguates with a structured ``reason`` field
@@ -215,6 +279,8 @@ class RemoteWatcher:
                 limit=max_n)
         except WatchFellBehindError:
             raise  # 410 — the informer's re-list contract
+        except UnauthorizedError:
+            raise  # 401 is a permanent credential error, not a transient
         except Exception:
             # Transient network failure (connection reset, server accept
             # backlog overflow, a 5xx, a stalled long-poll): the informer

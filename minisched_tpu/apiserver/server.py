@@ -29,9 +29,27 @@ store's existing CRUD + versioned watch:
     GET    /healthz
 
 Errors map to status codes: 404 NotFound, 409 AlreadyExists/Conflict,
-400 bad input. Server threads only touch the thread-safe store; the
-scheduler service runs beside it in-process, exactly like the
-reference's apiserver+scheduler pairing.
+400 bad input, 401 missing/bad bearer token (auth enabled), 429 over the
+in-flight budget (flow control). Server threads only touch the
+thread-safe store; the scheduler service runs beside it in-process,
+exactly like the reference's apiserver+scheduler pairing.
+
+Auth + flow control (reference parity): the reference wires loopback
+bearer-token authentication with an always-allow authorizer
+(reference k8sapiserver/k8sapiserver.go:139-153) and API-server flow
+control (k8sapiserver.go:203-208). The rebuild's analogs:
+
+  * ``token=...`` — every request except ``/healthz`` must carry
+    ``Authorization: Bearer <token>`` or is answered 401 with reason
+    ``Unauthorized``. Once authenticated, everything is allowed — the
+    reference's always-allow authorizer. ``token=None`` (default)
+    disables authentication, the pre-existing open-simulator behavior.
+  * ``max_inflight=N`` — at most N requests are served concurrently;
+    excess requests are answered 429 with a ``Retry-After`` header and
+    reason ``TooManyRequests`` (the k8s APF reject contract, which
+    client-go honors by sleeping and retrying). ``/healthz`` is exempt,
+    like the health probes APF's exempt priority level covers. 0 (the
+    default) disables the limit.
 """
 from __future__ import annotations
 
@@ -52,9 +70,14 @@ class APIServer:
     """Serve a ClusterStore over HTTP on localhost:port (0 = ephemeral)."""
 
     def __init__(self, store: ClusterStore, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, token: str | None = None,
+                 max_inflight: int = 0):
         self.store = store
-        handler = _make_handler(store)
+        self.token = token
+        # exposed for tests: deterministic saturation without timing games
+        self._inflight = (threading.BoundedSemaphore(max_inflight)
+                          if max_inflight > 0 else None)
+        handler = _make_handler(store, token, self._inflight)
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
@@ -78,7 +101,8 @@ class APIServer:
             self._thread = None
 
 
-def _make_handler(store: ClusterStore):
+def _make_handler(store: ClusterStore, token: str | None = None,
+                  inflight: threading.BoundedSemaphore | None = None):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -87,23 +111,27 @@ def _make_handler(store: ClusterStore):
         def log_message(self, fmt, *args):  # route through logging, quiet
             log.debug("apiserver: " + fmt, *args)
 
-        def _send(self, code: int, payload) -> None:
+        def _send(self, code: int, payload,
+                  headers: dict | None = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
         def _error(self, code: int, msg: str,
-                   reason: str | None = None) -> None:
+                   reason: str | None = None,
+                   headers: dict | None = None) -> None:
             # ``reason`` is the client-go status-reason analog: clients
             # switch on it structurally instead of sniffing message text
             # (409 folds AlreadyExists and Conflict into one code).
             body = {"error": msg}
             if reason is not None:
                 body["reason"] = reason
-            self._send(code, body)
+            self._send(code, body, headers=headers)
 
         def _body(self):
             n = int(self.headers.get("Content-Length", "0"))
@@ -135,9 +163,64 @@ def _make_handler(store: ClusterStore):
                 log.exception("apiserver internal error")
                 self._error(500, f"{type(e).__name__}: {e}")
 
+        # ---- auth + flow-control gate -----------------------------------
+
+        def _drain_body(self) -> None:
+            """Consume an unread request body before answering early
+            (401/429): with keep-alive HTTP/1.1 the leftover bytes would
+            otherwise be parsed as the NEXT request line, desyncing the
+            connection for pipelining clients."""
+            n = int(self.headers.get("Content-Length", "0") or 0)
+            if n:
+                self.rfile.read(n)
+
+        def _gated(self, fn) -> None:
+            """Run one request through authn (bearer token) and flow
+            control (bounded in-flight); /healthz bypasses both so health
+            probes stay useful under load and without credentials."""
+            route = urlparse(self.path).path.strip("/")
+            if route == "healthz":
+                return fn()
+            if token is not None:
+                auth = self.headers.get("Authorization", "")
+                if auth != f"Bearer {token}":
+                    self._drain_body()
+                    return self._error(
+                        401, "missing or invalid bearer token",
+                        reason="Unauthorized")
+            # Long-running requests are EXEMPT from the in-flight budget,
+            # exactly like upstream's max-in-flight filter exempts WATCH:
+            # a single long-poll would otherwise pin a slot for its whole
+            # timeout and starve all CRUD traffic at small budgets.
+            if inflight is None or route == "watch":
+                return fn()
+            if not inflight.acquire(blocking=False):
+                # the k8s APF reject: 429 + Retry-After; client-go sleeps
+                # and retries, and so does RemoteStore
+                self._drain_body()
+                return self._error(429, "too many in-flight requests",
+                                   reason="TooManyRequests",
+                                   headers={"Retry-After": "1"})
+            try:
+                fn()
+            finally:
+                inflight.release()
+
         # ---- verbs ------------------------------------------------------
 
         def do_GET(self):
+            self._gated(self._get)
+
+        def do_POST(self):
+            self._gated(self._post)
+
+        def do_PUT(self):
+            self._gated(self._put)
+
+        def do_DELETE(self):
+            self._gated(self._delete)
+
+        def _get(self):
             kind, key, q = self._route()
             if kind == "healthz":
                 return self._send(200, {"ok": True})
@@ -201,7 +284,7 @@ def _make_handler(store: ClusterStore):
                           for k, objs in lists.items()},
                 "cursor": cursor})
 
-        def do_POST(self):
+        def _post(self):
             kind, key, q = self._route()
             if kind == "bind":
                 def run():
@@ -229,7 +312,7 @@ def _make_handler(store: ClusterStore):
                     self._send(201, obj.to_dict(created))
             self._guard(run)
 
-        def do_PUT(self):
+        def _put(self):
             kind, key, _q = self._route()
             if kind is None or not key:
                 return self._error(404, "no route")
@@ -250,7 +333,7 @@ def _make_handler(store: ClusterStore):
                 self._send(200, obj.to_dict(updated))
             self._guard(run)
 
-        def do_DELETE(self):
+        def _delete(self):
             kind, key, _q = self._route()
             if kind is None or not key:
                 return self._error(404, "no route")

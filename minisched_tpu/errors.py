@@ -13,6 +13,11 @@ class AlreadyExistsError(RuntimeError):
     """Create of an object whose key already exists."""
 
 
+class UnauthorizedError(RuntimeError):
+    """Request rejected by the apiserver's bearer-token authentication
+    (HTTP 401; reference loopback auth, k8sapiserver.go:139-153)."""
+
+
 class WatchFellBehindError(ValueError):
     """A watch cursor fell behind the store's retained event log — the
     client must re-list and restart (the k8s 410 Gone contract).

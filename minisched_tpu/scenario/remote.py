@@ -45,7 +45,10 @@ def serve(store_only: bool = False) -> None:
             backoff_initial_s=0.1, backoff_max_s=0.5, batch_window_s=0.0))
     api = APIServer(store,
                     host=os.environ.get("MINISCHED_API_HOST", "127.0.0.1"),
-                    port=int(os.environ.get("MINISCHED_API_PORT", "0"))
+                    port=int(os.environ.get("MINISCHED_API_PORT", "0")),
+                    token=os.environ.get("MINISCHED_API_TOKEN") or None,
+                    max_inflight=int(os.environ.get(
+                        "MINISCHED_API_MAX_INFLIGHT", "0"))
                     ).start()
     print(f"LISTENING {api.address}", flush=True)
     try:
@@ -70,9 +73,12 @@ def _wait(pred, timeout: float = 30.0, interval: float = 0.1):
 
 def run_remote_scenario(address: str) -> None:
     """The README scenario (reference sched.go:70-143), over HTTP."""
+    import os
+
     from ..apiserver import RemoteStore
 
-    rs = RemoteStore(address)
+    rs = RemoteStore(address,
+                     token=os.environ.get("MINISCHED_API_TOKEN") or None)
     _wait(rs.healthz, timeout=15)
 
     rs.create_many([obj.Node(
@@ -119,11 +125,14 @@ def run_client_engine_scenario(address: str) -> None:
     to a store-only server over RemoteStore — informers long-poll
     /watch, failures update pods over PUT, bindings commit through
     /bind — then the README scenario runs against the same wire."""
+    import os
+
     from ..apiserver import RemoteStore
     from ..config import SchedulerConfig
     from ..service.service import SchedulerService
 
-    rs = RemoteStore(address)
+    rs = RemoteStore(address,
+                     token=os.environ.get("MINISCHED_API_TOKEN") or None)
     _wait(rs.healthz, timeout=15)
     svc = SchedulerService(rs)
     svc.start_scheduler(config=SchedulerConfig(
