@@ -604,10 +604,10 @@ def run_child() -> None:
             nfp, _ = pcache.snapshot(pad=n_pad)
             afp = pcache.snapshot_assigned()
             pop = build_preempt_op(ps_p)
-            chosen_p, ok_p, _cnt = pop(ebp, nfp, afp)
+            chosen_p, ok_p, _cnt, _sev = pop(ebp, nfp, afp)
             jax.block_until_ready(chosen_p)
             t0 = time.perf_counter()
-            chosen_p, ok_p, _cnt = pop(ebp, nfp, afp)
+            chosen_p, ok_p, _cnt, _sev = pop(ebp, nfp, afp)
             jax.block_until_ready(chosen_p)
             detail["preempt_device_s"] = round(time.perf_counter() - t0, 4)
             detail["preempt_candidates_found"] = int(np.asarray(ok_p).sum())

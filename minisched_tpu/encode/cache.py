@@ -99,10 +99,12 @@ class NodeFeatureCache:
         self._key_gang: Dict[str, str] = {}
         # Required anti-affinity terms of RUNNING pods (upstream symmetric
         # enforcement): sig=(key_idx, ns_hash, sel_pairs) → {node row:
-        # count of bound pods holding that term on that row}. Feeds
-        # anti_forbidden_for → encode.anti_forbid slots.
-        self._anti_terms: Dict[tuple, Dict[int, int]] = {}
-        self._pod_anti: Dict[str, List[tuple]] = {}  # pod key → sigs
+        # [owner priorities]} (a multiset — add/drop stay exact). Feeds
+        # anti_forbidden_for → encode.anti_forbid slots incl. the
+        # preemption-curability columns (owner row + max priority).
+        self._anti_terms: Dict[tuple, Dict[int, List[int]]] = {}
+        # pod key → (priority, sigs)
+        self._pod_anti: Dict[str, Tuple[int, List[tuple]]] = {}
         # Encoding-slot overflow reports: deduplicated and bounded — bind
         # churn re-reports the same pod's overflow on every account_bind,
         # and nothing drains this sink in production.
@@ -599,21 +601,31 @@ class NodeFeatureCache:
     def _anti_add_locked(self, pod: Pod, row: int) -> None:
         sigs = self._anti_sigs(pod)
         if sigs:
-            self._pod_anti[pod.key] = sigs
+            pri = int(pod.spec.priority)
+            self._pod_anti[pod.key] = (pri, sigs)
             for sig in sigs:
                 rows = self._anti_terms.setdefault(sig, {})
-                rows[row] = rows.get(row, 0) + 1
+                # per-row multiset of owner priorities: O(distinct sigs)
+                # aggregation in anti_forbidden_for, exact max on drop
+                rows.setdefault(row, []).append(pri)
 
     def _anti_drop_locked(self, pod_key: str, row: int) -> None:
-        for sig in self._pod_anti.pop(pod_key, ()):
+        entry = self._pod_anti.pop(pod_key, None)
+        if entry is None:
+            return
+        pri, sigs = entry
+        for sig in sigs:
             rows = self._anti_terms.get(sig)
             if not rows:
                 continue
-            n = rows.get(row, 0) - 1
-            if n > 0:
-                rows[row] = n
-            else:
-                rows.pop(row, None)
+            pris = rows.get(row)
+            if pris:
+                try:
+                    pris.remove(pri)
+                except ValueError:
+                    pass
+                if not pris:
+                    rows.pop(row, None)
             if not rows:
                 self._anti_terms.pop(sig, None)
 
@@ -645,19 +657,66 @@ class NodeFeatureCache:
             out.sort(key=lambda t: t[2])
             return out
 
+    def bound_keys_on(self, node_name: str) -> List[str]:
+        """Keys of ALL bound/assumed pods on ``node_name`` — preemption's
+        cure verification scans these (not just the evictable victim
+        pool) so an unevictable repeller (gang member, priority race)
+        fails the cure closed instead of being silently skipped."""
+        with self._lock:
+            i = self._index.get(node_name)
+            if i is None:
+                return []
+            return [k for k, v in self._bound.items() if v[0] == i]
+
+    def repelling_owners_on(self, node_name: str, pod: Pod) -> List[str]:
+        """Keys of bound pods ON ``node_name`` whose required
+        anti-affinity term matches ``pod`` (the symmetric existing-pod
+        direction) — preemption's mandatory victim set for curing an
+        anti_forbid slot at that node (ops/preempt.py). Term semantics
+        mirror anti_forbidden_for."""
+        with self._lock:
+            i = self._index.get(node_name)
+            if i is None or not self._pod_anti:
+                return []
+            ns_h = (F._h(pod.metadata.namespace)
+                    if pod.metadata.namespace else 0)
+            labels = {F.pair_hash(k, v)
+                      for k, v in pod.metadata.labels.items()}
+            out: List[str] = []
+            for owner_key, (_pri, sigs) in self._pod_anti.items():
+                entry = self._bound.get(owner_key)
+                if entry is None or entry[0] != i:
+                    continue
+                for (_key_idx, ns, pairs) in sigs:
+                    if ns != 0 and ns != ns_h:
+                        continue
+                    if all(p in labels for p in pairs):
+                        out.append(owner_key)
+                        break
+            return out
+
     def free_of(self, node_name: str) -> Optional[np.ndarray]:
         """Current free-resource vector of one node (copy), or None."""
         with self._lock:
             i = self._index.get(node_name)
             return None if i is None else self._feats.free[i].copy()
 
-    def anti_forbidden_for(self, pod: Pod) -> List[Tuple[int, int]]:
-        """(key_idx, domain) pairs the pod must avoid: domains holding a
-        RUNNING pod whose required anti-affinity term matches this pod
-        (upstream existing-pod anti-affinity symmetry; term semantics
-        mirror the device side: empty selector = match-all, term namespace
-        defaults to the owner pod's). Feeds encode.anti_forbid slots via
-        the engine's encode callback."""
+    def anti_forbidden_for(self, pod: Pod
+                           ) -> List[Tuple[int, int, int, int]]:
+        """(key_idx, domain, owner_row, owner_maxpri) entries the pod must
+        avoid: domains holding a RUNNING pod whose required anti-affinity
+        term matches this pod (upstream existing-pod anti-affinity
+        symmetry; term semantics mirror the device side: empty selector =
+        match-all, term namespace defaults to the owner pod's). Feeds
+        encode.anti_forbid slots via the engine's encode callback.
+
+        The two trailing fields feed preemption curability
+        (ops/preempt.py): ``owner_row`` is the single node row holding
+        EVERY owner of the (key, domain) entry, or -1 when owners span
+        nodes — upstream DefaultPreemption evicts node-local victims
+        only, so a multi-node ownership cannot be cured;
+        ``owner_maxpri`` is the highest owner priority (a preemptor must
+        outrank every owner). The sentinel entry is (-1, -1, -1, 0)."""
         with self._lock:
             if not self._anti_terms:
                 return []
@@ -666,8 +725,9 @@ class NodeFeatureCache:
                     if pod.metadata.namespace else 0)
             labels = {F.pair_hash(k, v)
                       for k, v in pod.metadata.labels.items()}
-            out: List[Tuple[int, int]] = []
-            seen = set()
+            # (key_idx, dom) → [single_row_or_-1, max_priority]
+            agg: Dict[Tuple[int, int], list] = {}
+            sentinel = False
             for (key_idx, ns, pairs), rows in self._anti_terms.items():
                 # ns 0 = any-namespace wildcard, mirroring the device
                 # group convention (a term owner with no namespace).
@@ -678,16 +738,26 @@ class NodeFeatureCache:
                 if key_idx < 0:
                     # Unrepresentable term (registry was full when its
                     # owner bound): forbidden domains unknown — emit the
-                    # sentinel so the engine fails closed for this pod.
-                    if (-1, -1) not in seen:
-                        seen.add((-1, -1))
-                        out.append((-1, -1))
+                    # sentinel so the engine fails closed.
+                    sentinel = True
                     continue
-                for row in rows:
+                for row, pris in rows.items():
                     dom = int(self._feats.topo_domains[key_idx, row])
-                    if dom >= 0 and (key_idx, dom) not in seen:
-                        seen.add((key_idx, dom))
-                        out.append((key_idx, dom))
+                    if dom < 0 or not pris:
+                        continue
+                    pri = max(pris)
+                    cur = agg.get((key_idx, dom))
+                    if cur is None:
+                        agg[(key_idx, dom)] = [row, pri]
+                    else:
+                        if cur[0] != row:
+                            cur[0] = -1  # owners span nodes: incurable
+                        cur[1] = max(cur[1], pri)
+            out: List[Tuple[int, int, int, int]] = []
+            if sentinel:
+                out.append((-1, -1, -1, 0))
+            for (key_idx, dom), (row, pri) in agg.items():
+                out.append((key_idx, dom, row, pri))
             return out
 
     # ---- internals ------------------------------------------------------

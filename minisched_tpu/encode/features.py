@@ -282,6 +282,12 @@ class PodFeatures(NamedTuple):
     # pod must avoid because a RUNNING pod's required anti term matches it.
     anti_forbid_key: np.ndarray  # (P,S) i32 topology-key idx, -1 unused
     anti_forbid_dom: np.ndarray  # (P,S) i32 domain id under that key
+    # Preemption curability of the slot (ops/preempt.py): the single node
+    # row holding ALL owners of the forbidding term(s), -1 when owners
+    # span nodes (then no node-local eviction can cure it), and the max
+    # owner priority (a preemptor must outrank every owner to evict).
+    anti_forbid_row: np.ndarray     # (P,S) i32
+    anti_forbid_maxpri: np.ndarray  # (P,S) i32
 
 
 class GroupFeatures(NamedTuple):
@@ -787,6 +793,8 @@ def encode_pods(pods: List[Pod], p_pad: int,
         anti_pref_weight=np.zeros((P, T), dtype=np.float32),
         anti_forbid_key=np.full((P, cfg.max_anti_forbid), -1, dtype=np.int32),
         anti_forbid_dom=np.full((P, cfg.max_anti_forbid), -1, dtype=np.int32),
+        anti_forbid_row=np.full((P, cfg.max_anti_forbid), -1, dtype=np.int32),
+        anti_forbid_maxpri=np.zeros((P, cfg.max_anti_forbid), dtype=np.int32),
     )
     gang_group = np.full(P, -1, dtype=np.int32)
     gang_ids: Dict[str, int] = {}
@@ -915,9 +923,15 @@ def encode_pods(pods: List[Pod], p_pad: int,
                 overflow.append(
                     f"pod {pod.key} anti-affinity forbidden domains: "
                     f"{len(pairs)} > {cfg.max_anti_forbid} slots")
-            for s, (fk, fd) in enumerate(pairs[:cfg.max_anti_forbid]):
-                f.anti_forbid_key[i, s] = fk
-                f.anti_forbid_dom[i, s] = fd
+            for s, entry in enumerate(pairs[:cfg.max_anti_forbid]):
+                # (key, dom) legacy pairs or (key, dom, owner_row,
+                # owner_maxpri) — the extended form feeds preemption
+                # curability (ops/preempt.py).
+                f.anti_forbid_key[i, s] = entry[0]
+                f.anti_forbid_dom[i, s] = entry[1]
+                if len(entry) >= 4:
+                    f.anti_forbid_row[i, s] = entry[2]
+                    f.anti_forbid_maxpri[i, s] = entry[3]
 
         anti = aff.pod_anti_affinity if aff else None
         if anti:

@@ -51,18 +51,20 @@ _SPREAD_REVOKE_MSG = (
 
 
 @jax.jit
-def _pack_decision(chosen, assigned, gang_rejected, feasible, rejects):
-    """Fuse the per-pod step outputs into one (4+F, P) i32 array so the
+def _pack_decision(chosen, assigned, gang_rejected, feasible,
+                   feasible_static, rejects):
+    """Fuse the per-pod step outputs into one (5+F, P) i32 array so the
     host fetches ONE buffer per batch. On a remote-TPU tunnel every
-    separate np.asarray is a device round trip; five fetches of small
-    arrays cost ~4 extra latencies — measured ~0.27 s/batch at 10k pods,
+    separate np.asarray is a device round trip; six fetches of small
+    arrays cost ~5 extra latencies — measured ~0.27 s/batch at 10k pods,
     on par with the entire device compute."""
     import jax.numpy as jnp
 
     head = jnp.stack([chosen.astype(jnp.int32),
                       assigned.astype(jnp.int32),
                       gang_rejected.astype(jnp.int32),
-                      feasible.astype(jnp.int32)])
+                      feasible.astype(jnp.int32),
+                      feasible_static.astype(jnp.int32)])
     return jnp.concatenate([head, rejects.astype(jnp.int32)], axis=0)
 
 
@@ -490,6 +492,9 @@ class Scheduler:
         # pin capacity forever). Guarded by its own lock — the binder
         # thread clears entries while the scheduling thread debits them.
         self._nominations: Dict[str, tuple] = {}
+        # preemption wins per pending pod without a successful bind
+        # (cleared on bind/delete; see _PREEMPT_MAX_ROUNDS)
+        self._preempt_rounds: Dict[str, int] = {}
         self._nom_lock = threading.Lock()
         # Which encode-side fail-closed verdicts apply: only constraints
         # this profile's plugin set actually enforces may park a pod.
@@ -661,7 +666,7 @@ class Scheduler:
 
             def anti_fn(pod: Pod) -> List[tuple]:
                 pairs = self.cache.anti_forbidden_for(pod)
-                if any(k < 0 for k, _ in pairs):
+                if any(entry[0] < 0 for entry in pairs):
                     # (-1, -1) sentinel: a running pod's matching anti term
                     # has an unregistrable topology key — permanent until
                     # that pod leaves, not a domain-count problem.
@@ -740,7 +745,8 @@ class Scheduler:
         # comparable to the whole device compute).
         packed_dev = _pack_decision(
             decision.chosen, decision.assigned, decision.gang_rejected,
-            decision.feasible_counts, decision.reject_counts)
+            decision.feasible_counts, decision.feasible_static,
+            decision.reject_counts)
         spread_dev = (_pack_spread(decision.spread_pre, decision.spread_dom,
                                    decision.spread_min)
                       if self._spread_enabled else None)
@@ -754,7 +760,8 @@ class Scheduler:
         assigned = packed[1].astype(bool)
         gang_rejected = packed[2].astype(bool)
         feasible = packed[3]
-        rejects = packed[4:]
+        feasible_static = packed[4]
+        rejects = packed[5:]
         sp = (np.array(spread_dev) if spread_dev is not None else None)
 
         if sample_k is not None:
@@ -769,7 +776,8 @@ class Scheduler:
             if res_rows.size:
                 self._run_residual(
                     eb, nf, af, key, res_rows, decision,
-                    chosen, assigned, gang_rejected, feasible, rejects, sp)
+                    chosen, assigned, gang_rejected, feasible,
+                    feasible_static, rejects, sp)
         t_step = time.perf_counter()
 
         if self.recorder is not None:
@@ -871,6 +879,7 @@ class Scheduler:
         assigned_l = assigned[:len(batch)].tolist()
         gang_rejected_l = gang_rejected[:len(batch)].tolist()
         feasible_l = feasible[:len(batch)].tolist()
+        static_l = feasible_static[:len(batch)].tolist()
         n_ghost = 0  # assigned rows lost to a mid-cycle node deletion
         for i, qpi in enumerate(batch):
             if i in revoked:
@@ -912,7 +921,7 @@ class Scheduler:
                     qpi, plugins,
                     f"gang {qpi.pod.spec.pod_group} missed quorum "
                     f"{qpi.pod.spec.pod_group_min}", retryable=False)
-            elif feasible_l[i] > 0:
+            elif feasible_l[i] > 0 and static_l[i] > 0:
                 # Nodes were feasible but earlier pods in the batch took the
                 # capacity — retryable, not unschedulable (SURVEY §7
                 # "batch-internal causality").
@@ -923,6 +932,16 @@ class Scheduler:
             else:
                 plugins = {self.filter_names[f] for f in range(rejects.shape[0])
                            if rejects[f, i] > 0} or {BATCH_CAPACITY}
+                if feasible_l[i] > 0:
+                    # The in-scan caps deferred the static skew check, so
+                    # the filter passed nodes the scan then refused under
+                    # the SAME pre-batch counts (feasible_static == 0):
+                    # the pod is statically over-skew everywhere, not
+                    # batch-contended — a terminal PodTopologySpread
+                    # verdict (which preemption below may cure by
+                    # evicting matching pods), never an endless
+                    # BATCH_CAPACITY retry loop.
+                    plugins = {"PodTopologySpread"}
                 # PostFilter (DefaultPreemption): defer the terminal
                 # verdict — a batched victim-candidate search may free
                 # capacity by evicting lower-priority pods. Gang members
@@ -1090,7 +1109,7 @@ class Scheduler:
 
     def _run_residual(self, eb, nf, af, key, rows, decision,
                       chosen, assigned, gang_rejected, feasible,
-                      rejects, sp) -> None:
+                      feasible_static, rejects, sp) -> None:
         """Full-axis re-evaluation of sampled-out pods, merged in place.
 
         The residual sub-batch reuses the batch's group tables (same gf/
@@ -1105,12 +1124,13 @@ class Scheduler:
                                   jax.random.fold_in(key, 0x5e5))
         p2 = np.asarray(_pack_decision(
             d2.chosen, d2.assigned, d2.gang_rejected,
-            d2.feasible_counts, d2.reject_counts))
+            d2.feasible_counts, d2.feasible_static, d2.reject_counts))
         chosen[rows] = p2[0][:n_res]
         assigned[rows] = p2[1][:n_res].astype(bool)
         gang_rejected[rows] = p2[2][:n_res].astype(bool)
         feasible[rows] = p2[3][:n_res]
-        rejects[:, rows] = p2[4:][:, :n_res]
+        feasible_static[rows] = p2[4][:n_res]
+        rejects[:, rows] = p2[5:][:, :n_res]
         if sp is not None and sp.shape[0] > 1:
             sp2 = np.asarray(_pack_spread(
                 d2.spread_pre, d2.spread_dom, d2.spread_min))
@@ -1168,7 +1188,7 @@ class Scheduler:
                          jax.random.fold_in(self._key, self._step_counter))
             p2 = np.asarray(_pack_decision(
                 d2.chosen, d2.assigned, d2.gang_rejected,
-                d2.feasible_counts, d2.reject_counts))
+                d2.feasible_counts, d2.feasible_static, d2.reject_counts))
             n_r = len(rows)
             chosen2 = p2[0]
             assigned2 = p2[1].astype(bool)
@@ -1268,9 +1288,10 @@ class Scheduler:
 
         op = build_preempt_op(self.plugin_set, cfg=self.cache.cfg)
         eb2, _p2 = self._slice_eb(eb, rows)
-        chosen_d, ok_d, _cnt = op(eb2, nf, af)
+        chosen_d, ok_d, _cnt, sev_d = op(eb2, nf, af)
         chosen = np.asarray(chosen_d)
         ok = np.asarray(ok_d)
+        spread_evict = np.asarray(sev_d)
 
         won: Set[int] = set()
         taken: Set[str] = set()  # victims already evicted this cycle
@@ -1299,8 +1320,21 @@ class Scheduler:
                 self.drop_nomination(qpi.pod.key)
                 won.add(i)  # already bound elsewhere — no verdict needed
                 continue
+            # Rounds cap: a cure the host could not honor (unevictable
+            # repeller, device hashed-match broader than exact host
+            # semantics) would otherwise evict-and-retry forever; after
+            # _PREEMPT_MAX_ROUNDS wins without a bind, the terminal
+            # verdict stands.
+            if (self._preempt_rounds.get(qpi.pod.key, 0)
+                    >= self._PREEMPT_MAX_ROUNDS):
+                log.warning("preemption: %s exceeded %d rounds without "
+                            "binding; giving up", qpi.pod.key,
+                            self._PREEMPT_MAX_ROUNDS)
+                self.drop_nomination(qpi.pod.key)
+                continue
             victims = self._select_victims(qpi.pod, node_name, taken,
-                                           pdb_state)
+                                           pdb_state,
+                                           spread_evict=spread_evict[j])
             if victims is None:
                 continue  # candidates raced away — terminal verdict stands
             if not victims:
@@ -1352,10 +1386,13 @@ class Scheduler:
                 retryable=True)
             log.info("preemption: %s evicted %d pod(s) on %s",
                      qpi.pod.key, len(victims), node_name)
+            self._preempt_rounds[qpi.pod.key] = (
+                self._preempt_rounds.get(qpi.pod.key, 0) + 1)
             won.add(i)
         return won
 
     _NOMINATION_TTL_S = 60.0
+    _PREEMPT_MAX_ROUNDS = 3
 
     def drop_nomination(self, pod_key: str) -> None:
         """Release a preemptor's capacity reservation (pod bound, deleted,
@@ -1365,6 +1402,7 @@ class Scheduler:
         if self._nominations:
             with self._nom_lock:
                 self._nominations.pop(pod_key, None)
+        self._preempt_rounds.pop(pod_key, None)
 
     def _nomination_debits(self, batch_keys: Set[str], names, nf):
         """(N,R) capacity reserved by OUT-OF-BATCH nominees (expired and
@@ -1431,18 +1469,23 @@ class Scheduler:
 
     def _select_victims(self, pod, node_name: str, taken: Set[str],
                         pdb_state: Optional[List[list]] = None,
-                        ) -> Optional[List[str]]:
-        """Minimal victim prefix on ``node_name``: evict lowest-priority
-        pods first (upstream's order) until the node's free vector covers
-        the preemptor's request on every axis. None when the candidates
-        no longer suffice (state raced since the device search).
+                        spread_evict=None) -> Optional[List[str]]:
+        """Victim set on ``node_name``: the MANDATORY topology victims
+        (pods whose presence rejects the preemptor — its own required
+        anti-affinity matches, the symmetric repelling-term owners, and
+        ``spread_evict[c]`` matching pods per over-skew spread slot),
+        then lowest-priority-first capacity top-up until the node's free
+        vector covers the preemptor's request on every axis (upstream's
+        order). None when the candidates no longer suffice (state raced
+        since the device search) or a mandatory victim is unavailable.
 
         PodDisruptionBudgets (upstream policy/v1): a victim whose
         eviction would drop a matching budget below min_available is
         skipped in the first pass and permitted only when no
         non-violating victim set suffices — upstream DefaultPreemption's
         minimize-violations ordering (violating victims rank last but
-        preemption is not forbidden outright). On success the shared
+        preemption is not forbidden outright; a PDB-protected MANDATORY
+        victim therefore fails pass 1 outright). On success the shared
         ``pdb_state`` rows are debited so later preemptors in the SAME
         cycle see the budget the earlier evictions consumed."""
         from ..encode import features as F
@@ -1464,23 +1507,115 @@ class Scheduler:
         cands = [(k, r) for k, r, _p in self.cache.victims_below(
             node_name, pod.spec.priority) if k not in taken]
 
+        anti = (pod.spec.affinity.pod_anti_affinity.required
+                if (pod.spec.affinity
+                    and pod.spec.affinity.pod_anti_affinity) else [])
+        spread_slots = []  # (constraint, count) with count > 0
+        if spread_evict is not None:
+            cons = pod.spec.topology_spread_constraints
+            for c, e in enumerate(np.asarray(spread_evict).tolist()):
+                if e > 0 and c < len(cons):
+                    spread_slots.append((cons[c], int(np.ceil(e))))
+
+        req_of = dict(cands)
+
         # Candidate pod identity (namespace, labels) fetched ONCE — not
         # per pass per candidate; store.get deep-copies the object tree.
+        # The anti-affinity cure check needs identity for EVERY bound pod
+        # on the node, not just the evictable pool: an unevictable
+        # repeller (gang member, priority race, a device/host selector-
+        # semantics gap) must fail the cure closed, never be skipped.
         meta: Dict[str, tuple] = {}
-        if pdb_state:
-            for key, _req in cands:
+        meta_keys: List[str] = [k for k, _ in cands]
+        if anti:
+            seen = set(meta_keys)
+            meta_keys += [k for k in self.cache.bound_keys_on(node_name)
+                          if k not in seen and k not in taken]
+        if pdb_state or anti or spread_slots:
+            for key in meta_keys:
                 try:
                     vp = self.store.get("Pod", key)
                 except NotFoundError:
                     continue
                 meta[key] = (vp.metadata.namespace, vp.metadata.labels)
 
+        # Mandatory topology victims (preemption-curable rejections —
+        # ops/preempt.py verified curability against the step snapshot;
+        # unavailable mandatory victims here mean the state raced, a
+        # repeller is unevictable, or the device's hashed match was
+        # broader than the exact host semantics → None, no speculative
+        # eviction).
+        mandatory: List[str] = []
+        mset: Set[str] = set()
+
+        def _mand(key: str) -> bool:
+            if key in mset:
+                return True
+            if key in req_of:
+                mset.add(key)
+                mandatory.append(key)
+                return True
+            return False  # not an eligible victim (anymore)
+
+        pod_ns = pod.metadata.namespace
+        for term in anti:
+            term_ns = set(term.namespaces) if term.namespaces else {pod_ns}
+            for key in meta_keys:
+                m = meta.get(key)
+                if m is None or m[0] not in term_ns:
+                    continue
+                if (term.label_selector is None
+                        or term.label_selector.matches(m[1])):
+                    if not _mand(key):
+                        return None
+        for owner in self.cache.repelling_owners_on(node_name, pod):
+            if owner not in taken and not _mand(owner):
+                return None
+        for tsc, count in spread_slots:
+            got = sum(1 for key in mset
+                      if (m := meta.get(key)) is not None
+                      and m[0] == pod_ns
+                      and (tsc.label_selector is None
+                           or tsc.label_selector.matches(m[1])))
+            for key, _req in cands:  # lowest priority first
+                if got >= count:
+                    break
+                if key in mset:
+                    continue
+                m = meta.get(key)
+                if (m is not None and m[0] == pod_ns
+                        and (tsc.label_selector is None
+                             or tsc.label_selector.matches(m[1]))):
+                    if _mand(key):
+                        got += 1
+            if got < count:
+                return None  # not enough matching victims anymore
+
         def attempt(allow_violations: bool):
             acc = free0
             victims: List[str] = []
             budgets = [list(b) for b in (pdb_state or [])]
             deferred: List[tuple] = []
+            # Mandatory victims first — they are the cure, not a
+            # capacity choice, so the fits-already early-exit below must
+            # never skip them. A PDB-protected mandatory victim fails
+            # pass 1 outright (there is no alternative victim).
+            for key in mandatory:
+                if budgets:
+                    m = meta.get(key)
+                    hit = ([b for b in budgets
+                            if b[0] == m[0]
+                            and (b[1] is None or b[1].matches(m[1]))]
+                           if m is not None else [])
+                    if any(b[2] <= 0 for b in hit) and not allow_violations:
+                        return None
+                    for b in hit:
+                        b[2] -= 1
+                acc = acc + req_of[key]
+                victims.append(key)
             for key, req in cands:
+                if key in mset:
+                    continue
                 if np.all(acc >= need):
                     break
                 if budgets:
@@ -1784,6 +1919,7 @@ class Scheduler:
             with self._nom_lock:
                 for k in bound_keys:
                     self._nominations.pop(k, None)
+                    self._preempt_rounds.pop(k, None)
         ok = keyed
         if len(bound_keys) != len(keyed):  # rare: some skipped mid-flight
             ok = []

@@ -38,6 +38,14 @@ class Decision(NamedTuple):
     assigned: jnp.ndarray         # (P,) bool
     gang_rejected: jnp.ndarray    # (P,) bool — pod's gang missed quorum
     feasible_counts: jnp.ndarray  # (P,) i32 nodes passing all filters
+    # Nodes passing all filters WITH the UNDEFERRED hard-spread check
+    # (== feasible_counts when no in-scan caps are active). The in-scan
+    # spread caps (ops/spreadcap.py) defer the static skew check into the
+    # greedy scan, so a statically-over-skew pod shows feasible_counts>0
+    # yet the scan cannot place it; the engine uses THIS count to tell
+    # real in-batch contention (retry) from a static skew block
+    # (terminal → preemption / unschedulable with PodTopologySpread).
+    feasible_static: jnp.ndarray  # (P,) i32
     reject_counts: jnp.ndarray    # (F,P) i32 nodes rejected per filter plugin
     total_scores: jnp.ndarray     # explain: (P,N) f32 weighted sum (NEG on
     #   infeasible); else (0,N) placeholder — nothing on the scheduling
@@ -254,6 +262,8 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             caps = build_domain_caps(eb.pf, eb.gf, nf,
                                      ctx["counts_dom"], ctx["dom_exists"])
             ctx["spread_scan_groups"] = caps.scan_groups
+        spread_plugin = next(
+            (f for f in filters if f.name == "PodTopologySpread"), None)
 
         def evaluate(pf_sub):
             """Filters + scores for a pod sub-batch against the full node
@@ -275,6 +285,16 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
                 if explain:
                     masks.append(m)
             feasible_counts = feasible.sum(axis=1).astype(jnp.int32)
+            feasible_static = feasible_counts
+            if caps is not None and spread_plugin is not None:
+                # Undeferred spread verdict for terminal-vs-contention
+                # classification (Decision.feasible_static): one extra
+                # spread-filter pass, only when caps are active.
+                ctx_static = dict(ctx)
+                ctx_static.pop("spread_scan_groups", None)
+                m_static = spread_plugin.filter(pf_sub, nf, ctx_static)
+                feasible_static = (feasible & m_static).sum(
+                    axis=1).astype(jnp.int32)
             reject_counts = (jnp.stack(rc) if rc else
                              jnp.zeros((0, pf_sub.valid.shape[0]),
                                        dtype=jnp.int32))
@@ -289,7 +309,7 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
                     raws.append(raw)
                     norms.append(norm)
             return (jnp.where(feasible, total, NEG), feasible_counts,
-                    reject_counts, masks, raws, norms)
+                    feasible_static, reject_counts, masks, raws, norms)
 
         # Memory regime: the per-slot topology/affinity math materializes
         # several (P,N) f32 temps at once; at config-4 shapes (16k pods ×
@@ -312,13 +332,14 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
                 C //= 2
             pf_chunks = jax.tree_util.tree_map(
                 lambda a: a.reshape((P // C, C) + a.shape[1:]), pf)
-            mt, fc, rcs, _, _, _ = jax.lax.map(evaluate, pf_chunks)
+            mt, fc, fs, rcs, _, _, _ = jax.lax.map(evaluate, pf_chunks)
             masked_total = mt.reshape(P, N)
             feasible_counts = fc.reshape(P)
+            feasible_static = fs.reshape(P)
             reject_counts = rcs.transpose(1, 0, 2).reshape(-1, P)
             masks, raws, norms = [], [], []
         else:
-            (masked_total, feasible_counts, reject_counts,
+            (masked_total, feasible_counts, feasible_static, reject_counts,
              masks, raws, norms) = evaluate(pf)
         if assign_fn is not None:
             # Externally-supplied assignment stage (sharded chunked-gather
@@ -432,6 +453,7 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             assigned=assign.assigned,
             gang_rejected=assign.gang_rejected,
             feasible_counts=feasible_counts,
+            feasible_static=feasible_static,
             reject_counts=reject_counts,
             # The (P,N) score matrix is an explain-mode output: nothing on
             # the scheduling path reads it back, and materializing it as a

@@ -71,7 +71,7 @@ def build_sharded_step(plugin_set: PluginSet, mesh, eb_template, nf_template,
     stack_both = NamedSharding(mesh, P(None, POD_AXIS, NODE_AXIS))
     out_sh = Decision(
         chosen=pod_only, assigned=pod_only, gang_rejected=pod_only,
-        feasible_counts=pod_only,
+        feasible_counts=pod_only, feasible_static=pod_only,
         reject_counts=NamedSharding(mesh, P(None, POD_AXIS)),
         total_scores=both, free_after=node_res,
         spread_pre=NamedSharding(mesh, P(POD_AXIS, None)),

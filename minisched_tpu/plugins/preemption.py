@@ -13,15 +13,22 @@ batched on device (ops/preempt.py — per-(pod, node) victim-release
 feasibility over the assigned-pod corpus) and the engine commits the
 minimal victim set host-side (engine/scheduler.py preemption pass).
 
-Deviations from upstream, documented: every non-``capacity_only`` filter
-rejection is treated as INCURABLE by eviction — upstream DefaultPreemption
-simulates victim removal and therefore CAN cure inter-pod anti-affinity
-and topology-spread rejections by evicting the repelling/crowding pod,
-so a pod that upstream would place via such an eviction parks terminally
-here. This is intentional: curing those filters requires re-running the
-topology/affinity group state per candidate victim set (a per-(pod,node)
-combinatorial simulation the batched one-shot candidate search trades
-away for O(Pf·A + R·Pf·N) cost — ops/preempt.py). PodDisruptionBudgets
+Anti-affinity and topology-spread rejections ARE curable by eviction
+(upstream parity, node-local victim scope exactly like upstream's
+``SelectVictimsOnNode``): ops/preempt.py admits a candidate node when
+evicting lower-priority pods ON THAT NODE removes the rejection — the
+preemptor's own required anti-affinity matches, the symmetric
+repelling-term owners (encode.anti_forbid_row/_maxpri carry their
+location and rank), and enough spread-matching pods to bring the domain
+back under max_skew (``spread_evict`` counts) — and the engine's victim
+selection evicts those pods as a MANDATORY set before the
+capacity-driven top-up. Remaining documented deviations: other
+non-capacity filter rejections (taints, node affinity, required pod
+AFFINITY — eviction cannot create a match) stay incurable, curability is
+validated at step-snapshot freshness (the host re-validates capacity and
+mandatory-victim availability, not domain-wide topology), and victim
+ordering does not protect a pod that supplies the preemptor's own
+required affinity. PodDisruptionBudgets
 ARE modeled (policy/v1 min_available form, state/objects.py): a victim
 whose eviction would drop a matching budget below min_available is
 chosen only when no non-violating victim set suffices — upstream

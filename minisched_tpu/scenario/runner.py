@@ -98,13 +98,15 @@ class Cluster:
 
     def create_pod(self, name: str, *, namespace: str = "default",
                    cpu: float = 100, memory: float = 0,
+                   labels: Optional[dict] = None,
                    spec: Optional[obj.PodSpec] = None, **spec_kwargs) -> obj.Pod:
         if spec is None:
             requests = {"cpu": cpu}
             if memory:
                 requests["memory"] = memory
             spec = obj.PodSpec(requests=requests, **spec_kwargs)
-        pod = obj.Pod(metadata=obj.ObjectMeta(name=name, namespace=namespace),
+        pod = obj.Pod(metadata=obj.ObjectMeta(name=name, namespace=namespace,
+                                              labels=labels or {}),
                       spec=spec)
         return self.store.create(pod)
 

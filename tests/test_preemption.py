@@ -53,7 +53,7 @@ def test_op_finds_node_with_fewest_lower_priority_victims():
     ps = PluginSet([NodeUnschedulable(), NodeResourcesFit()])
     pr = pod("preemptor", cpu=100); pr.spec.priority = 10
     eb, nf, af, names = _op_inputs(c, [pr])
-    chosen, ok, cnt = build_preempt_op(ps)(eb, nf, af)
+    chosen, ok, cnt, _sev = build_preempt_op(ps)(eb, nf, af)
     assert bool(np.asarray(ok)[0])
     # n1 has exactly ONE evictable lower-priority victim (fewest)
     assert names[int(np.asarray(chosen)[0])] == "pr-n1"
@@ -76,7 +76,7 @@ def test_op_respects_non_capacity_filters_and_priority_bar():
                     NodeResourcesFit()])
     pr = pod("pr2", cpu=100); pr.spec.priority = 10
     eb, nf, af, _names = _op_inputs(c, [pr])
-    _chosen, ok, _cnt = build_preempt_op(ps)(eb, nf, af)
+    _chosen, ok, _cnt, _sev = build_preempt_op(ps)(eb, nf, af)
     # tainted node is a hard blocker; the other has no lower-prio victims
     assert not bool(np.asarray(ok)[0])
 
@@ -409,3 +409,220 @@ def test_pdb_last_resort_minimizes_violations():
         assert sorted(v) != ["default/p1", "default/p2"], v
     finally:
         eng.shutdown()
+
+
+# ---- topology-curable preemption (upstream SelectVictimsOnNode parity) --
+
+def _anti(term_labels, key="kubernetes.io/hostname"):
+    return obj.Affinity(pod_anti_affinity=obj.PodAntiAffinity(required=[
+        obj.PodAffinityTerm(
+            label_selector=obj.LabelSelector(match_labels=term_labels),
+            topology_key=key)]))
+
+
+def _topo_cluster(extra=()):
+    c = Cluster()
+    c.start(profile=Profile(plugins=["NodeUnschedulable", "NodeResourcesFit",
+                                     "InterPodAffinity", "PodTopologySpread",
+                                     "DefaultPreemption", *extra]),
+            config=SchedulerConfig(backoff_initial_s=0.05, backoff_max_s=0.2,
+                                   max_batch_size=64, batch_window_s=0.0),
+            with_pv_controller=False)
+    return c
+
+
+def test_engine_preemption_cures_own_anti_affinity():
+    """A low-priority pod whose labels match the preemptor's required
+    anti-affinity is a MANDATORY victim: capacity alone would fit both,
+    so only the topology cure explains the eviction (upstream
+    DefaultPreemption simulates removal and places the preemptor)."""
+    c = _topo_cluster()
+    try:
+        c.create_node("ca-n0", cpu=64000)  # capacity is NOT the problem
+        c.create_pod("victim", cpu=100, priority=1,
+                     labels={"app": "db"})
+        c.wait_for_pod_bound("victim", timeout=20)
+        c.create_pod("vip", cpu=100, priority=100,
+                     affinity=_anti({"app": "db"}))
+        bound = c.wait_for_pod_bound("vip", timeout=30)
+        assert bound.spec.node_name == "ca-n0"
+        # the repelling pod was evicted (the cure), not co-located
+        assert all(p.metadata.name != "victim" for p in c.list_pods())
+    finally:
+        c.shutdown()
+
+
+def test_engine_anti_cure_requires_outranking_every_repeller():
+    c = _topo_cluster()
+    try:
+        c.create_node("cb-n0", cpu=64000)
+        c.create_pod("guard", cpu=100, priority=100,
+                     labels={"app": "db"})
+        c.wait_for_pod_bound("guard", timeout=20)
+        c.create_pod("mid", cpu=100, priority=10,
+                     affinity=_anti({"app": "db"}))
+        p = c.wait_for_pod_pending("mid", timeout=10)
+        assert "InterPodAffinity" in p.status.unschedulable_plugins
+        assert any(q.metadata.name == "guard" for q in c.list_pods())
+    finally:
+        c.shutdown()
+
+
+def test_engine_anti_cure_blocked_by_offnode_domain_matcher():
+    """Zone-scoped anti term: a matching pod on ANOTHER node of the zone
+    cannot be evicted by a node-local victim set (upstream scope), so
+    preemption must not fire and the preemptor parks."""
+    ZONE = "topology.kubernetes.io/zone"
+    c = _topo_cluster()
+    try:
+        c.create_node("cz-n0", cpu=64000, labels={ZONE: "z1"})
+        c.create_node("cz-n1", cpu=64000, labels={ZONE: "z1"})
+        c.create_pod("m0", cpu=100, priority=1, labels={"app": "db"},
+                     node_selector={"kubernetes.io/hostname": "cz-n0"})
+        c.create_pod("m1", cpu=100, priority=1, labels={"app": "db"},
+                     node_selector={"kubernetes.io/hostname": "cz-n1"})
+        c.wait_for_pod_bound("m0", timeout=20)
+        c.wait_for_pod_bound("m1", timeout=20)
+        c.create_pod("vip", cpu=100, priority=100,
+                     affinity=_anti({"app": "db"}, key=ZONE))
+        p = c.wait_for_pod_pending("vip", timeout=10)
+        assert "InterPodAffinity" in p.status.unschedulable_plugins
+        assert sum(1 for q in c.list_pods()
+                   if q.metadata.name.startswith("m")) == 2
+    finally:
+        c.shutdown()
+
+
+def test_engine_preemption_cures_symmetric_anti_affinity():
+    """A RUNNING low-priority pod whose own required anti term repels
+    the preemptor (existing-pod anti-affinity) is evicted — the
+    anti_forbid_row/_maxpri encode columns carry the owner's location
+    and rank to the device op."""
+    c = _topo_cluster()
+    try:
+        c.create_node("cs-n0", cpu=64000)
+        c.create_pod("hermit", cpu=100, priority=1,
+                     affinity=_anti({"app": "web"}))
+        c.wait_for_pod_bound("hermit", timeout=20)
+        c.create_pod("vip", cpu=100, priority=100,
+                     labels={"app": "web"})
+        bound = c.wait_for_pod_bound("vip", timeout=30)
+        assert bound.spec.node_name == "cs-n0"
+        assert all(p.metadata.name != "hermit" for p in c.list_pods())
+    finally:
+        c.shutdown()
+
+
+def test_engine_symmetric_anti_not_cured_against_higher_owner():
+    c = _topo_cluster()
+    try:
+        c.create_node("ch-n0", cpu=64000)
+        c.create_pod("hermit", cpu=100, priority=100,
+                     affinity=_anti({"app": "web"}))
+        c.wait_for_pod_bound("hermit", timeout=20)
+        c.create_pod("mid", cpu=100, priority=10, labels={"app": "web"})
+        p = c.wait_for_pod_pending("mid", timeout=10)
+        assert "InterPodAffinity" in p.status.unschedulable_plugins
+        assert any(q.metadata.name == "hermit" for q in c.list_pods())
+    finally:
+        c.shutdown()
+
+
+def test_engine_preemption_cures_spread_skew():
+    """Statically over-skew everywhere (the in-scan caps defer the
+    static check, so this also pins the feasible_static terminal
+    classification): evicting enough MATCHING pods from the chosen
+    node's zone brings it back under max_skew."""
+    ZONE = "topology.kubernetes.io/zone"
+    c = _topo_cluster()
+    try:
+        c.create_node("sp-n0", cpu=64000, labels={ZONE: "za"})
+        c.create_node("sp-n1", cpu=64000, labels={ZONE: "zb"},
+                      unschedulable=True)  # zb exists but unschedulable
+        for i in range(2):
+            c.create_pod(f"m{i}", cpu=100, priority=1,
+                         labels={"app": "s"})
+            c.wait_for_pod_bound(f"m{i}", timeout=20)
+        # za count=2, zb count=0 → skew_after on sp-n0 = 3 > 1; sp-n1 is
+        # cordoned → statically blocked everywhere. Cure: evict 2
+        # matching pods from sp-n0.
+        c.create_pod("vip", cpu=100, priority=100, labels={"app": "s"},
+                     topology_spread_constraints=[
+                         obj.TopologySpreadConstraint(
+                             max_skew=1, topology_key=ZONE,
+                             when_unsatisfiable="DoNotSchedule",
+                             label_selector=obj.LabelSelector(
+                                 match_labels={"app": "s"}))])
+        bound = c.wait_for_pod_bound("vip", timeout=30)
+        assert bound.spec.node_name == "sp-n0"
+        remaining = [p.metadata.name for p in c.list_pods()
+                     if p.metadata.name.startswith("m")]
+        assert len(remaining) == 0, remaining  # both matching pods evicted
+    finally:
+        c.shutdown()
+
+
+def test_engine_spread_block_parks_terminally_without_preemption():
+    """Same static skew block with preemption DISABLED: the pod must park
+    as unschedulable under PodTopologySpread (and revive on the pod
+    delete event) — not spin forever on BATCH_CAPACITY retries."""
+    ZONE = "topology.kubernetes.io/zone"
+    c = Cluster()
+    try:
+        c.start(profile=Profile(plugins=["NodeUnschedulable",
+                                         "NodeResourcesFit",
+                                         "PodTopologySpread"]),
+                config=SchedulerConfig(backoff_initial_s=0.05,
+                                       backoff_max_s=0.2,
+                                       max_batch_size=64,
+                                       batch_window_s=0.0),
+                with_pv_controller=False)
+        c.create_node("st-n0", cpu=64000, labels={ZONE: "za"})
+        c.create_node("st-n1", cpu=64000, labels={ZONE: "zb"},
+                      unschedulable=True)
+        for i in range(2):
+            c.create_pod(f"m{i}", cpu=100, priority=1, labels={"app": "s"})
+            c.wait_for_pod_bound(f"m{i}", timeout=20)
+        c.create_pod("late", cpu=100, labels={"app": "s"},
+                     topology_spread_constraints=[
+                         obj.TopologySpreadConstraint(
+                             max_skew=1, topology_key=ZONE,
+                             when_unsatisfiable="DoNotSchedule",
+                             label_selector=obj.LabelSelector(
+                                 match_labels={"app": "s"}))])
+        p = c.wait_for_pod_pending("late", timeout=10)
+        assert "PodTopologySpread" in p.status.unschedulable_plugins
+        # revival contract: with zb pinned at 0 by the cordon, za only
+        # admits when empty — deleting both matching pods frees the skew
+        # and the Pod DELETE events revive the parked pod
+        c.delete_pod("m0")
+        c.delete_pod("m1")
+        c.wait_for_pod_bound("late", timeout=20)
+    finally:
+        c.shutdown()
+
+
+def test_engine_anti_cure_fails_closed_on_unevictable_gang_repeller():
+    """The device op counts every lower-priority pod as evictable, but
+    gang members are never victims: the host cure-verification must
+    scan ALL bound pods on the node and fail closed — no eviction of
+    unrelated pods, no endless evict-retry loop."""
+    c = _topo_cluster()
+    try:
+        c.create_node("cg-n0", cpu=64000)
+        # gang member with the repelling labels (priority 1 — the device
+        # sees it as evictable; the host must refuse)
+        c.create_pod("gmember", cpu=100, priority=1, labels={"app": "db"},
+                     pod_group="g1", pod_group_min=1)
+        c.wait_for_pod_bound("gmember", timeout=20)
+        # innocent bystander the broken path would have evicted
+        c.create_pod("bystander", cpu=100, priority=1)
+        c.wait_for_pod_bound("bystander", timeout=20)
+        c.create_pod("vip", cpu=100, priority=100,
+                     affinity=_anti({"app": "db"}))
+        p = c.wait_for_pod_pending("vip", timeout=10)
+        assert "InterPodAffinity" in p.status.unschedulable_plugins
+        names = {q.metadata.name for q in c.list_pods()}
+        assert {"gmember", "bystander"} <= names
+    finally:
+        c.shutdown()
