@@ -668,6 +668,22 @@ def run_child() -> None:
     except Exception as e:
         detail["bitmask_error"] = f"{type(e).__name__}: {e}"[:300]
 
+    # ---- engine over the WIRE (the reference's process shape with ------
+    # auth + flow control ON): store behind the HTTP apiserver, the
+    # scheduler attached as a pure network client. Modest scale — the
+    # long-poll informer pump, JSON codec, bind subresource, and the
+    # client token bucket are the system under test here, not XLA.
+    try:
+        if in_budget("wire_pods_per_sec"):
+            from bench_workload import make_workload as _mw
+
+            w_n, w_p = min(n_nodes, 2000), min(n_pods, 2000)
+            w_nodes, w_pods = _mw(w_n, w_p, seed=7)
+            detail.update(engine_bench(w_n, w_p, w_nodes, w_pods,
+                                       plugins, prefix="wire", wire=True))
+    except Exception as e:
+        detail["wire_error"] = f"{type(e).__name__}: {e}"[:300]
+
     emit_and_exit(0)
 
 
@@ -722,7 +738,7 @@ def roofline(seconds: float, p: int, n: int, n_filters: int,
 
 def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                  batch_size=None, prefix="engine", window_s=15.0,
-                 explain=False, backoff_s=None) -> dict:
+                 explain=False, backoff_s=None, wire=False) -> dict:
     """Schedule the same workload through the REAL engine: store + informers
     + queue + batched cycle + bulk bind; throughput from scheduler.metrics().
     Two passes — the first eats XLA compiles for the engine's pad buckets,
@@ -732,7 +748,14 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
     SUSTAINED multi-batch one: the engine chews through the same workload
     in n_pods/batch_size back-to-back cycles (pad bucket reused, assume
     accounting carried across batches) — the steady-state serving number
-    rather than the one-shot burst number. Output keys take ``prefix``."""
+    rather than the one-shot burst number. Output keys take ``prefix``.
+
+    ``wire=True`` runs the ENGINE AS A PURE NETWORK CLIENT (the
+    reference's process shape, scheduler/scheduler.go:54-75): the store
+    sits behind the HTTP apiserver with bearer-token auth + flow control
+    ON, the scheduler attaches via RemoteStore (informers long-polling
+    /watch, bindings through /bind), and the pod burst is submitted over
+    the wire too."""
     from minisched_tpu.config import SchedulerConfig
     from minisched_tpu.service.defaultconfig import Profile
     from minisched_tpu.service.service import SchedulerService
@@ -748,7 +771,14 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
         # informer and force a mid-run 60k-object re-list.
         store = ClusterStore()
         store.create_many(make_nodes())
-        svc = SchedulerService(store)
+        api = client = None
+        if wire:
+            from minisched_tpu.apiserver import APIServer, RemoteStore
+
+            api = APIServer(store, token="bench-token",
+                            max_inflight=256).start()
+            client = RemoteStore(api.address, token="bench-token")
+        svc = SchedulerService(client if wire else store)
         t0 = time.perf_counter()
         # The gather window lets the whole pod burst form ONE full-sized
         # batch (deterministic pad bucket, warmed by the warmup pass)
@@ -781,7 +811,7 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
         # Bulk submission: the workload burst arrives as one store
         # transaction (one watch wake-up); the informer drains it in
         # batches — the creation loop itself is off the critical path.
-        store.create_many(pod_objs)
+        (client if wire else store).create_many(pod_objs)
         deadline = time.time() + float(
             os.environ.get("MINISCHED_BENCH_ENGINE_DEADLINE", "240"))
         bound = 0
@@ -795,6 +825,8 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
         total_s = time.perf_counter() - t0
         m = sched.metrics()
         svc.shutdown_scheduler()
+        if api is not None:
+            api.shutdown()
         gc.unfreeze()  # let the torn-down cluster actually be collected
         if attempt == "warmup" and bound < n_pods:
             # Warm-up couldn't bind everything inside the deadline; the
