@@ -859,6 +859,7 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                 f"{prefix}_step_s": round(m["step_s_total"], 4),
                 f"{prefix}_step_dispatch_s":
                     round(m["step_dispatch_s_total"], 4),
+                f"{prefix}_pad_shapes": list(m.get("last_shapes", ())),
                 f"{prefix}_commit_s": round(m["commit_s_total"], 4),
                 f"{prefix}_gap_s": round(m.get("gap_s_total", 0.0), 4),
                 f"{prefix}_bind_conflicts": int(m["bind_conflicts"]),

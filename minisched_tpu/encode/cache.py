@@ -10,7 +10,7 @@ step (bucketing avoids per-batch recompilation — SURVEY §7 "dynamic shapes").
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -67,6 +67,32 @@ def bucket_for(n: int, minimum: int = 16) -> int:
     return b
 
 
+def step_bucket(n: int, minimum: int = 16) -> int:
+    """Padding bucket for the STEP's array shapes: power-of-two up to
+    2048, then eighth-steps between octaves (2^k · (8+j)/8, j = 1..8).
+
+    Pure doubling wastes up to ~2× compute at the scales that matter —
+    the headline 50k nodes × 10k pods pads to 65536 × 16384, 2.1× the
+    cells of a tight pad, and every (P,N) filter/score pass pays it.
+    Eighth-steps cap the waste at 12.5% while keeping every value above
+    2048 a multiple of 256: lane-tile aligned for the pallas kernel and
+    divisible by any power-of-two mesh axis up to 256 for the sharded
+    step. The ladder has 8× the distinct buckets per octave (more XLA
+    compiles in the worst case), but a steady-state engine sits in one
+    or two: batch sizes are max_batch_size-capped and the node count is
+    quasi-static, so compiles amortize exactly like the pow2 ladder's.
+    """
+    b = bucket_for(n, minimum)
+    if b <= 2048 or b <= minimum:
+        # Below the ladder, or the caller's floor IS the bucket (a
+        # minimum above 2048 pins shapes; stepping below it would flap
+        # through sub-floor buckets and recompile on every growth step).
+        return b
+    base = b >> 1                 # n > base = max(2^(k-1), minimum·2^(k-1))
+    step = base >> 3
+    return base + step * -(-(n - base) // step)
+
+
 class NodeFeatureCache:
     """Thread-safe incrementally-maintained node feature arrays."""
 
@@ -79,6 +105,13 @@ class NodeFeatureCache:
         self._index: Dict[str, int] = {}  # node name → row
         self._names: List[Optional[str]] = [None] * capacity
         self._free_rows: List[int] = list(range(capacity - 1, -1, -1))
+        # High-water marks (max allocated row + 1, monotonic): snapshots
+        # may pad to step_bucket(hw) instead of the pow2 capacity — rows
+        # beyond the high water are empty by construction, so the tighter
+        # pad is always legal. Monotonicity keeps the engine's per-pad
+        # compile/device-static caches from flapping under churn.
+        self._rows_hw = 0
+        self._a_hw = 0
         # pod key → (node row, requests vector, host ports, claim keys) for
         # incremental free-resource accounting; only bound pods appear here.
         self._bound: Dict[str, Tuple[int, np.ndarray, List[int], List[str]]] = {}
@@ -272,6 +305,9 @@ class NodeFeatureCache:
                 a_rows = self._a_free[-len(fast):]
                 del self._a_free[-len(fast):]
                 aa = np.asarray(a_rows, dtype=np.int64)
+                hw = int(aa.max()) + 1
+                if hw > self._a_hw:
+                    self._a_hw = hw
                 self._assigned.valid[aa] = True
                 self._assigned.node_row[aa] = ii
                 self._assigned.requests[aa] = reqs[kk]
@@ -461,7 +497,8 @@ class NodeFeatureCache:
     # else changes only with static_version.
     DYNAMIC_NF_FIELDS = ("free", "used_ports")
 
-    def snapshot(self, pad: Optional[int] = None) -> Tuple[NodeFeatures, List[Optional[str]]]:
+    def snapshot(self, pad: Union[int, Callable[[int], int], None] = None,
+                 ) -> Tuple[NodeFeatures, List[Optional[str]]]:
         """Copy of the feature arrays padded to ``pad`` (default: bucketed
         capacity), plus the row→name mapping (None = empty row).
 
@@ -471,7 +508,9 @@ class NodeFeatureCache:
         feats, names, _sv = self.snapshot_versioned(pad)
         return feats, names
 
-    def snapshot_versioned(self, pad: Optional[int] = None,
+    def snapshot_versioned(self,
+                           pad: Union[int, Callable[[int], int],
+                                      None] = None,
                            known_static=None):
         """``snapshot`` that also returns the static version OBSERVED UNDER
         THE SNAPSHOT LOCK — the topology refresh performed here may itself
@@ -485,12 +524,21 @@ class NodeFeatureCache:
         returned as ``None`` instead of host copies — the caller replaces
         them anyway, and skipping them drops ~tens of MB of memcpy from
         every steady-state batch. Returns (feats, names, static_version).
+
+        ``pad`` may be a CALLABLE ``hw -> int``: it is resolved from the
+        row high-water mark UNDER the snapshot lock, so a concurrent
+        node add on the informer thread can never allocate a row past a
+        pad the caller computed from a stale high-water read (row
+        allocation takes the same lock).
         """
         with self._lock:
             self._refresh_topology_locked()
             sv = self.static_version
             n = self._capacity
-            target = pad if pad is not None else bucket_for(n)
+            if callable(pad):
+                target = pad(self._rows_hw)
+            else:
+                target = pad if pad is not None else bucket_for(n)
             f = self._feats
             skip = (lambda name: known_static == (sv, target)
                     and name not in self.DYNAMIC_NF_FIELDS)
@@ -525,11 +573,19 @@ class NodeFeatureCache:
                 names = list(self._names) + [None] * (target - n)
             return feats, names, sv
 
-    def snapshot_assigned(self, pad: Optional[int] = None) -> AssignedPodFeatures:
-        """Copy of the assigned-pod corpus padded/truncated like snapshot()."""
+    def snapshot_assigned(self, pad: Union[int, Callable[[int], int],
+                                         None] = None,
+                          ) -> AssignedPodFeatures:
+        """Copy of the assigned-pod corpus padded/truncated like
+        snapshot(). ``pad`` may be a callable ``hw -> int`` resolved from
+        the assigned-row high-water mark under the lock (see
+        snapshot_versioned)."""
         with self._lock:
             a = self._a_capacity
-            target = pad if pad is not None else bucket_for(a)
+            if callable(pad):
+                target = pad(self._a_hw)
+            else:
+                target = pad if pad is not None else bucket_for(a)
             f = self._assigned
             if target < a:
                 if f.valid[target:].any():
@@ -550,6 +606,17 @@ class NodeFeatureCache:
     def node_count(self) -> int:
         with self._lock:
             return len(self._index)
+
+    def rows_high_water(self) -> int:
+        """Max node row ever allocated + 1 (monotonic; ≤ capacity).
+        step_bucket(rows_high_water()) is the tightest legal snapshot pad."""
+        with self._lock:
+            return self._rows_hw
+
+    def assigned_high_water(self) -> int:
+        """Max assigned-corpus row ever allocated + 1 (monotonic)."""
+        with self._lock:
+            return self._a_hw
 
     def row_of(self, name: str) -> Optional[int]:
         with self._lock:
@@ -775,7 +842,10 @@ class NodeFeatureCache:
             self._names += [None] * (new_cap - self._capacity)
             self._free_rows = list(range(new_cap - 1, self._capacity - 1, -1))
             self._capacity = new_cap
-        return self._free_rows.pop()
+        row = self._free_rows.pop()
+        if row >= self._rows_hw:
+            self._rows_hw = row + 1
+        return row
 
     def _ensure_assigned_capacity(self, need: int) -> None:
         while len(self._a_free) < need:
@@ -790,7 +860,10 @@ class NodeFeatureCache:
 
     def _alloc_assigned_row(self) -> int:
         self._ensure_assigned_capacity(1)
-        return self._a_free.pop()
+        row = self._a_free.pop()
+        if row >= self._a_hw:
+            self._a_hw = row + 1
+        return row
 
     def _refresh_topology_locked(self) -> None:
         """Recompute domain tables if new topology keys registered since the

@@ -30,7 +30,7 @@ import numpy as np
 
 from ..config import SchedulerConfig
 from ..encode import NodeFeatureCache, encode_pods
-from ..encode.cache import bucket_for
+from ..encode.cache import bucket_for, step_bucket
 from ..encode.features import NodeFeatures
 from ..errors import ConflictError, NotFoundError
 from ..ops.pipeline import Decision, build_step
@@ -69,13 +69,16 @@ def _pack_decision(chosen, assigned, gang_rejected, feasible,
 
 
 @jax.jit
-def _pack_spread(pre, dom, mn):
-    """Spread-arbitration inputs as one (2P+1, G) f32 fetch. Domain ids
+def _pack_spread(pre, dom, mn, scan_groups):
+    """Spread-arbitration inputs as one (2P+2, G) f32 fetch: pre-counts,
+    chosen-domain ids, per-group pre-batch min, and the in-scan
+    enforcement flags (rows the host arbitration may skip). Domain ids
     and counts are < 2^24, exact in f32."""
     import jax.numpy as jnp
 
     return jnp.concatenate(
-        [pre, dom.astype(jnp.float32), mn[None, :]], axis=0)
+        [pre, dom.astype(jnp.float32), mn[None, :],
+         scan_groups.astype(jnp.float32)[None, :]], axis=0)
 
 
 def arbitrate_rwo(batch: List[QueuedPodInfo], assigned, chosen,
@@ -249,7 +252,8 @@ class _SpreadGroupState:
 def arbitrate_spread(batch: List[QueuedPodInfo], assigned, pf, gf,
                      spread_pre, spread_dom, spread_min,
                      dead: Set[int], anti_enabled: bool = True,
-                     exact_tables=None) -> Set[int]:
+                     exact_tables=None,
+                     scan_enforced=None) -> Set[int]:
     """Intra-batch topology arbitration → additional revoked indices.
 
     Every batch pod was filtered/scored against PRE-batch topology counts,
@@ -285,7 +289,15 @@ def arbitrate_spread(batch: List[QueuedPodInfo], assigned, pf, gf,
     Inputs: pf/gf (host-side encoded batch), spread_pre/dom (P,G) and
     spread_min (G,) from the step (state at each pod's chosen node),
     ``dead`` = indices already revoked upstream (they never commit, so
-    they contribute no deltas)."""
+    they contribute no deltas).
+
+    ``scan_enforced`` ((G,) bool, Decision.scan_groups): groups whose
+    hard skew the in-scan domain caps (ops/spreadcap.py) already judged
+    against running counts AT CHOICE TIME, in this same batch order —
+    the host replay is skipped for them, and a batch whose hard groups
+    are all scan-enforced never calls ``exact_tables`` at all (the
+    (G,D) transfer exists solely to rebuild the running state the scan
+    already had)."""
     from ..encode import features as F
 
     if spread_pre.shape[0] == 0:
@@ -301,88 +313,159 @@ def arbitrate_spread(batch: List[QueuedPodInfo], assigned, pf, gf,
     if not hard.any() and not has_anti:
         return set()
     match = batch_group_match(batch, gf)
-    # Exact mode state: group id → _SpreadGroupState, built lazily from
-    # the step's (G,D) tables for the groups hard constraints reference.
-    cdom = dexist = None
-    if hard.any() and exact_tables is not None:
-        fetched = exact_tables()
-        if fetched is not None and fetched[0].shape[0]:
-            cdom, dexist = fetched
-    # States must exist for every hard-referenced group BEFORE the walk:
-    # built lazily at first check, an earlier non-constrained matching
-    # pod's admission would be missing from the group's running counts.
-    gstates: Dict[int, _SpreadGroupState] = {}
-    if cdom is not None:
-        for g in np.unique(pf.spread_group[:P][hard]):
-            if g >= 0:
-                gstates[int(g)] = _SpreadGroupState(cdom[int(g)],
-                                                    dexist[int(g)])
 
-    delta: Dict[tuple, int] = {}       # (g,d) → matching pods placed
-    anti_delta: Dict[tuple, int] = {}  # (g,d) → anti-terms-on-g placed in d
-    revoked: Set[int] = set()
-    for i in range(P):
-        if not assigned[i] or i in dead:
-            continue
-        viol = False
-        for c in np.nonzero(hard[i])[0]:
-            g = int(pf.spread_group[i, c])
-            d = int(spread_dom[i, g])
-            st = gstates.get(g)
-            if st is not None:
-                if d >= 0 and (int(st.counts[d]) + 1 - st.min
-                               > int(pf.spread_max_skew[i, c])):
-                    viol = True
-                    break
-            else:
-                after = float(spread_pre[i, g]) + delta.get((g, d), 0) + 1
-                if after - float(spread_min[g]) > float(
-                        pf.spread_max_skew[i, c]):
-                    viol = True
-                    break
-        if not viol and has_anti:
-            for t in np.nonzero(anti[i] >= 0)[0]:
-                g = int(anti[i, t])
-                d = int(spread_dom[i, g])
-                # direct: an earlier matching placement in my domain
-                if d >= 0 and delta.get((g, d), 0) > 0:
-                    viol = True
-                    break
-            if not viol:
-                # symmetric: an earlier pod's anti term targets ME
+    hard_gids = {int(g) for g in np.unique(pf.spread_group[:P][hard])
+                 if g >= 0}
+    # (G,D) tables are fetched at most once across every walk iteration.
+    tables = {"fetched": False, "cdom": None, "dexist": None}
+
+    def fetch_tables():
+        if not tables["fetched"]:
+            tables["fetched"] = True
+            if exact_tables is not None:
+                fetched = exact_tables()
+                if fetched is not None and fetched[0].shape[0]:
+                    tables["cdom"], tables["dexist"] = fetched
+        return tables["cdom"], tables["dexist"]
+
+    def _walk(dead_all: Set[int]) -> Set[int]:
+        """One exact sequential replay with ``dead_all`` contributing
+        nothing. Mutable enforcement view: a group's scan verdict is
+        trusted only while every admission the scan COUNTED for it
+        survives. A host-side revocation (RWO/gang ``dead_all``, or an
+        anti revocation made in this very walk) removes a contribution
+        the scan's running counts relied on — lowering a domain min that
+        later admissions were judged against — so those groups fall back
+        to the exact replay, reconstructed mid-walk from the survivor
+        deltas."""
+        enf = (np.array(scan_enforced, dtype=bool, copy=True)
+               if scan_enforced is not None
+               else np.zeros(gf.valid.shape[0], dtype=bool))
+        delta: Dict[tuple, int] = {}      # (g,d) → matching pods placed
+        anti_delta: Dict[tuple, int] = {}  # (g,d) → anti terms placed in d
+        gstates: Dict[int, _SpreadGroupState] = {}
+
+        def build_state(g: int) -> None:
+            """Exact running state for group g AT THE CURRENT WALK
+            POSITION: pre-batch tables plus every surviving admission so
+            far (delta already tracks them for all matching groups,
+            enforced or not)."""
+            cdom, dexist = fetch_tables()
+            if cdom is None:
+                return  # fallback mode: pre-batch-min check (over-revokes)
+            st = _SpreadGroupState(cdom[g], dexist[g])
+            for (g2, d), cnt in delta.items():
+                if g2 == g:
+                    for _ in range(cnt):
+                        st.admit(d)
+            gstates[g] = st
+
+        def un_enforce(rows) -> None:
+            """Stop trusting the scan for every hard group the given
+            revoked pods match; rebuild their exact state from deltas."""
+            for i in rows:
                 for g in np.nonzero(match[i])[0]:
-                    d = int(spread_dom[i, int(g)])
-                    if d >= 0 and anti_delta.get((int(g), d), 0) > 0:
+                    gi = int(g)
+                    if enf[gi] and gi in hard_gids:
+                        enf[gi] = False
+                        build_state(gi)
+
+        # Pre-walk: revocations known before this walk were counted by
+        # the scan from their row onward — replay their groups from row
+        # 0 (the sequential scheduler would have rejected them at their
+        # turn).
+        dead_assigned = [i for i in dead_all if i < P and assigned[i]]
+        if dead_assigned:
+            un_enforce(dead_assigned)
+        for g in sorted(hard_gids):
+            if not enf[g] and g not in gstates:
+                build_state(g)
+
+        revoked: Set[int] = set()
+        for i in range(P):
+            if not assigned[i] or i in dead_all:
+                continue
+            viol = False
+            for c in np.nonzero(hard[i])[0]:
+                g = int(pf.spread_group[i, c])
+                if enf[g]:
+                    # the scan judged this admission against running
+                    # counts at choice time, and every admission it
+                    # counted so far survives — replaying is redundant
+                    continue
+                d = int(spread_dom[i, g])
+                st = gstates.get(g)
+                if st is not None:
+                    if d >= 0 and (int(st.counts[d]) + 1 - st.min
+                                   > int(pf.spread_max_skew[i, c])):
                         viol = True
                         break
-        if viol:
-            revoked.add(i)
-            continue
-        for g in np.nonzero(match[i])[0]:
-            gi = int(g)
-            d = int(spread_dom[i, gi])
-            if d >= 0:  # node lacks the group's key → no domain membership
-                # delta tracks IN-BATCH placements for the anti path in
-                # both modes; the exact group states additionally carry
-                # the running total counts + min for the skew check.
-                delta[(gi, d)] = delta.get((gi, d), 0) + 1
-                st = gstates.get(gi)
-                if st is not None:
-                    st.admit(d)
-        if has_anti:
-            for t in np.nonzero(anti[i] >= 0)[0]:
-                g = int(anti[i, t])
-                d = int(spread_dom[i, g])
-                if d >= 0:
-                    anti_delta[(g, d)] = anti_delta.get((g, d), 0) + 1
-    # gang atomicity over the new revocations
-    gangs = {gang_key(batch[i].pod) for i in revoked
-             if batch[i].pod.spec.pod_group}
-    if gangs:
-        for i, qpi in enumerate(batch):
-            if assigned[i] and i not in dead and gang_key(qpi.pod) in gangs:
+                else:
+                    after = (float(spread_pre[i, g])
+                             + delta.get((g, d), 0) + 1)
+                    if after - float(spread_min[g]) > float(
+                            pf.spread_max_skew[i, c]):
+                        viol = True
+                        break
+            if not viol and has_anti:
+                for t in np.nonzero(anti[i] >= 0)[0]:
+                    g = int(anti[i, t])
+                    d = int(spread_dom[i, g])
+                    # direct: an earlier matching placement in my domain
+                    if d >= 0 and delta.get((g, d), 0) > 0:
+                        viol = True
+                        break
+                if not viol:
+                    # symmetric: an earlier pod's anti term targets ME
+                    for g in np.nonzero(match[i])[0]:
+                        d = int(spread_dom[i, int(g)])
+                        if d >= 0 and anti_delta.get((int(g), d), 0) > 0:
+                            viol = True
+                            break
+            if viol:
                 revoked.add(i)
-    return revoked
+                # this pod's admission WAS in the scan's running counts —
+                # groups it matches can no longer trust the scan verdict
+                # for the remaining rows
+                un_enforce((i,))
+                continue
+            for g in np.nonzero(match[i])[0]:
+                gi = int(g)
+                d = int(spread_dom[i, gi])
+                if d >= 0:  # node lacks the key → no domain membership
+                    # delta tracks IN-BATCH placements for the anti path
+                    # in both modes; the exact group states additionally
+                    # carry the running counts + min for the skew check.
+                    delta[(gi, d)] = delta.get((gi, d), 0) + 1
+                    st = gstates.get(gi)
+                    if st is not None:
+                        st.admit(d)
+            if has_anti:
+                for t in np.nonzero(anti[i] >= 0)[0]:
+                    g = int(anti[i, t])
+                    d = int(spread_dom[i, g])
+                    if d >= 0:
+                        anti_delta[(g, d)] = anti_delta.get((g, d), 0) + 1
+        return revoked
+
+    # Fixpoint over gang atomicity: a revoked member revokes its whole
+    # gang, and each revoked gang member's admission was counted by BOTH
+    # the scan and this walk's running state — later pods may hold
+    # placements only legal because of it. Re-walk with the gang's
+    # members dead until no new revocation appears (bounded by the
+    # number of gangs; a batch with no gang revocations exits after one
+    # pass, identical to the single-walk behavior).
+    extra: Set[int] = set()
+    while True:
+        revoked = _walk(dead | extra) | extra
+        gangs = {gang_key(batch[i].pod) for i in revoked
+                 if batch[i].pod.spec.pod_group}
+        cascade = {i for i, qpi in enumerate(batch)
+                   if (assigned[i] and i not in dead and i not in revoked
+                       and gang_key(qpi.pod) in gangs)}
+        if not cascade:
+            return revoked
+        extra = revoked | cascade
 
 
 class Scheduler:
@@ -684,7 +767,7 @@ class Scheduler:
                 return pairs
 
         encode_hard: Dict[int, tuple] = {}
-        eb = encode_pods(pods, bucket_for(len(pods), cfg.pod_bucket_min),
+        eb = encode_pods(pods, step_bucket(len(pods), cfg.pod_bucket_min),
                          cfg=self.cache.cfg,
                          registry=self.cache.registry,
                          overflow=self.cache.overflow,
@@ -709,8 +792,9 @@ class Scheduler:
         # on device (known_static hit).
         cached = self._nf_static_device
         nf, names, static_v = self.cache.snapshot_versioned(
+            pad=self._node_pad,
             known_static=cached[0] if cached else None)
-        af = self.cache.snapshot_assigned()
+        af = self.cache.snapshot_assigned(pad=self._af_pad)
         nf = self._with_device_static(nf, static_v)
         # Nominated-capacity protection (upstream nominatedNodeName
         # semantics): capacity a preemption freed is RESERVED for its
@@ -726,6 +810,21 @@ class Scheduler:
 
         self._step_counter += 1
         key = jax.random.fold_in(self._key, self._step_counter)
+        L_b = len(batch)
+        # Hard (DoNotSchedule) spread rows, known host-side from the
+        # encode: they pick the full-axis step (the in-scan domain caps
+        # judge skew against RUNNING counts at choice time — sampling
+        # would disable the caps and push every admission through the
+        # host replay plus its (G,D) table fetch) and gate the spread
+        # arbitration fetch below.
+        hard_spread = False
+        if self._spread_enabled:
+            from ..encode import features as _F
+
+            hard_spread = bool(
+                ((eb.pf.spread_group[:L_b] >= 0)
+                 & (eb.pf.spread_mode[:L_b] == _F.SPREAD_DO_NOT_SCHEDULE)
+                 ).any())
         # Node-axis sampling (percentage_of_nodes_to_score): a small batch
         # against a huge cluster runs the pipeline on the top-K candidate
         # subset; pods the sample finds 0-feasible are re-checked below
@@ -735,7 +834,7 @@ class Scheduler:
             step_fn, sample_k = self._mesh_step(eb, nf, af), None
         else:
             step_fn, sample_k = self._sampled_step(
-                nf.free.shape[0], len(batch), has_gang)
+                nf.free.shape[0], len(batch), has_gang or hard_spread)
             step_fn = step_fn or self._step
         decision: Decision = step_fn(eb, nf, af, key)
         # Pack every per-pod output into ONE device array per dtype family
@@ -747,9 +846,18 @@ class Scheduler:
             decision.chosen, decision.assigned, decision.gang_rejected,
             decision.feasible_counts, decision.feasible_static,
             decision.reject_counts)
+        # The spread/anti arbitration inputs are fetched only when the
+        # batch actually carries something the host must arbitrate: a
+        # hard (DoNotSchedule) spread slot or a required anti-affinity
+        # term. A soft-only topology batch (the common ScheduleAnyway
+        # case) pays neither the pack dispatch nor the (2P+2, G)
+        # transfer — arbitrate_spread would return empty for it anyway.
+        needs_arb = hard_spread or bool(
+            self._spread_enabled and self._anti_enabled
+            and (eb.pf.anti_req_group[:L_b] >= 0).any())
         spread_dev = (_pack_spread(decision.spread_pre, decision.spread_dom,
-                                   decision.spread_min)
-                      if self._spread_enabled else None)
+                                   decision.spread_min, decision.scan_groups)
+                      if needs_arb else None)
         # Dispatch returns before the device finishes (jax async); the
         # first np.asarray below blocks. Splitting the two reveals whether
         # step time is host→device feeding or device compute.
@@ -799,7 +907,7 @@ class Scheduler:
                     retryable=True)
 
         repair_rows: List[int] = []
-        if self._spread_enabled:
+        if self._spread_enabled and sp is not None:
             sp_p = decision.spread_pre.shape[0]
             s_revoked = arbitrate_spread(
                 batch, assigned, eb.pf, eb.gf,
@@ -807,10 +915,12 @@ class Scheduler:
                 sp[sp_p:2 * sp_p].astype(np.int32),
                 sp[2 * sp_p], dead=revoked,
                 anti_enabled=self._anti_enabled,
-                # Lazy: only a batch with hard DoNotSchedule rows pays
-                # the (G,D) table transfer for exact skew arbitration.
+                # Lazy: only a batch with hard DoNotSchedule rows the
+                # in-scan caps did NOT enforce pays the (G,D) table
+                # transfer for exact skew arbitration.
                 exact_tables=lambda: (np.asarray(decision.spread_cdom),
-                                      np.asarray(decision.spread_dexist)))
+                                      np.asarray(decision.spread_dexist)),
+                scan_enforced=sp[2 * sp_p + 1].astype(bool))
             from ..state.objects import CLAIM_UNUSED
             for i in sorted(s_revoked):
                 qpi = batch[i]
@@ -1005,11 +1115,12 @@ class Scheduler:
             # cache's assumed state is the committed truth.
             cached = self._nf_static_device
             nf_p, names_p, sv_p = self.cache.snapshot_versioned(
+                pad=self._node_pad,
                 known_static=cached[0] if cached else None)
             nf_p = self._with_device_static(nf_p, sv_p)
-            won = self._try_preempt(batch, preempt_rows, eb, nf_p,
-                                    self.cache.snapshot_assigned(),
-                                    names_p)
+            won = self._try_preempt(
+                batch, preempt_rows, eb, nf_p,
+                self.cache.snapshot_assigned(pad=self._af_pad), names_p)
             for i in preempt_rows:
                 if i not in won:
                     self._handle_failure(
@@ -1049,6 +1160,11 @@ class Scheduler:
             m["last_encode_s"] = t_encode - t0
             m["last_step_s"] = t_step - t_encode
             m["last_commit_s"] = t_commit - t_step
+            # Padded step shapes (P, N, A) — the pad-efficiency audit
+            # trail for the eighth-step buckets (encode/cache.step_bucket)
+            m["last_shapes"] = (int(eb.pf.valid.shape[0]),
+                                int(nf.valid.shape[0]),
+                                int(af.valid.shape[0]))
         return decision
 
     # ---- multi-chip step (SchedulerConfig.mesh) --------------------------
@@ -1075,15 +1191,37 @@ class Scheduler:
 
     # ---- node-axis sampling (percentage_of_nodes_to_score) --------------
 
-    def _sampled_step(self, n_pad: int, batch_len: int, has_gang: bool):
+    def _node_pad(self, hw: int) -> int:
+        """Node-axis pad for this engine's step shapes: the eighth-step
+        bucket of the cache's row high-water instead of the pow2 capacity
+        (50k nodes: 53248 vs 65536 — every (P,N) pass in the step is 23%
+        cheaper for free). High-water is monotonic, so the pad — and with
+        it the step's compile cache and the device-resident static-leaf
+        cache — only moves when the cluster actually grows. Passed as the
+        snapshot's ``pad`` CALLABLE so the bucket is resolved from the
+        high-water mark under the snapshot lock — a stale read could
+        otherwise race a concurrent node add past the pad."""
+        return step_bucket(max(hw, 1), self.config.node_bucket_min)
+
+    def _af_pad(self, hw: int) -> int:
+        """Assigned-corpus pad, same eighth-step treatment (the corpus
+        appears in the (G,A) topology match and the preemption victim
+        search)."""
+        return step_bucket(max(hw, 1), 16)
+
+    def _sampled_step(self, n_pad: int, batch_len: int,
+                      full_axis: bool):
         """(step_fn, K) for this batch, or (None, None) when sampling
-        doesn't apply. Gangs disable sampling — quorum must be judged
-        against one consistent node set, and a member failing only
-        because the sample missed its nodes would wrongly reject the
-        whole gang. Explain mode disables it too (per-node annotation
-        columns would misalign with the full name table)."""
+        doesn't apply. ``full_axis`` forces the full node set: gangs
+        (quorum must be judged against one consistent node set — a
+        member failing only because the sample missed its nodes would
+        wrongly reject the whole gang) and hard-spread batches (the
+        in-scan domain caps only run unsampled; a sampled hard batch
+        would fall back to host replay + the (G,D) table fetch). Explain
+        mode disables sampling too (per-node annotation columns would
+        misalign with the full name table)."""
         cfg = self.config
-        if cfg.explain or has_gang:
+        if cfg.explain or full_axis:
             return None, None
         pct = cfg.percentage_of_nodes_to_score
         if pct >= 100:
@@ -1133,7 +1271,8 @@ class Scheduler:
         rejects[:, rows] = p2[5:][:, :n_res]
         if sp is not None and sp.shape[0] > 1:
             sp2 = np.asarray(_pack_spread(
-                d2.spread_pre, d2.spread_dom, d2.spread_min))
+                d2.spread_pre, d2.spread_dom, d2.spread_min,
+                d2.scan_groups))
             sp_p = decision.spread_pre.shape[0]
             if d2.spread_pre.shape[0]:
                 sp[rows] = sp2[:P2][:n_res]
@@ -1168,8 +1307,9 @@ class Scheduler:
                 break
             cached = self._nf_static_device
             nf, names, static_v = self.cache.snapshot_versioned(
+                pad=self._node_pad,
                 known_static=cached[0] if cached else None)
-            af = self.cache.snapshot_assigned()
+            af = self.cache.snapshot_assigned(pad=self._af_pad)
             nf = self._with_device_static(nf, static_v)
             if self._nominations:
                 reserved = self._nomination_debits(
@@ -1194,7 +1334,8 @@ class Scheduler:
             assigned2 = p2[1].astype(bool)
             sub = [batch[i] for i in rows]
             sp2 = np.asarray(_pack_spread(
-                d2.spread_pre, d2.spread_dom, d2.spread_min))
+                d2.spread_pre, d2.spread_dom, d2.spread_min,
+                d2.scan_groups))
             sp_p2 = d2.spread_pre.shape[0]
             rev2 = arbitrate_spread(
                 sub, assigned2, eb2.pf, eb2.gf,
@@ -1202,7 +1343,8 @@ class Scheduler:
                 sp2[2 * sp_p2], dead=set(),
                 anti_enabled=self._anti_enabled,
                 exact_tables=lambda: (np.asarray(d2.spread_cdom),
-                                      np.asarray(d2.spread_dexist)))
+                                      np.asarray(d2.spread_dexist)),
+                scan_enforced=sp2[2 * sp_p2 + 1].astype(bool))
             items, req_rows, next_rows = [], [], []
             iter_rows: List[int] = []  # batch row per ``items`` entry
             iter_bind: List[tuple] = []

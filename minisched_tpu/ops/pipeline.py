@@ -73,6 +73,13 @@ class Decision(NamedTuple):
     spread_cdom: jnp.ndarray      # (G,D) f32 pre-batch matching count per
     #                               domain
     spread_dexist: jnp.ndarray    # (G,D) bool domain exists on some node
+    # (G,) bool — group's hard skew was enforced by the in-scan domain
+    # caps THIS batch (ops/spreadcap.py; False everywhere when the caps
+    # didn't run: pallas branch taken, sampling, auction, mesh, explain).
+    # The host arbitration skips the skew replay — and the (G,D)
+    # exact-table fetch — for these groups: the scan already judged every
+    # admission against running counts in batch order.
+    scan_groups: jnp.ndarray
     # explain mode only (else zero-size placeholders):
     filter_masks: jnp.ndarray     # (F,P,N) bool per-plugin pass mask
     raw_scores: jnp.ndarray       # (S,P,N) f32 pre-normalize
@@ -289,12 +296,21 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             if caps is not None and spread_plugin is not None:
                 # Undeferred spread verdict for terminal-vs-contention
                 # classification (Decision.feasible_static): one extra
-                # spread-filter pass, only when caps are active.
-                ctx_static = dict(ctx)
-                ctx_static.pop("spread_scan_groups", None)
-                m_static = spread_plugin.filter(pf_sub, nf, ctx_static)
-                feasible_static = (feasible & m_static).sum(
-                    axis=1).astype(jnp.int32)
+                # spread-filter pass — and only when a hard slot is
+                # actually enforced this batch (lax.cond), so the
+                # common all-soft topology batch never pays it (the
+                # filter deferred nothing; static == deferred there).
+                def _static_pass(args):
+                    feas, pf_c = args
+                    ctx_static = dict(ctx)
+                    ctx_static.pop("spread_scan_groups", None)
+                    m_static = spread_plugin.filter(pf_c, nf, ctx_static)
+                    return (feas & m_static).sum(axis=1).astype(jnp.int32)
+
+                feasible_static = jax.lax.cond(
+                    caps.any_enforced, _static_pass,
+                    lambda args: args[0].sum(axis=1).astype(jnp.int32),
+                    (feasible, pf_sub))
             reject_counts = (jnp.stack(rc) if rc else
                              jnp.zeros((0, pf_sub.valid.shape[0]),
                                        dtype=jnp.int32))
@@ -405,8 +421,11 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
         # Spread-arbitration inputs: per (pod, GROUP), gathered at the
         # ASSIGNED node, so they must come after the assignment stage.
         # Cheap — (P,G) gathers with G = distinct selector groups (small).
+        G = eb.gf.valid.shape[0]
+        scan_groups = (caps.scan_groups & caps.any_enforced
+                       if caps is not None
+                       else jnp.zeros((G,), dtype=bool))
         if needs_topology and "counts_node" in ctx:
-            G = eb.gf.valid.shape[0]
             safe_row = jnp.clip(assign.chosen, 0, N - 1)         # (P,)
             live = assign.assigned[:, None] & eb.gf.valid[None, :]
             spread_pre = jnp.where(
@@ -419,7 +438,6 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             spread_cdom = ctx["counts_dom"]                      # (G,D)
             spread_dexist = ctx["dom_exists"]                    # (G,D)
         else:
-            G = eb.gf.valid.shape[0]
             spread_pre = jnp.zeros((0, G), dtype=jnp.float32)
             spread_dom = jnp.full((0, G), -1, dtype=jnp.int32)
             spread_min = jnp.zeros((0,), dtype=jnp.float32)
@@ -466,6 +484,7 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             spread_dom=spread_dom,
             spread_cdom=spread_cdom,
             spread_dexist=spread_dexist,
+            scan_groups=scan_groups,
             filter_masks=filter_stack,
             raw_scores=raw_stack,
             norm_scores=norm_stack,

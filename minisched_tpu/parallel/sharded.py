@@ -79,6 +79,7 @@ def build_sharded_step(plugin_set: PluginSet, mesh, eb_template, nf_template,
         spread_min=NamedSharding(mesh, P()),
         spread_cdom=NamedSharding(mesh, P()),
         spread_dexist=NamedSharding(mesh, P()),
+        scan_groups=NamedSharding(mesh, P()),
         filter_masks=stack_both, raw_scores=stack_both, norm_scores=stack_both)
 
     return jax.jit(stepfn, in_shardings=(eb_sh, nf_sh, af_sh, key_sh),

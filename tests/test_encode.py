@@ -251,3 +251,58 @@ def test_anti_term_table_bind_unbind_refcount():
     assert len(c.anti_forbidden_for(victim)) == 1  # p2 still holds it
     c.account_unbind(p2.key)
     assert c.anti_forbidden_for(victim) == []
+
+
+def test_step_bucket_geometry():
+    from minisched_tpu.encode.cache import step_bucket
+
+    # power-of-two below/at 2048
+    assert step_bucket(1) == 16
+    assert step_bucket(17) == 32
+    assert step_bucket(2048) == 2048
+    # eighth-steps above: ≤12.5% waste, multiples of 256
+    assert step_bucket(2049) == 2304
+    assert step_bucket(10_000) == 10240
+    assert step_bucket(50_000) == 53248
+    assert step_bucket(65_536) == 65536
+    for n in (3000, 10_000, 50_000, 100_000, 123_457):
+        b = step_bucket(n)
+        assert b >= n and b % 256 == 0
+        assert b <= n * 1.125, (n, b)
+    # monotone, idempotent on its own outputs
+    assert step_bucket(step_bucket(50_000)) == step_bucket(50_000)
+    # a minimum above 2048 is a hard floor (pinned shapes), never
+    # undercut by the eighth-step ladder
+    assert step_bucket(1, 4096) == 4096
+    assert step_bucket(3000, 4096) == 4096
+    assert step_bucket(5000, 4096) == 5120
+
+
+def test_rows_high_water_tracks_allocations():
+    from minisched_tpu.encode.cache import NodeFeatureCache, step_bucket
+    from minisched_tpu.state import objects as obj
+
+    c = NodeFeatureCache(capacity=16)
+    assert c.rows_high_water() == 0
+    for i in range(10):
+        c.upsert_node(obj.Node(metadata=obj.ObjectMeta(name=f"n{i}"),
+                               status=obj.NodeStatus(
+                                   allocatable={"cpu": 1000.0})))
+    assert c.rows_high_water() == 10
+    # deletes never shrink the mark (monotonic: keeps pads stable)
+    c.remove_node("n9")
+    assert c.rows_high_water() == 10
+    # snapshot at the tight bucket is legal and row-aligned
+    nf, names = c.snapshot(pad=step_bucket(c.rows_high_water()))
+    assert nf.valid.shape[0] == 16 and len(names) == 16
+    # callable pad: resolved from the high-water mark UNDER the lock
+    nf2, names2 = c.snapshot(pad=lambda hw: step_bucket(max(hw, 1)))
+    assert nf2.valid.shape[0] == 16
+    af = c.snapshot_assigned(pad=lambda hw: step_bucket(max(hw, 1)))
+    assert af.valid.shape[0] == 16
+    # assigned-corpus twin
+    p = obj.Pod(metadata=obj.ObjectMeta(name="p0", namespace="d"),
+                spec=obj.PodSpec(requests={"cpu": 1.0}))
+    assert c.assigned_high_water() == 0
+    c.account_bind(p, node_name="n0")
+    assert c.assigned_high_water() == 1

@@ -188,3 +188,66 @@ def test_engine_repair_drains_skew_burst_in_one_cycle():
         assert max(counts.values()) - min(counts.values()) <= 1, counts
     finally:
         svc.shutdown_scheduler()
+
+
+def test_scan_enforced_groups_skip_replay_and_table_fetch():
+    """A batch whose hard groups the in-scan caps all enforced
+    (Decision.scan_groups) must neither replay the skew checks nor call
+    ``exact_tables`` — the (G,D) transfer exists only to rebuild running
+    state the scan already carried. Placements the scan admitted (even
+    ones the frozen pre-batch view would call violations) survive."""
+    # 6 pods stacked into domain 0 of 3 empty domains at max_skew=1: the
+    # static view revokes all but one; the scan-enforced flag says the
+    # scan already judged them sequentially, so none are revoked here.
+    batch, assigned, eb, g, pre, dom, mn, cdom, dexist = _setup(
+        6, 3, [0] * 6, [0.0, 0.0, 0.0])
+
+    def exploding_tables():
+        raise AssertionError("exact_tables fetched for a fully "
+                             "scan-enforced batch")
+
+    scan = np.zeros(eb.gf.valid.shape[0], dtype=bool)
+    scan[g] = True
+    revoked = arbitrate_spread(
+        batch, assigned, eb.pf, eb.gf, pre, dom, mn, dead=set(),
+        exact_tables=exploding_tables, scan_enforced=scan)
+    assert revoked == set()
+
+
+def test_unenforced_groups_still_replay_exactly():
+    """scan_enforced all-False keeps the full exact replay: the same
+    stacked burst IS revoked down to the sequential-legal set."""
+    batch, assigned, eb, g, pre, dom, mn, cdom, dexist = _setup(
+        6, 3, [0] * 6, [0.0, 0.0, 0.0])
+    scan = np.zeros(eb.gf.valid.shape[0], dtype=bool)
+    revoked = arbitrate_spread(
+        batch, assigned, eb.pf, eb.gf, pre, dom, mn, dead=set(),
+        exact_tables=lambda: (cdom, dexist), scan_enforced=scan)
+    # sequential semantics: domain 0 may reach max_skew=1 over the empty
+    # min → exactly one admission survives
+    assert len(revoked) == 5
+
+
+def test_dead_revocation_invalidates_scan_trust():
+    """The reviewer scenario: the scan admitted pod0→B (raising the min)
+    then pod1→A at the cap; pod0 is revoked host-side (RWO). Trusting
+    the scan would commit pod1 at skew 2 > max_skew 1 — the arbitration
+    must fall back to exact replay for the group and revoke pod1."""
+    batch, assigned, eb, g, pre, dom, mn, cdom, dexist = _setup(
+        2, 2, [1, 0], [1.0, 0.0])   # pod0→domain1(B), pod1→domain0(A)
+    scan = np.zeros(eb.gf.valid.shape[0], dtype=bool)
+    scan[g] = True
+    revoked = arbitrate_spread(
+        batch, assigned, eb.pf, eb.gf, pre, dom, mn, dead={0},
+        exact_tables=lambda: (cdom, dexist), scan_enforced=scan)
+    assert revoked == {1}, revoked
+
+    # control: with pod0 SURVIVING, the scan's judgment stands — nothing
+    # is revoked and the exact tables are never fetched
+    def exploding():
+        raise AssertionError("tables fetched with no revocations")
+
+    revoked2 = arbitrate_spread(
+        batch, assigned, eb.pf, eb.gf, pre, dom, mn, dead=set(),
+        exact_tables=exploding, scan_enforced=scan)
+    assert revoked2 == set()

@@ -222,3 +222,27 @@ def test_dispatch_cache_stability_across_same_shape_batches():
         assert _Catch.hits == 0, "dispatch anomaly recovery fired"
     finally:
         logging.getLogger("minisched_tpu.ops.pipeline").removeHandler(h)
+
+
+def test_decision_exports_scan_groups():
+    """Decision.scan_groups marks exactly the groups the caps-scan
+    enforced: the hard group on a hard batch, nothing on a soft-only
+    batch (pallas/no-caps branch ⇒ the host arbitration must replay)."""
+    cache = _cluster()
+    d, _ = _run(cache, [_spread_pod(f"sg{i}") for i in range(8)], p_pad=16)
+    sg = np.asarray(d.scan_groups)
+    assert sg.any(), "hard-spread batch must report scan enforcement"
+
+    soft = [obj.Pod(
+        metadata=obj.ObjectMeta(name=f"soft{i}", namespace="default",
+                                labels={"app": "s"}),
+        spec=obj.PodSpec(
+            requests={"cpu": 100.0},
+            topology_spread_constraints=[obj.TopologySpreadConstraint(
+                max_skew=1, topology_key=ZONE,
+                when_unsatisfiable="ScheduleAnyway",
+                label_selector=obj.LabelSelector(
+                    match_labels={"app": "s"}))]))
+        for i in range(8)]
+    d2, _ = _run(cache, soft, p_pad=16)
+    assert not np.asarray(d2.scan_groups).any()
