@@ -908,19 +908,8 @@ class Scheduler:
 
         repair_rows: List[int] = []
         if self._spread_enabled and sp is not None:
-            sp_p = decision.spread_pre.shape[0]
-            s_revoked = arbitrate_spread(
-                batch, assigned, eb.pf, eb.gf,
-                sp[:sp_p],
-                sp[sp_p:2 * sp_p].astype(np.int32),
-                sp[2 * sp_p], dead=revoked,
-                anti_enabled=self._anti_enabled,
-                # Lazy: only a batch with hard DoNotSchedule rows the
-                # in-scan caps did NOT enforce pays the (G,D) table
-                # transfer for exact skew arbitration.
-                exact_tables=lambda: (np.asarray(decision.spread_cdom),
-                                      np.asarray(decision.spread_dexist)),
-                scan_enforced=sp[2 * sp_p + 1].astype(bool))
+            s_revoked = self._arbitrate_packed(
+                batch, assigned, eb, decision, sp, dead=revoked)
             from ..state.objects import CLAIM_UNUSED
             for i in sorted(s_revoked):
                 qpi = batch[i]
@@ -981,6 +970,7 @@ class Scheduler:
         bulk_assume = not self.plugin_set.permit_plugins
         assume_items: List[tuple] = []
         assume_rows: List[int] = []
+        ghost_rows: List[int] = []  # assume-missed rows, both paths
         preempt_rows: List[int] = []          # deferred terminal verdicts
         preempt_plugins: Dict[int, Set[str]] = {}
         # Python-int views: per-element numpy scalar indexing inside a
@@ -1016,6 +1006,7 @@ class Scheduler:
                     pair, ghost = self._start_binding_cycle(qpi, node_name)
                     if ghost:
                         n_ghost += 1
+                        ghost_rows.append(i)
                     if pair is not None:
                         to_bind.append(pair)
             elif gang_rejected_l[i]:
@@ -1089,8 +1080,56 @@ class Scheduler:
                         batch[assume_rows[m]], {BATCH_CAPACITY},
                         f"chosen node {node_name} was deleted during the "
                         "scheduling cycle", retryable=True)
+                ghost_rows.extend(assume_rows[m] for m in missed)
                 to_bind = [(q, n) for q, n in to_bind
                            if q.pod.key not in dead_keys]
+
+        if ghost_rows:
+            # Ghost staleness, both assume paths: the scan (and the host
+            # replay) COUNTED the ghost rows' admissions, so a later
+            # same-batch placement may be legal only because of a
+            # contribution that just vanished. Two consequences:
+            #   * gang atomicity — a ghosted member's siblings must not
+            #     bind at sub-quorum;
+            #   * hard-spread exactness — re-arbitrate with the ghosts
+            #     dead; a newly violating survivor is revoked.
+            # Revocations go through _revoke_post_assume, which also
+            # aborts an in-flight permit wait (non-bulk path); to_bind
+            # has not been submitted yet, so dropped pairs never bind.
+            g_set = set(ghost_rows)
+            bind_keys = {q.pod.key for q, _ in to_bind}
+            drop_keys: Set[str] = set()
+            ghost_gangs = {gang_key(batch[i].pod) for i in g_set
+                           if batch[i].pod.spec.pod_group}
+            if ghost_gangs:
+                for j, qpi in enumerate(batch):
+                    if (j in g_set or j in revoked or not assigned_l[j]
+                            or gang_key(qpi.pod) not in ghost_gangs):
+                        continue
+                    if self._revoke_post_assume(
+                            qpi, {COSCHEDULING, BATCH_CAPACITY},
+                            f"gang {qpi.pod.spec.pod_group} member's "
+                            "chosen node was deleted during the "
+                            "scheduling cycle",
+                            in_bind=qpi.pod.key in bind_keys):
+                        drop_keys.add(qpi.pod.key)
+                        revoked = revoked | {j}
+            if sp is not None:
+                # re_rev includes gang siblings of any member it revokes
+                # (arbitrate_spread's internal gang-atomicity fixpoint)
+                re_rev = self._arbitrate_packed(
+                    batch, assigned, eb, decision, sp,
+                    dead=revoked | g_set)
+                for i in sorted(re_rev):
+                    qpi = batch[i]
+                    if self._revoke_post_assume(
+                            qpi, {BATCH_CAPACITY}, _SPREAD_REVOKE_MSG,
+                            in_bind=qpi.pod.key in bind_keys):
+                        drop_keys.add(qpi.pod.key)
+                        revoked = revoked | {i}
+            if drop_keys:
+                to_bind = [(q, n) for q, n in to_bind
+                           if q.pod.key not in drop_keys]
 
         n_repaired = 0
         if repair_rows:
@@ -1190,6 +1229,22 @@ class Scheduler:
         return self._sharded_step
 
     # ---- node-axis sampling (percentage_of_nodes_to_score) --------------
+
+    def _arbitrate_packed(self, batch, assigned, eb, decision, sp,
+                          dead: Set[int]) -> Set[int]:
+        """arbitrate_spread over the packed (2P+2, G) spread fetch — the
+        ONE place that decodes _pack_spread's row layout (pre | dom |
+        min | scan_groups). The (G,D) exact tables stay lazy: only a
+        batch with hard rows the in-scan caps did not enforce pays the
+        transfer."""
+        sp_p = decision.spread_pre.shape[0]
+        return arbitrate_spread(
+            batch, assigned, eb.pf, eb.gf,
+            sp[:sp_p], sp[sp_p:2 * sp_p].astype(np.int32), sp[2 * sp_p],
+            dead=dead, anti_enabled=self._anti_enabled,
+            exact_tables=lambda: (np.asarray(decision.spread_cdom),
+                                  np.asarray(decision.spread_dexist)),
+            scan_enforced=sp[2 * sp_p + 1].astype(bool))
 
     def _node_pad(self, hw: int) -> int:
         """Node-axis pad for this engine's step shapes: the eighth-step
@@ -1336,15 +1391,8 @@ class Scheduler:
             sp2 = np.asarray(_pack_spread(
                 d2.spread_pre, d2.spread_dom, d2.spread_min,
                 d2.scan_groups))
-            sp_p2 = d2.spread_pre.shape[0]
-            rev2 = arbitrate_spread(
-                sub, assigned2, eb2.pf, eb2.gf,
-                sp2[:sp_p2], sp2[sp_p2:2 * sp_p2].astype(np.int32),
-                sp2[2 * sp_p2], dead=set(),
-                anti_enabled=self._anti_enabled,
-                exact_tables=lambda: (np.asarray(d2.spread_cdom),
-                                      np.asarray(d2.spread_dexist)),
-                scan_enforced=sp2[2 * sp_p2 + 1].astype(bool))
+            rev2 = self._arbitrate_packed(
+                sub, assigned2, eb2, d2, sp2, dead=set())
             items, req_rows, next_rows = [], [], []
             iter_rows: List[int] = []  # batch row per ``items`` entry
             iter_bind: List[tuple] = []
@@ -2021,12 +2069,30 @@ class Scheduler:
         sig = wp.get_signal(timeout=max_timeout + 1.0)
         with self._waiting_lock:
             self.waiting_pods.pop(qpi.pod.key, None)
+        revoked = getattr(wp, "engine_revoked", None)
         if sig is None or not sig.allowed:
             reason = sig.reason if sig else "permit wait timed out"
             self._unassume(qpi)
+            if revoked is not None:
+                # engine-side revocation (_revoke_post_assume), not a
+                # permit verdict: retryable with the engine's attribution
+                self._handle_failure(qpi, revoked[0], revoked[1],
+                                     retryable=True)
+                return
             self._handle_failure(
                 qpi, {name for name, _, _ in wp.waits},
                 f"WaitOnPermit failed: {reason}", retryable=False)
+            return
+        if revoked is not None:
+            # The permit ALLOW signal raced the engine's reject (the
+            # signal channel is first-send-wins, so the reject was
+            # dropped) — but engine_revoked is set under _waiting_lock
+            # strictly before this pop, so honoring it here closes the
+            # window: the revocation must win or the pod binds at
+            # sub-quorum / over max_skew.
+            self._unassume(qpi)
+            self._handle_failure(qpi, revoked[0], revoked[1],
+                                 retryable=True)
             return
         self._bind(qpi, wp.node_name)
 
@@ -2091,6 +2157,33 @@ class Scheduler:
         log.warning("bind of %s to %s failed: %s", qpi.pod.key, node_name,
                     reason)
         self.queue.requeue_backoff(qpi)
+
+    def _revoke_post_assume(self, qpi: QueuedPodInfo, plugins: Set[str],
+                            msg: str, *, in_bind: bool) -> bool:
+        """Reverse an assume made THIS cycle (ghost-gang atomicity /
+        ghost-spread staleness). Returns True when the revocation took.
+
+        ``in_bind``: the pod sits in the cycle's unsubmitted to_bind
+        list — unassume + requeue is race-free (the bulk bind commits
+        strictly after this point). Otherwise the pod is on the async
+        permit path: an in-flight wait is rejected (its _wait_and_bind
+        continuation unassumes and requeues with OUR attribution via
+        the engine_revoked mark); a wait that already resolved may have
+        bound — too late to revoke, upstream's own assumed-pod race —
+        so the revocation is declined."""
+        if in_bind:
+            self._unassume(qpi)
+            self._handle_failure(qpi, plugins, msg, retryable=True)
+            return True
+        with self._waiting_lock:
+            wp = self.waiting_pods.get(qpi.pod.key)
+            if wp is None:
+                log.info("post-assume revocation of %s declined: permit "
+                         "wait already resolved", qpi.pod.key)
+                return False
+            wp.engine_revoked = (set(plugins), msg)
+        wp.reject("engine", msg)
+        return True
 
     def _unassume(self, qpi: QueuedPodInfo) -> None:
         self.cache.account_unbind(qpi.pod.key)

@@ -145,3 +145,179 @@ def test_ghost_bound_pod_adopted_when_node_returns():
         assert free is not None and abs(free[cpu_axis] - 3300.0) < 1e-3
     finally:
         c.shutdown()
+
+
+def test_ghost_under_hard_spread_revokes_dependent_placement():
+    """A ghost's admission was counted by the scan AND the host replay:
+    a later same-batch placement legal only because of it must be
+    revoked (unassumed + requeued) when the ghost's node vanishes
+    mid-cycle — not committed at skew > max_skew.
+
+    Zones: A={nA}, B={nB (doomed), nB-small (keeps the domain alive but
+    cannot fit the pods)}. Pre-bound matching pod on nA (A=1, B=0). The
+    batch is X (priority 10 → scanned first, only B fits the skew) then
+    Y (→ A, legal ONLY with X counted: 1+1-min(1)=1). nB dies between
+    snapshot and assume: X ghosts, and with X gone Y-on-A is A=2/B=0 —
+    skew 2 > max_skew 1. The re-arbitration must pull Y back."""
+    ZONE = "topology.kubernetes.io/zone"
+    sel = obj.LabelSelector(match_labels={"app": "g"})
+
+    def spread_spec(cpu, priority=0):
+        return obj.PodSpec(
+            requests={"cpu": cpu}, priority=priority,
+            topology_spread_constraints=[obj.TopologySpreadConstraint(
+                max_skew=1, topology_key=ZONE,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=sel)])
+
+    c = Cluster()
+    try:
+        c.start(profile=Profile(plugins=["NodeUnschedulable",
+                                         "NodeResourcesFit",
+                                         "PodTopologySpread"]),
+                config=SchedulerConfig(backoff_initial_s=0.05,
+                                       backoff_max_s=0.2,
+                                       batch_window_s=0.3,
+                                       max_batch_size=8),
+                with_pv_controller=False)
+        c.create_node("nA", cpu=64000, labels={ZONE: "A"})
+        c.create_node("nB", cpu=150, labels={ZONE: "B"})
+        c.create_node("nB-small", cpu=50, labels={ZONE: "B"})
+        # pre-bound matching pod: A=1, B=0
+        c.create_pod("pre", labels={"app": "g"},
+                     spec=obj.PodSpec(requests={"cpu": 100},
+                                      node_name="nA"))
+        sched = c.service.scheduler
+        cache = sched.cache
+        wait_until(lambda: cache.assigned_count() == 1, 5.0)
+
+        orig = cache.snapshot_versioned
+        fired = threading.Event()
+        armed = threading.Event()
+
+        def racy_snapshot(*a, **kw):
+            out = orig(*a, **kw)
+            if (armed.is_set() and not fired.is_set()
+                    and cache.row_of("nB") is not None):
+                fired.set()
+                c.delete_node("nB")
+                wait_until(lambda: cache.row_of("nB") is None, 5.0)
+            return out
+
+        # arm the mid-cycle deletion ONLY for the cycle whose batch holds
+        # BOTH pods — a window split would otherwise ghost X alone and
+        # never form the dependent placement this test exists to check
+        orig_sb = sched.schedule_batch
+        cycle_done = threading.Event()
+
+        def wrapped_sb(batch):
+            both = {q.pod.metadata.name for q in batch} >= {"x", "y"}
+            if both:
+                armed.set()
+            out = orig_sb(batch)
+            if both:
+                cycle_done.set()  # commit finished (first cycle compiles)
+            return out
+
+        cache.snapshot_versioned = racy_snapshot
+        sched.schedule_batch = wrapped_sb
+        try:
+            # one batch: X first (priority), then Y
+            x_pod = obj.Pod(
+                metadata=obj.ObjectMeta(name="x", namespace="default",
+                                        labels={"app": "g"}),
+                spec=spread_spec(100, priority=10))
+            y_pod = obj.Pod(
+                metadata=obj.ObjectMeta(name="y", namespace="default",
+                                        labels={"app": "g"}),
+                spec=spread_spec(100, priority=5))
+            c.create_objects([x_pod, y_pod])
+            wait_until(fired.is_set, 10.0)
+            wait_until(cycle_done.is_set, 60.0)  # first cycle compiles
+            time.sleep(1.0)  # binder flush + several retry cycles
+        finally:
+            cache.snapshot_versioned = orig
+            sched.schedule_batch = orig_sb
+        x, y = c.get_pod("x"), c.get_pod("y")
+        # neither may be committed: X's zone-B capacity died with nB;
+        # Y-on-A would be the skew violation the re-arbitration exists
+        # to prevent
+        assert x.spec.node_name == "", x.spec.node_name
+        assert y.spec.node_name == "", y.spec.node_name
+        # final bound matching placements still honor max_skew
+        bound = [p for p in c.list_pods()
+                 if p.spec.node_name and p.metadata.labels.get("app") == "g"]
+        assert len(bound) == 1 and bound[0].metadata.name == "pre"
+    finally:
+        c.shutdown()
+
+
+def test_ghost_gang_member_revokes_siblings():
+    """Gang atomicity across the assume boundary: a gang member whose
+    chosen node dies mid-cycle (assume miss) must pull its assumed
+    siblings back — peers binding at sub-quorum is the partial-allocation
+    deadlock gang scheduling exists to prevent."""
+    c = Cluster()
+    try:
+        c.start(profile=Profile(plugins=["NodeUnschedulable",
+                                         "NodeResourcesFit"]),
+                config=SchedulerConfig(backoff_initial_s=0.05,
+                                       backoff_max_s=0.2,
+                                       batch_window_s=0.3,
+                                       max_batch_size=8),
+                with_pv_controller=False)
+        # each node fits exactly one member
+        c.create_node("g-n1", cpu=150)
+        c.create_node("g-n2", cpu=150)
+        sched = c.service.scheduler
+        cache = sched.cache
+
+        orig = cache.snapshot_versioned
+        fired = threading.Event()
+        armed = threading.Event()
+
+        def racy_snapshot(*a, **kw):
+            out = orig(*a, **kw)
+            if (armed.is_set() and not fired.is_set()
+                    and cache.row_of("g-n2") is not None):
+                fired.set()
+                c.delete_node("g-n2")
+                wait_until(lambda: cache.row_of("g-n2") is None, 5.0)
+            return out
+
+        orig_sb = sched.schedule_batch
+        cycle_done = threading.Event()
+
+        def wrapped_sb(batch):
+            both = {q.pod.metadata.name for q in batch} >= {"ga", "gb"}
+            if both:
+                armed.set()
+            out = orig_sb(batch)
+            if both:
+                cycle_done.set()
+            return out
+
+        cache.snapshot_versioned = racy_snapshot
+        sched.schedule_batch = wrapped_sb
+        try:
+            pods = [obj.Pod(
+                metadata=obj.ObjectMeta(name=n, namespace="default"),
+                spec=obj.PodSpec(requests={"cpu": 100}, pod_group="team",
+                                 pod_group_min=2))
+                for n in ("ga", "gb")]
+            c.create_objects(pods)
+            wait_until(fired.is_set, 10.0)
+            wait_until(cycle_done.is_set, 60.0)
+            time.sleep(1.0)
+        finally:
+            cache.snapshot_versioned = orig
+            sched.schedule_batch = orig_sb
+        ga, gb = c.get_pod("ga"), c.get_pod("gb")
+        # NEITHER member may be committed alone: the ghost requeued, and
+        # gang atomicity must pull the surviving sibling back too
+        bound = [p.metadata.name for p in (ga, gb) if p.spec.node_name]
+        assert len(bound) != 1, f"sub-quorum commit: only {bound} bound"
+        # capacity accounting consistent with the outcome
+        assert cache.assigned_count() == len(bound)
+    finally:
+        c.shutdown()
