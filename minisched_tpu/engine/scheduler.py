@@ -906,36 +906,15 @@ class Scheduler:
                     "RWO claim pinned by an earlier pod in this batch",
                     retryable=True)
 
-        repair_rows: List[int] = []
-        if self._spread_enabled and sp is not None:
-            s_revoked = self._arbitrate_packed(
-                batch, assigned, eb, decision, sp, dead=revoked)
-            from ..state.objects import CLAIM_UNUSED
-            for i in sorted(s_revoked):
-                qpi = batch[i]
-                st = vol_memo.get(qpi.pod.key)
-                # In-cycle repair candidates: re-placed against refreshed
-                # counts after the survivors are assumed (_repair_spread)
-                # instead of paying a full queue round-trip + backoff per
-                # tranche. Excluded: gang members (repairing one member
-                # alone breaks gang atomicity), pods holding unused RWO
-                # claims (a repair could move them off the node their
-                # claim was arbitrated against), and fail-closed pods —
-                # repair would BIND a placement the encoder could not
-                # represent faithfully; they must reach the fail_closed
-                # parking below via the normal revoked path.
-                if (self.config.spread_repair_iters
-                        and not qpi.pod.spec.pod_group
-                        and qpi.pod.key not in fail_closed
-                        and not (st is not None
-                                 and CLAIM_UNUSED in st[1])):
-                    repair_rows.append(i)
-                else:
-                    self._handle_failure(qpi, {BATCH_CAPACITY},
-                                         _SPREAD_REVOKE_MSG, retryable=True)
-            revoked = revoked | s_revoked
-
         if fail_closed:
+            # BEFORE the spread arbitration: fail-closed revocations (and
+            # their gang cascades) must be in its dead set — their scan-
+            # counted admissions otherwise leave a later placement
+            # committed over max_skew (the assume-miss staleness class,
+            # reachable with no node deletion at all). This order also
+            # guarantees fail-closed pods park TERMINALLY: the old
+            # post-arbitration placement let a spread-revoked fail-closed
+            # pod be requeued retryable first and skipped here.
             # Gang atomicity: failing one member closed parks its whole
             # gang — peers binding at sub-quorum is the partial-allocation
             # deadlock gang scheduling exists to prevent.
@@ -961,6 +940,33 @@ class Scheduler:
                 self._handle_failure(qpi, plugins, reason, retryable=False)
                 revoked = revoked | {i}
 
+        repair_rows: List[int] = []
+        if self._spread_enabled and sp is not None:
+            s_revoked = self._arbitrate_packed(
+                batch, assigned, eb, decision, sp, dead=revoked)
+            from ..state.objects import CLAIM_UNUSED
+            for i in sorted(s_revoked):
+                qpi = batch[i]
+                st = vol_memo.get(qpi.pod.key)
+                # In-cycle repair candidates: re-placed against refreshed
+                # counts after the survivors are assumed (_repair_spread)
+                # instead of paying a full queue round-trip + backoff per
+                # tranche. Excluded: gang members (repairing one member
+                # alone breaks gang atomicity) and pods holding unused RWO
+                # claims (a repair could move them off the node their
+                # claim was arbitrated against). Fail-closed pods never
+                # appear here — they were parked terminally above and are
+                # in the arbitration's dead set.
+                if (self.config.spread_repair_iters
+                        and not qpi.pod.spec.pod_group
+                        and not (st is not None
+                                 and CLAIM_UNUSED in st[1])):
+                    repair_rows.append(i)
+                else:
+                    self._handle_failure(qpi, {BATCH_CAPACITY},
+                                         _SPREAD_REVOKE_MSG, retryable=True)
+            revoked = revoked | s_revoked
+
         to_bind: List[tuple] = []  # permit-free (qpi, node_name) pairs
         # With no permit plugins in the profile (the common case) the
         # per-pod binding cycle reduces to assume + enqueue: batch the
@@ -970,7 +976,11 @@ class Scheduler:
         bulk_assume = not self.plugin_set.permit_plugins
         assume_items: List[tuple] = []
         assume_rows: List[int] = []
-        ghost_rows: List[int] = []  # assume-missed rows, both paths
+        # Rows whose SCAN-COUNTED admission vanished after the fact:
+        # assume misses (node deleted mid-cycle, both paths) and
+        # synchronous permit rejections. Either way later placements may
+        # be legal only because of them — see the post-assume block.
+        lost_rows: List[int] = []
         preempt_rows: List[int] = []          # deferred terminal verdicts
         preempt_plugins: Dict[int, Set[str]] = {}
         # Python-int views: per-element numpy scalar indexing inside a
@@ -1003,10 +1013,13 @@ class Scheduler:
                     assume_rows.append(i)
                     to_bind.append((qpi, node_name))
                 else:
-                    pair, ghost = self._start_binding_cycle(qpi, node_name)
+                    pair, ghost, rej = self._start_binding_cycle(
+                        qpi, node_name)
                     if ghost:
                         n_ghost += 1
-                        ghost_rows.append(i)
+                        lost_rows.append(i)
+                    elif rej:
+                        lost_rows.append(i)
                     if pair is not None:
                         to_bind.append(pair)
             elif gang_rejected_l[i]:
@@ -1080,37 +1093,39 @@ class Scheduler:
                         batch[assume_rows[m]], {BATCH_CAPACITY},
                         f"chosen node {node_name} was deleted during the "
                         "scheduling cycle", retryable=True)
-                ghost_rows.extend(assume_rows[m] for m in missed)
+                lost_rows.extend(assume_rows[m] for m in missed)
                 to_bind = [(q, n) for q, n in to_bind
                            if q.pod.key not in dead_keys]
 
-        if ghost_rows:
-            # Ghost staleness, both assume paths: the scan (and the host
-            # replay) COUNTED the ghost rows' admissions, so a later
+        if lost_rows:
+            # Post-assume staleness: the scan (and the host replay)
+            # COUNTED the lost rows' admissions — assume misses and
+            # synchronous permit rejections alike — so a later
             # same-batch placement may be legal only because of a
             # contribution that just vanished. Two consequences:
-            #   * gang atomicity — a ghosted member's siblings must not
+            #   * gang atomicity — a lost member's siblings must not
             #     bind at sub-quorum;
-            #   * hard-spread exactness — re-arbitrate with the ghosts
-            #     dead; a newly violating survivor is revoked.
+            #   * hard-spread exactness — re-arbitrate with the lost
+            #     rows dead; a newly violating survivor is revoked
+            #     (into the in-cycle repair pass when eligible).
             # Revocations go through _revoke_post_assume, which also
             # aborts an in-flight permit wait (non-bulk path); to_bind
             # has not been submitted yet, so dropped pairs never bind.
-            g_set = set(ghost_rows)
+            from ..state.objects import CLAIM_UNUSED
+            g_set = set(lost_rows)
             bind_keys = {q.pod.key for q, _ in to_bind}
             drop_keys: Set[str] = set()
-            ghost_gangs = {gang_key(batch[i].pod) for i in g_set
-                           if batch[i].pod.spec.pod_group}
-            if ghost_gangs:
+            lost_gangs = {gang_key(batch[i].pod) for i in g_set
+                          if batch[i].pod.spec.pod_group}
+            if lost_gangs:
                 for j, qpi in enumerate(batch):
                     if (j in g_set or j in revoked or not assigned_l[j]
-                            or gang_key(qpi.pod) not in ghost_gangs):
+                            or gang_key(qpi.pod) not in lost_gangs):
                         continue
                     if self._revoke_post_assume(
                             qpi, {COSCHEDULING, BATCH_CAPACITY},
-                            f"gang {qpi.pod.spec.pod_group} member's "
-                            "chosen node was deleted during the "
-                            "scheduling cycle",
+                            f"gang {qpi.pod.spec.pod_group} member lost "
+                            "its placement during the scheduling cycle",
                             in_bind=qpi.pod.key in bind_keys):
                         drop_keys.add(qpi.pod.key)
                         revoked = revoked | {j}
@@ -1122,7 +1137,19 @@ class Scheduler:
                     dead=revoked | g_set)
                 for i in sorted(re_rev):
                     qpi = batch[i]
-                    if self._revoke_post_assume(
+                    st = vol_memo.get(qpi.pod.key)
+                    if (self.config.spread_repair_iters
+                            and not qpi.pod.spec.pod_group
+                            and qpi.pod.key in bind_keys
+                            and not (st is not None
+                                     and CLAIM_UNUSED in st[1])):
+                        # same in-cycle repair offer the first-pass
+                        # revocations get — no queue round-trip
+                        self._unassume(qpi)
+                        drop_keys.add(qpi.pod.key)
+                        repair_rows.append(i)
+                        revoked = revoked | {i}
+                    elif self._revoke_post_assume(
                             qpi, {BATCH_CAPACITY}, _SPREAD_REVOKE_MSG,
                             in_bind=qpi.pod.key in bind_keys):
                         drop_keys.add(qpi.pod.key)
@@ -1396,6 +1423,7 @@ class Scheduler:
             items, req_rows, next_rows = [], [], []
             iter_rows: List[int] = []  # batch row per ``items`` entry
             iter_bind: List[tuple] = []
+            ghost_js: List[int] = []   # sub-rows lost to assume misses
             for j in range(n_r):
                 i = rows[j]
                 if assigned2[j] and j not in rev2:
@@ -1410,13 +1438,22 @@ class Scheduler:
                         iter_rows.append(i)
                         iter_bind.append((batch[i], node_name))
                     else:
-                        pair, ghost = self._start_binding_cycle(
+                        pair, ghost, rej = self._start_binding_cycle(
                             batch[i], node_name)
                         if ghost:
                             # not placed at all — the row goes back into
                             # the loop like a bulk-path miss
                             n_admitted -= 1
                             next_rows.append(i)
+                            ghost_js.append(j)
+                        elif rej:
+                            # synchronous permit rejection: terminal for
+                            # the pod (handled inside the cycle call) but
+                            # its scan-counted admission vanished — dead
+                            # for this iteration's re-arbitration.
+                            # (Still counted admitted, matching the main
+                            # cycle's accounting for permit outcomes.)
+                            ghost_js.append(j)
                         elif pair is not None:
                             out_bind.append(pair)
                 else:
@@ -1435,9 +1472,42 @@ class Scheduler:
                     n_admitted -= len(missed)
                     dead = set(missed)  # membership filter below
                     next_rows.extend(iter_rows[m] for m in missed)
+                    ghost_js.extend(req_rows[m] for m in missed)
                     iter_bind = [p for m, p in enumerate(iter_bind)
                                  if m not in dead]
-                out_bind.extend(iter_bind)
+            if ghost_js:
+                # Same assume-miss staleness as the main cycle: this
+                # iteration's walk counted the ghosts' admissions, so a
+                # surviving placement may be legal only because of them.
+                # Re-arbitrate with the ghosts dead; newly violating
+                # survivors are unassumed and re-loop (their bind pairs
+                # are still unsubmitted), permit-waiting ones are
+                # revoked through their async continuation.
+                re3 = self._arbitrate_packed(
+                    sub, assigned2, eb2, d2, sp2,
+                    dead=rev2 | set(ghost_js)) - rev2 - set(ghost_js)
+                if re3:
+                    pair_keys = ({p[0].pod.key for p in iter_bind}
+                                 | {p[0].pod.key for p in out_bind})
+                    kill: Set[str] = set()
+                    for j in sorted(re3):
+                        qpi = batch[rows[j]]
+                        k = qpi.pod.key
+                        if k in pair_keys:
+                            self._unassume(qpi)
+                            kill.add(k)
+                            next_rows.append(rows[j])
+                            n_admitted -= 1
+                        elif self._revoke_post_assume(
+                                qpi, {BATCH_CAPACITY},
+                                _SPREAD_REVOKE_MSG, in_bind=False):
+                            n_admitted -= 1
+                    if kill:
+                        iter_bind = [p for p in iter_bind
+                                     if p[0].pod.key not in kill]
+                        out_bind = [p for p in out_bind
+                                    if p[0].pod.key not in kill]
+            out_bind.extend(iter_bind)
             rows = next_rows
             if len(next_rows) == n_r:  # no progress; stop burning steps
                 break
@@ -2016,13 +2086,16 @@ class Scheduler:
     # ---- permit + binding cycle ----------------------------------------
 
     def _start_binding_cycle(self, qpi: QueuedPodInfo, node_name: str):
-        """Assume + permit. Returns (pair, ghost): ``pair`` is
+        """Assume + permit. Returns (pair, ghost, rejected): ``pair`` is
         (qpi, node_name) when the pod is permit-free so the caller can
         bulk-commit the whole batch in one store transaction, None when
         the pod was parked for a permit wait (bound later, per-pod) or
         failed permit; ``ghost`` is True when the pod was NOT placed at
         all because its chosen node's row vanished mid-cycle (the caller
-        must not count it as assigned)."""
+        must not count it as assigned); ``rejected`` is True when a
+        permit plugin rejected SYNCHRONOUSLY — the pod was unassumed,
+        so like a ghost its scan-counted admission vanished and the
+        caller must feed it to the post-assume re-arbitration."""
         pod = qpi.pod
         # Assume the pod onto the node immediately so the next batch's
         # snapshot sees the capacity taken (upstream assume/forget model).
@@ -2034,7 +2107,7 @@ class Scheduler:
                 qpi, {BATCH_CAPACITY},
                 f"chosen node {node_name} was deleted during the "
                 "scheduling cycle", retryable=True)
-            return None, True
+            return None, True, False
 
         waits = []
         for plugin in self.plugin_set.permit_plugins:
@@ -2049,7 +2122,7 @@ class Scheduler:
                     qpi, {plugin.name},
                     f"pod rejected by permit plugin {plugin.name}",
                     retryable=False)
-                return None, False
+                return None, False, True
             if status == "wait":
                 waits.append((plugin.name, delay, timeout))
 
@@ -2061,8 +2134,8 @@ class Scheduler:
                 self.waiting_pods[pod.key] = wp
             max_timeout = max(t for _, _, t in waits)
             self._binder.submit(self._wait_and_bind, qpi, wp, max_timeout)
-            return None, False
-        return (qpi, node_name), False
+            return None, False, False
+        return (qpi, node_name), False, False
 
     def _wait_and_bind(self, qpi: QueuedPodInfo, wp: WaitingPod,
                        max_timeout: float) -> None:

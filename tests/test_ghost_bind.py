@@ -321,3 +321,110 @@ def test_ghost_gang_member_revokes_siblings():
         assert cache.assigned_count() == len(bound)
     finally:
         c.shutdown()
+
+
+def test_engine_revocation_beats_racing_permit_allow():
+    """The permit signal channel is first-send-wins: an ALLOW that lands
+    before _revoke_post_assume's reject silently swallows it. The
+    allowed branch of _wait_and_bind must still honor the revocation
+    mark (set under the waiting-pods lock before the pop) — otherwise a
+    ghost-revoked pod binds anyway at sub-quorum / over max_skew."""
+    from minisched_tpu.engine.queue import QueuedPodInfo
+    from minisched_tpu.engine.scheduler import Scheduler
+    from minisched_tpu.engine.waitingpod import WaitingPod
+    from minisched_tpu.state.store import ClusterStore
+
+    # engine WITHOUT its run loop (no service): no scheduling thread can
+    # race this test's hand-driven permit continuation
+    store = ClusterStore()
+    node = _node("n1", cpu=64000)
+    store.create(node)
+    sched = Scheduler(store, Profile(plugins=["NodeUnschedulable",
+                                              "NodeResourcesFit"]).build(),
+                      SchedulerConfig(backoff_initial_s=0.05))
+    try:
+        sched.cache.upsert_node(node)
+        pod = store.create(_pod("racer", cpu=100))
+        qpi = QueuedPodInfo(pod=pod)
+        assert sched.cache.account_bind(pod, node_name="n1")
+
+        wp = WaitingPod(pod, "n1", [("P", 0.0, 5.0)])
+        wp.allow("P")                     # ALLOW queued first...
+        with sched._waiting_lock:
+            sched.waiting_pods[pod.key] = wp
+        # ...engine revocation arrives second; its reject is dropped by
+        # the first-send-wins channel
+        assert sched._revoke_post_assume(
+            qpi, {"BatchCapacity"}, "ghost revocation", in_bind=False)
+        # drain the async continuation the real binder would run
+        sched._wait_and_bind(qpi, wp, 1.0)
+        assert store.get("Pod", pod.key).spec.node_name == ""  # never bound
+        assert sched.cache.assigned_count() == 0               # unassumed
+    finally:
+        sched.shutdown()
+
+
+def test_fail_closed_revocation_feeds_spread_arbitration():
+    """The staleness class without any node deletion: X is placed by the
+    scan (its admission counted) but fails closed host-side (3rd spread
+    constraint, DoNotSchedule, overflows the 2 encoder slots). Y's
+    placement on zone A was legal only because X filled zone B. The
+    fail-closed revocation now runs BEFORE the spread arbitration, so Y
+    is revoked and repaired onto the nB capacity X released — never
+    committed on A at skew 2 > max_skew 1."""
+    ZONE = "topology.kubernetes.io/zone"
+    sel = obj.LabelSelector(match_labels={"app": "g"})
+
+    def con(key, when):
+        return obj.TopologySpreadConstraint(
+            max_skew=1, topology_key=key, when_unsatisfiable=when,
+            label_selector=sel)
+
+    c = Cluster()
+    try:
+        c.start(profile=Profile(plugins=["NodeUnschedulable",
+                                         "NodeResourcesFit",
+                                         "PodTopologySpread"]),
+                config=SchedulerConfig(backoff_initial_s=0.05,
+                                       backoff_max_s=0.2,
+                                       batch_window_s=0.3,
+                                       max_batch_size=8),
+                with_pv_controller=False)
+        c.create_node("nA", cpu=64000, labels={ZONE: "A"})
+        c.create_node("nB", cpu=150, labels={ZONE: "B"})
+        c.create_node("nB-small", cpu=50, labels={ZONE: "B"})
+        c.create_pod("pre", labels={"app": "g"},
+                     spec=obj.PodSpec(requests={"cpu": 100},
+                                      node_name="nA"))
+        sched = c.service.scheduler
+        wait_until(lambda: sched.cache.assigned_count() == 1, 5.0)
+
+        x_pod = obj.Pod(
+            metadata=obj.ObjectMeta(name="x", namespace="default",
+                                    labels={"app": "g"}),
+            spec=obj.PodSpec(
+                requests={"cpu": 100}, priority=10,
+                topology_spread_constraints=[
+                    con(ZONE, "DoNotSchedule"),
+                    con("topology.kubernetes.io/rack", "ScheduleAnyway"),
+                    # 3rd constraint overflows the 2 encoder slots and is
+                    # hard -> X fails closed under PodTopologySpread
+                    con("topology.kubernetes.io/row", "DoNotSchedule")]))
+        y_pod = obj.Pod(
+            metadata=obj.ObjectMeta(name="y", namespace="default",
+                                    labels={"app": "g"}),
+            spec=obj.PodSpec(
+                requests={"cpu": 100}, priority=5,
+                topology_spread_constraints=[con(ZONE, "DoNotSchedule")]))
+        c.create_objects([x_pod, y_pod])
+
+        y = c.wait_for_pod_bound("y", timeout=30.0)
+        # Y must land on the capacity X released in zone B — landing on
+        # nA would be the skew-2 commit the pre-arbitration fail-closed
+        # revocation prevents
+        assert y.spec.node_name == "nB", y.spec.node_name
+        x = c.get_pod("x")
+        assert x.spec.node_name == ""
+        assert "PodTopologySpread" in (x.status.unschedulable_plugins or ())
+    finally:
+        c.shutdown()
