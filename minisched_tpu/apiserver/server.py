@@ -27,6 +27,13 @@ store's existing CRUD + versioned watch:
     POST   /bind                         → bulk bind ([[key, node], ...]
            body → {"bound": [keys]}; already-bound/gone pods skipped)
     GET    /healthz
+    GET    /metrics                      → Prometheus text exposition:
+           server request/rejection counters, per-kind object counts,
+           watch-log depth, plus any gauges registered through
+           ``APIServer.metrics_providers`` (e.g. a co-located
+           scheduler's cycle metrics). The real kube-apiserver serves
+           /metrics the same way; the reference inherits it from the
+           upstream server it embeds.
 
 Errors map to status codes: 404 NotFound, 409 AlreadyExists/Conflict,
 400 bad input, 401 missing/bad bearer token (auth enabled), 429 over the
@@ -77,7 +84,17 @@ class APIServer:
         # exposed for tests: deterministic saturation without timing games
         self._inflight = (threading.BoundedSemaphore(max_inflight)
                           if max_inflight > 0 else None)
-        handler = _make_handler(store, token, self._inflight)
+        # /metrics extension point: callables returning {name: number};
+        # a co-located SchedulerService appends the engine's metrics()
+        # so one scrape covers the whole simulator (emitted with the
+        # minisched_engine_ prefix). Providers must be thread-safe.
+        self.metrics_providers: list = []
+        # server-side request counters for /metrics (lock-guarded)
+        self._counters: dict = {}
+        self._counters_lock = threading.Lock()
+        handler = _make_handler(store, token, self._inflight,
+                                self.metrics_providers, self._counters,
+                                self._counters_lock)
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
@@ -102,7 +119,19 @@ class APIServer:
 
 
 def _make_handler(store: ClusterStore, token: str | None = None,
-                  inflight: threading.BoundedSemaphore | None = None):
+                  inflight: threading.BoundedSemaphore | None = None,
+                  metrics_providers: list | None = None,
+                  counters: dict | None = None,
+                  counters_lock: threading.Lock | None = None):
+    if counters is None:
+        counters = {}
+    if counters_lock is None:
+        counters_lock = threading.Lock()
+
+    def bump(name: str) -> None:
+        with counters_lock:
+            counters[name] = counters.get(name, 0) + 1
+
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -181,9 +210,11 @@ def _make_handler(store: ClusterStore, token: str | None = None,
             route = urlparse(self.path).path.strip("/")
             if route == "healthz":
                 return fn()
+            bump(f"requests_{self.command.lower()}")
             if token is not None:
                 auth = self.headers.get("Authorization", "")
                 if auth != f"Bearer {token}":
+                    bump("rejected_unauthorized")
                     self._drain_body()
                     return self._error(
                         401, "missing or invalid bearer token",
@@ -197,6 +228,7 @@ def _make_handler(store: ClusterStore, token: str | None = None,
             if not inflight.acquire(blocking=False):
                 # the k8s APF reject: 429 + Retry-After; client-go sleeps
                 # and retries, and so does RemoteStore
+                bump("rejected_too_many_requests")
                 self._drain_body()
                 return self._error(429, "too many in-flight requests",
                                    reason="TooManyRequests",
@@ -224,6 +256,8 @@ def _make_handler(store: ClusterStore, token: str | None = None,
             kind, key, q = self._route()
             if kind == "healthz":
                 return self._send(200, {"ok": True})
+            if kind == "metrics":
+                return self._guard(self._metrics)
             if kind == "watch":
                 return self._guard(lambda: self._watch(q))
             if kind == "snapshot":
@@ -238,6 +272,55 @@ def _make_handler(store: ClusterStore, token: str | None = None,
                     self._send(200, {"items": [obj.to_dict(o)
                                                for o in store.list(kind)]})
             self._guard(run)
+
+        def _metrics(self):
+            """Prometheus text exposition (version 0.0.4): server
+            counters, store gauges, and registered provider gauges. Keys
+            are sanitized to metric-name characters; non-numeric provider
+            values are skipped (providers may carry diagnostic fields
+            like batch_sizes lists)."""
+            import re as _re
+
+            def clean(name: str) -> str:
+                return _re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+            lines = []
+
+            def emit(name, value, mtype="gauge", labels=""):
+                lines.append(f"# TYPE {name} {mtype}")
+                lines.append(f"{name}{labels} {value}")
+
+            with counters_lock:
+                snap = dict(counters)
+            for k in sorted(snap):
+                emit(f"minisched_apiserver_{clean(k)}_total", snap[k],
+                     "counter")
+            st = store.stats()
+            # one TYPE line for the metric, then all its samples — the
+            # 0.0.4 exposition format rejects repeated TYPE lines
+            lines.append("# TYPE minisched_store_objects gauge")
+            for kind, n in sorted(st["objects"].items()):
+                lines.append(
+                    f'minisched_store_objects{{kind="{kind}"}} {n}')
+            emit("minisched_store_resource_version",
+                 st["resource_version"], "counter")
+            emit("minisched_store_watch_log_depth", st["watch_log_depth"])
+            emit("minisched_store_watch_log_capacity",
+                 st["watch_log_capacity"])
+            for provider in (metrics_providers or ()):
+                try:
+                    for k, v in provider().items():
+                        if isinstance(v, (int, float)):
+                            emit(f"minisched_engine_{clean(k)}", v)
+                except Exception:  # a broken provider must not 500 scrapes
+                    log.exception("metrics provider failed")
+            body = ("\n".join(lines) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
 
         def _watch(self, q):
             """Stateless long-poll watch: each call opens a cursor at

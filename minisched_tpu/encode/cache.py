@@ -112,6 +112,19 @@ class NodeFeatureCache:
         # compile/device-static caches from flapping under churn.
         self._rows_hw = 0
         self._a_hw = 0
+        # Per-row TOPOLOGY incarnation: bumped when a row is (re)allocated
+        # to a name or its topo-domain column changes on upsert. The
+        # engine assumes pods BY NODE NAME against a snapshot taken
+        # earlier in the cycle — a same-named node deleted and re-created
+        # with different topology labels mid-cycle would otherwise commit
+        # the pod into a domain the scan never judged (observed in chaos
+        # as a hard-skew violation under zone-rotating node churn).
+        # account_bind* treat an incarnation mismatch as a miss. Values
+        # come from ONE global counter (never reused), so a replacement
+        # that lands on a different row can never collide with the old
+        # row's value.
+        self._row_inc = np.zeros(capacity, dtype=np.int64)
+        self._inc_counter = 0
         # pod key → (node row, requests vector, host ports, claim keys) for
         # incremental free-resource accounting; only bound pods appear here.
         self._bound: Dict[str, Tuple[int, np.ndarray, List[int], List[str]]] = {}
@@ -176,13 +189,23 @@ class NodeFeatureCache:
         it; snapshot takes this lock, so atomicity follows."""
         with self._lock:
             i = self._index.get(node.metadata.name)
-            if i is None:
+            fresh_row = i is None
+            if fresh_row:
                 i = self._alloc_row()
                 self._index[node.metadata.name] = i
                 self._names[i] = node.metadata.name
+                old_topo = None
+            else:
+                old_topo = self._feats.topo_domains[:, i].copy()
             # Re-encoding resets static columns; free is derived below.
             F.encode_node_into(self._feats, i, node, self.overflow)
             F.compute_topo_domains_row(self._feats, i, self.registry, self.cfg)
+            if fresh_row or not np.array_equal(
+                    old_topo, self._feats.topo_domains[:, i]):
+                # new incarnation for assume-by-name purposes: a pending
+                # assume judged against the previous topology must miss
+                self._inc_counter += 1
+                self._row_inc[i] = self._inc_counter
             self._recompute_free_row(i)
             for pod in bound_pods:
                 self._account_bind_locked(pod, node.metadata.name)
@@ -224,7 +247,8 @@ class NodeFeatureCache:
 
     # ---- pod accounting -------------------------------------------------
 
-    def account_bind(self, pod: Pod, node_name: str = "") -> bool:
+    def account_bind(self, pod: Pod, node_name: str = "",
+                     expected_inc: Optional[int] = None) -> bool:
         """Pod became bound: subtract its requests from the node's free row
         and add it to the assigned-pod corpus. ``node_name`` overrides
         ``pod.spec.node_name`` for the assume path, where the engine
@@ -236,18 +260,29 @@ class NodeFeatureCache:
         cache never saw) — the accounting did NOT happen and the caller
         must react (requeue the pod, or park it for re-adoption when a
         same-named node returns). A silent miss here is how a pod becomes
-        permanently invisible to capacity/topology accounting."""
+        permanently invisible to capacity/topology accounting.
+
+        ``expected_inc`` (snapshot_versioned's row incarnation for the
+        chosen row): a mismatch means the NAME now resolves to a node
+        with DIFFERENT topology than the one the scheduling step judged
+        (deleted + re-created with new labels mid-cycle) — treated as a
+        miss, so the caller requeues and the next cycle sees the real
+        topology."""
         with self._lock:
-            ok = self._account_bind_locked(pod, node_name)
+            ok = self._account_bind_locked(pod, node_name,
+                                           expected_inc=expected_inc)
             self.version += 1
             return ok
 
-    def account_bind_bulk(self, items, req_rows=None) -> List[int]:
+    def account_bind_bulk(self, items, req_rows=None,
+                          expected_inc=None) -> List[int]:
         """Assume a whole batch in one lock acquisition: ``items`` is a
         list of (pod, node_name). Returns the positions in ``items`` whose
         named node had NO row (deleted between snapshot and assume) — those
         pods were NOT accounted and the caller must requeue or park them
-        (see ``account_bind``).
+        (see ``account_bind``). ``expected_inc`` (optional, aligned with
+        ``items``): per-item snapshot row incarnations; a mismatch is a
+        miss (node replaced with different topology mid-cycle).
 
         ``req_rows`` optionally supplies the
         encoder's request rows (encode.PodFeatures.requests) so the
@@ -280,16 +315,19 @@ class NodeFeatureCache:
                 if pod.key in batch_seen:
                     continue
                 batch_seen.add(pod.key)
+                exp = None if expected_inc is None else expected_inc[k]
                 if (reqs is None or pod.spec.volumes or pod.spec.ports
                         or self._pod_has_anti(pod)
                         or pod.key in self._bound):
                     if not self._account_bind_locked(
                             pod, node_name,
-                            None if reqs is None else reqs[k].copy()):
+                            None if reqs is None else reqs[k].copy(),
+                            expected_inc=exp):
                         missed.append(k)
                     continue
                 i = self._index.get(node_name or pod.spec.node_name)
-                if i is None:
+                if i is None or (exp is not None
+                                 and self._row_inc[i] != exp):
                     missed.append(k)
                     continue
                 fast.append((k, i, pod))
@@ -347,12 +385,15 @@ class NodeFeatureCache:
             return missed
 
     def _account_bind_locked(self, pod: Pod, node_name: str = "",
-                             req: Optional[np.ndarray] = None) -> bool:
+                             req: Optional[np.ndarray] = None,
+                             expected_inc: Optional[int] = None) -> bool:
         """Returns False on a node-row miss (NOT accounted); True when the
         pod is accounted — including the idempotent already-bound case."""
         i = self._index.get(node_name or pod.spec.node_name)
         if i is None:
             return False
+        if expected_inc is not None and self._row_inc[i] != expected_inc:
+            return False  # same name, different topology incarnation
         if pod.key in self._bound:
             return True
         if req is None:
@@ -505,7 +546,7 @@ class NodeFeatureCache:
         ``pad`` may be smaller than capacity when every row beyond it is
         empty (e.g. capacity doubled to 64k for 50k nodes; a 51200 pad
         avoids wasting 30% of the matrices on padding)."""
-        feats, names, _sv = self.snapshot_versioned(pad)
+        feats, names, _sv, _incs = self.snapshot_versioned(pad)
         return feats, names
 
     def snapshot_versioned(self,
@@ -530,6 +571,11 @@ class NodeFeatureCache:
         node add on the informer thread can never allocate a row past a
         pad the caller computed from a stale high-water read (row
         allocation takes the same lock).
+
+        Returns (feats, names, static_version, row_incarnations) — the
+        incarnation column (padded with zeros) lets assume-by-name
+        detect a node replaced with different topology mid-cycle
+        (account_bind's ``expected_inc``).
         """
         with self._lock:
             self._refresh_topology_locked()
@@ -571,7 +617,10 @@ class NodeFeatureCache:
                     leaves.append(e)
                 feats = NodeFeatures(*leaves)
                 names = list(self._names) + [None] * (target - n)
-            return feats, names, sv
+            incs = np.zeros(target, dtype=np.int64)
+            m = min(target, n)
+            incs[:m] = self._row_inc[:m]
+            return feats, names, sv, incs
 
     def snapshot_assigned(self, pad: Union[int, Callable[[int], int],
                                          None] = None,
@@ -841,6 +890,9 @@ class NodeFeatureCache:
             self._feats = grown
             self._names += [None] * (new_cap - self._capacity)
             self._free_rows = list(range(new_cap - 1, self._capacity - 1, -1))
+            inc = np.zeros(new_cap, dtype=np.int64)
+            inc[: self._capacity] = self._row_inc
+            self._row_inc = inc
             self._capacity = new_cap
         row = self._free_rows.pop()
         if row >= self._rows_hw:

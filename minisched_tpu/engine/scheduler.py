@@ -791,7 +791,7 @@ class Scheduler:
         # and the cache skips host copies of static leaves we already hold
         # on device (known_static hit).
         cached = self._nf_static_device
-        nf, names, static_v = self.cache.snapshot_versioned(
+        nf, names, static_v, row_incs = self.cache.snapshot_versioned(
             pad=self._node_pad,
             known_static=cached[0] if cached else None)
         af = self.cache.snapshot_assigned(pad=self._af_pad)
@@ -976,6 +976,7 @@ class Scheduler:
         bulk_assume = not self.plugin_set.permit_plugins
         assume_items: List[tuple] = []
         assume_rows: List[int] = []
+        assume_incs: List[int] = []  # snapshot row incarnations per item
         # Rows whose SCAN-COUNTED admission vanished after the fact:
         # assume misses (node deleted mid-cycle, both paths) and
         # synchronous permit rejections. Either way later placements may
@@ -1011,10 +1012,12 @@ class Scheduler:
                 if bulk_assume:
                     assume_items.append((qpi.pod, node_name))
                     assume_rows.append(i)
+                    assume_incs.append(int(row_incs[chosen_l[i]]))
                     to_bind.append((qpi, node_name))
                 else:
                     pair, ghost, rej = self._start_binding_cycle(
-                        qpi, node_name)
+                        qpi, node_name,
+                        expected_inc=int(row_incs[chosen_l[i]]))
                     if ghost:
                         n_ghost += 1
                         lost_rows.append(i)
@@ -1074,7 +1077,8 @@ class Scheduler:
 
         if assume_items:
             missed = self.cache.account_bind_bulk(
-                assume_items, req_rows=eb.pf.requests[assume_rows])
+                assume_items, req_rows=eb.pf.requests[assume_rows],
+                expected_inc=assume_incs)
             if missed:
                 # The chosen node's cache row vanished between the cycle's
                 # snapshot and this assume (node deleted mid-cycle). Bind
@@ -1180,7 +1184,7 @@ class Scheduler:
             # and misses pods the repair loop re-placed elsewhere; the
             # cache's assumed state is the committed truth.
             cached = self._nf_static_device
-            nf_p, names_p, sv_p = self.cache.snapshot_versioned(
+            nf_p, names_p, sv_p, _incs_p = self.cache.snapshot_versioned(
                 pad=self._node_pad,
                 known_static=cached[0] if cached else None)
             nf_p = self._with_device_static(nf_p, sv_p)
@@ -1388,7 +1392,7 @@ class Scheduler:
             if not rows or step_fn is None:
                 break
             cached = self._nf_static_device
-            nf, names, static_v = self.cache.snapshot_versioned(
+            nf, names, static_v, row_incs = self.cache.snapshot_versioned(
                 pad=self._node_pad,
                 known_static=cached[0] if cached else None)
             af = self.cache.snapshot_assigned(pad=self._af_pad)
@@ -1421,6 +1425,7 @@ class Scheduler:
             rev2 = self._arbitrate_packed(
                 sub, assigned2, eb2, d2, sp2, dead=set())
             items, req_rows, next_rows = [], [], []
+            iter_incs: List[int] = []  # snapshot incarnation per item
             iter_rows: List[int] = []  # batch row per ``items`` entry
             iter_bind: List[tuple] = []
             ghost_js: List[int] = []   # sub-rows lost to assume misses
@@ -1435,11 +1440,13 @@ class Scheduler:
                     if bulk:
                         items.append((batch[i].pod, node_name))
                         req_rows.append(j)
+                        iter_incs.append(int(row_incs[int(chosen2[j])]))
                         iter_rows.append(i)
                         iter_bind.append((batch[i], node_name))
                     else:
                         pair, ghost, rej = self._start_binding_cycle(
-                            batch[i], node_name)
+                            batch[i], node_name,
+                            expected_inc=int(row_incs[int(chosen2[j])]))
                         if ghost:
                             # not placed at all — the row goes back into
                             # the loop like a bulk-path miss
@@ -1463,7 +1470,8 @@ class Scheduler:
                     next_rows.append(i)
             if items:
                 missed = self.cache.account_bind_bulk(
-                    items, req_rows=eb2.pf.requests[req_rows])
+                    items, req_rows=eb2.pf.requests[req_rows],
+                    expected_inc=iter_incs)
                 if missed:
                     # Chosen node deleted mid-cycle (see the main cycle's
                     # assume-miss path): not accounted, must not bind —
@@ -2085,7 +2093,8 @@ class Scheduler:
 
     # ---- permit + binding cycle ----------------------------------------
 
-    def _start_binding_cycle(self, qpi: QueuedPodInfo, node_name: str):
+    def _start_binding_cycle(self, qpi: QueuedPodInfo, node_name: str,
+                             expected_inc: Optional[int] = None):
         """Assume + permit. Returns (pair, ghost, rejected): ``pair`` is
         (qpi, node_name) when the pod is permit-free so the caller can
         bulk-commit the whole batch in one store transaction, None when
@@ -2099,7 +2108,8 @@ class Scheduler:
         pod = qpi.pod
         # Assume the pod onto the node immediately so the next batch's
         # snapshot sees the capacity taken (upstream assume/forget model).
-        if not self.cache.account_bind(pod, node_name=node_name):
+        if not self.cache.account_bind(pod, node_name=node_name,
+                                       expected_inc=expected_inc):
             # Node row deleted between snapshot and assume — binding now
             # would commit a ghost placement the model can never account
             # (see the bulk-assume miss path). Requeue for a fresh cycle.

@@ -206,6 +206,18 @@ class ClusterStore:
             refs = list(self._objects[kind].values())
         return [deepcopy_obj(o) for o in refs]
 
+    def stats(self) -> Dict[str, Any]:
+        """One consistent reading of the store's observable state for
+        the apiserver's /metrics endpoint: per-kind object counts, the
+        current resource version, and the watch log's retained depth."""
+        with self._cond:
+            return {
+                "objects": {k: len(v) for k, v in self._objects.items()},
+                "resource_version": self._rv,
+                "watch_log_depth": len(self._log),
+                "watch_log_capacity": self._max_log,
+            }
+
     def count(self, kind: str) -> int:
         with self._cond:
             return len(self._objects[kind])

@@ -196,11 +196,11 @@ def test_snapshot_versioned_static_elision_and_atomicity():
     leaf copies only on an exact (version, pad) match."""
     c = NodeFeatureCache()
     c.upsert_node(node("n0"))
-    nf, names, sv = c.snapshot_versioned()
+    nf, names, sv, incs = c.snapshot_versioned()
     assert all(getattr(nf, f) is not None for f in nf._fields)
 
     # Hit: same version+pad → static leaves elided, dynamic ones present.
-    nf2, _, sv2 = c.snapshot_versioned(known_static=(sv, nf.free.shape[0]))
+    nf2, _, sv2, _ = c.snapshot_versioned(known_static=(sv, nf.free.shape[0]))
     assert sv2 == sv
     assert nf2.allocatable is None and nf2.topo_domains is None
     assert nf2.free is not None and nf2.used_ports is not None
@@ -209,13 +209,13 @@ def test_snapshot_versioned_static_elision_and_atomicity():
     # static version INSIDE snapshot_versioned → the stale key must miss
     # (full copies returned) and the new version must be the one returned.
     c.registry.index_of("example.com/rack")
-    nf3, _, sv3 = c.snapshot_versioned(known_static=(sv, nf.free.shape[0]))
+    nf3, _, sv3, _ = c.snapshot_versioned(known_static=(sv, nf.free.shape[0]))
     assert sv3 > sv
     assert nf3.topo_domains is not None  # fresh copy, not elided
 
     # Bind accounting must NOT bump the static version.
     c.account_bind(pod("p0", cpu=10), node_name="n0")
-    _, _, sv4 = c.snapshot_versioned()
+    _, _, sv4, _ = c.snapshot_versioned()
     assert sv4 == sv3
 
 

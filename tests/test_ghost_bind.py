@@ -428,3 +428,99 @@ def test_fail_closed_revocation_feeds_spread_arbitration():
         assert "PodTopologySpread" in (x.status.unschedulable_plugins or ())
     finally:
         c.shutdown()
+
+
+def test_node_replaced_with_new_zone_mid_cycle_misses_assume():
+    """The chaos-caught hole: the assume is BY NAME, so a node deleted
+    and re-created with a different zone label between the cycle's
+    snapshot and the assume used to commit the pod into a domain the
+    scan never judged (observed as hard-skew violations under
+    zone-rotating churn). The row-incarnation check must turn that into
+    an assume miss: the pod requeues and places against the REAL
+    topology next cycle."""
+    ZONE = "topology.kubernetes.io/zone"
+    sel = obj.LabelSelector(match_labels={"app": "g"})
+
+    def spread_spec(cpu):
+        return obj.PodSpec(
+            requests={"cpu": cpu},
+            topology_spread_constraints=[obj.TopologySpreadConstraint(
+                max_skew=1, topology_key=ZONE,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=sel)])
+
+    c = Cluster()
+    try:
+        c.start(profile=Profile(plugins=["NodeUnschedulable",
+                                         "NodeResourcesFit",
+                                         "PodTopologySpread"]),
+                config=SchedulerConfig(backoff_initial_s=0.05,
+                                       backoff_max_s=0.2,
+                                       batch_window_s=0.2),
+                with_pv_controller=False)
+        # zone A is full for matching pods (skew: A=1 B=0 ⇒ only B
+        # legal); zC-small cannot fit any pod but keeps zone C EXISTING,
+        # so after zB's replacement the min stays 0 and a commit on any
+        # zone-A node remains illegal (without it, zone B's disappearance
+        # would make a retry placement on A legal and the stale-belief
+        # commit indistinguishable from the correct path)
+        c.create_node("zA", cpu=64000, labels={ZONE: "A"})
+        c.create_node("zB", cpu=64000, labels={ZONE: "B"})
+        c.create_node("zC-small", cpu=50, labels={ZONE: "C"})
+        c.create_pod("pre", labels={"app": "g"},
+                     spec=obj.PodSpec(requests={"cpu": 100},
+                                      node_name="zA"))
+        sched = c.service.scheduler
+        cache = sched.cache
+        wait_until(lambda: cache.assigned_count() == 1, 5.0)
+
+        orig = cache.snapshot_versioned
+        fired = threading.Event()
+
+        def racy_snapshot(*a, **kw):
+            out = orig(*a, **kw)
+            if not fired.is_set() and cache.row_of("zB") is not None:
+                fired.set()
+                # replace zB with a SAME-NAMED node in zone A: the scan
+                # will choose "zB" believing it is zone B
+                c.delete_node("zB")
+                wait_until(lambda: cache.row_of("zB") is None, 5.0)
+                c.create_node("zB", cpu=64000, labels={ZONE: "A"})
+                wait_until(lambda: cache.row_of("zB") is not None, 5.0)
+            return out
+
+        orig_sb = sched.schedule_batch
+        cycle_done = threading.Event()
+
+        def wrapped_sb(batch):
+            mine = any(q.pod.metadata.name == "p" for q in batch)
+            out = orig_sb(batch)
+            if mine:
+                cycle_done.set()  # the commit (incl. async submit) ended
+            return out
+
+        cache.snapshot_versioned = racy_snapshot
+        sched.schedule_batch = wrapped_sb
+        try:
+            c.create_pod("p", labels={"app": "g"}, spec=spread_spec(100))
+            wait_until(fired.is_set, 10.0)
+            wait_until(cycle_done.is_set, 60.0)
+            time.sleep(1.0)  # binder flush + retry cycles
+        finally:
+            cache.snapshot_versioned = orig
+            sched.schedule_batch = orig_sb
+        p = c.get_pod("p")
+        # Both live nodes are now zone A with A=1 pre-count: placing p
+        # anywhere is skew 2 > 1. The ONLY wrong outcome is a commit
+        # made under the stale zone-B belief.
+        assert p.spec.node_name == "", (
+            f"committed to {p.spec.node_name} under a stale zone view")
+        counts = {}
+        for q in c.list_pods():
+            if q.spec.node_name and q.metadata.labels.get("app") == "g":
+                nd = c.store.get("Node", q.spec.node_name)
+                z = nd.metadata.labels[ZONE]
+                counts[z] = counts.get(z, 0) + 1
+        assert counts == {"A": 1}, counts
+    finally:
+        c.shutdown()
