@@ -310,7 +310,8 @@ def _make_handler(store: ClusterStore, token: str | None = None,
             for provider in (metrics_providers or ()):
                 try:
                     for k, v in provider().items():
-                        if isinstance(v, (int, float)):
+                        if (isinstance(v, (int, float))
+                                and not isinstance(v, bool)):
                             emit(f"minisched_engine_{clean(k)}", v)
                 except Exception:  # a broken provider must not 500 scrapes
                     log.exception("metrics provider failed")

@@ -564,7 +564,7 @@ class NodeFeatureCache:
         holds device copies for. When it matches, the static leaves are
         returned as ``None`` instead of host copies — the caller replaces
         them anyway, and skipping them drops ~tens of MB of memcpy from
-        every steady-state batch. Returns (feats, names, static_version).
+        every steady-state batch.
 
         ``pad`` may be a CALLABLE ``hw -> int``: it is resolved from the
         row high-water mark UNDER the snapshot lock, so a concurrent

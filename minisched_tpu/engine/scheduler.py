@@ -1290,10 +1290,14 @@ class Scheduler:
         return step_bucket(max(hw, 1), self.config.node_bucket_min)
 
     def _af_pad(self, hw: int) -> int:
-        """Assigned-corpus pad, same eighth-step treatment (the corpus
-        appears in the (G,A) topology match and the preemption victim
-        search)."""
-        return step_bucket(max(hw, 1), 16)
+        """Assigned-corpus pad from ITS high-water mark — the big win is
+        not snapshotting/matching the cache's full pow2 capacity when the
+        corpus is small (an empty corpus used to memcpy a 65536-row
+        snapshot every batch at 50k nodes). Buckets stay pow2, not
+        eighth-step: the corpus only GROWS in steady state, every bucket
+        crossing recompiles the step, and the (G,A)/(Pf,A) terms are too
+        cheap for the tighter ladder to pay for 3× the compile points."""
+        return bucket_for(max(hw, 1), 16)
 
     def _sampled_step(self, n_pad: int, batch_len: int,
                       full_axis: bool):
