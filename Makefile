@@ -9,7 +9,8 @@ PY ?= python
 # a wedged tunnel can't hang backend init.
 CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: test start start-remote demo bench bench_sharded dryrun soak
+.PHONY: test start start-remote start-client-engine demo docs bench \
+        bench_sharded bench-cpu dryrun soak
 
 # Unit + integration suite on a virtual 8-device CPU mesh.
 test:
@@ -56,6 +57,13 @@ bench:
 # scan vs single device vs auction). MINISCHED_SHARDED_{NODES,PODS} override.
 bench_sharded:
 	$(PY) bench_sharded.py
+
+# Bench-harness smoke at reduced shapes on CPU: every phase must produce
+# a number (protects the driver's end-of-round TPU run from harness
+# regressions when no accelerator is reachable).
+bench-cpu:
+	MINISCHED_BENCH_NODES=2000 MINISCHED_BENCH_PODS=500 \
+	  MINISCHED_BENCH_TIMEOUT=1200 JAX_PLATFORMS=cpu $(PY) bench.py
 
 # Compile-check the flagship single-chip step and the multi-chip sharded
 # step on an 8-device virtual mesh.
