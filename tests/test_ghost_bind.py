@@ -543,7 +543,6 @@ def test_sync_permit_rejection_feeds_spread_arbitration():
                 return ("reject", 0.0, 0.0)
             return ("allow", 0.0, 0.0)
 
-    dc.register_plugin("RejectX", RejectX)
     ZONE = "topology.kubernetes.io/zone"
     sel = obj.LabelSelector(match_labels={"app": "g"})
 
@@ -557,6 +556,7 @@ def test_sync_permit_rejection_feeds_spread_arbitration():
 
     c = Cluster()
     try:
+        dc.register_plugin("RejectX", RejectX)
         c.start(profile=Profile(plugins=["NodeUnschedulable",
                                          "NodeResourcesFit",
                                          "PodTopologySpread", "RejectX"]),

@@ -1,0 +1,105 @@
+"""Step/readback breakdown profiler — where does an engine cycle's device
+window actually go?
+
+The engine's ``step_s`` metric spans dispatch → packed-decision fetch →
+(optional) spread fetch; on a remote-TPU tunnel each piece mixes compute,
+transfer, and round-trip latency. This tool times them separately at
+engine-realistic shapes so a regression (or a tunnel having a bad day)
+can be attributed instead of guessed at:
+
+    python tools/profile_step.py [--nodes 50000] [--pods 10000] [--c4]
+
+Phases reported per shape:
+  step_s        one warm jitted step, block on chosen (device compute)
+  pack_fetch_s  _pack_decision dispatch + (5+F, P) i32 host fetch
+  sp_fetch_s    _pack_spread dispatch + (2P+2, G) f32 host fetch
+  cdom_fetch_s  the (G,D) exact-table transfer (hard-spread batches that
+                the in-scan caps could not enforce pay this)
+
+Run it whenever the engine's measured step_s diverges from the raw-step
+bench phase — the delta must be explainable by the fetch lines. Uses
+engine pads (encode.cache.step_bucket) so numbers match the product
+path, not the bench's 256-multiple pads.
+
+WARNING: do not timeout-kill this mid-compile on the TPU tunnel; a
+killed remote compile can wedge the compile service for every later
+client (see bench.py's probe notes).
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=50_000)
+    ap.add_argument("--pods", type=int, default=10_000)
+    ap.add_argument("--c4", action="store_true",
+                    help="profile the config-4 topology profile instead "
+                         "of the resources-only headline profile")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from bench_workload import (BENCH_PLUGINS, C4_PLUGINS, make_c4_workload,
+                                make_workload)
+    from minisched_tpu.encode import NodeFeatureCache, encode_pods
+    from minisched_tpu.encode.cache import step_bucket
+    from minisched_tpu.engine.scheduler import _pack_decision, _pack_spread
+    from minisched_tpu.ops import build_step
+    from minisched_tpu.service.defaultconfig import Profile
+
+    print(f"platform: {jax.devices()[0]}", flush=True)
+    if args.c4:
+        make_nodes, make_pods = make_c4_workload(args.nodes, args.pods)
+        plugins = C4_PLUGINS
+    else:
+        make_nodes, make_pods = make_workload(args.nodes, args.pods)
+        plugins = BENCH_PLUGINS
+    pset = Profile(name="prof", plugins=plugins,
+                   plugin_args={"NodeResourcesFit":
+                                {"score_strategy": None}}).build()
+
+    cache = NodeFeatureCache(capacity=max(64, args.nodes))
+    for nd in make_nodes():
+        cache.upsert_node(nd)
+    pods = make_pods()
+    p_pad = step_bucket(len(pods))
+    n_pad = step_bucket(cache.rows_high_water())
+    eb = encode_pods(pods, p_pad, registry=cache.registry)
+    nf, names = cache.snapshot(pad=n_pad)
+    af = cache.snapshot_assigned(pad=16)
+    key = jax.random.PRNGKey(0)
+    step = build_step(pset, explain=False)
+    print(f"shapes: P={p_pad} N={n_pad} A={af.valid.shape[0]} "
+          f"G={eb.gf.valid.shape[0]}", flush=True)
+
+    def timed(label, fn):
+        out = fn()
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out)
+        print(f"{label} = {time.perf_counter() - t0:.4f} s", flush=True)
+        return out
+
+    d = timed("step_s", lambda: step(eb, nf, af, key))
+    timed("pack_fetch_s", lambda: np.array(_pack_decision(
+        d.chosen, d.assigned, d.gang_rejected, d.feasible_counts,
+        d.feasible_static, d.reject_counts)))
+    if d.spread_pre.shape[0]:
+        timed("sp_fetch_s", lambda: np.array(_pack_spread(
+            d.spread_pre, d.spread_dom, d.spread_min, d.scan_groups)))
+        timed("cdom_fetch_s", lambda: (np.asarray(d.spread_cdom),
+                                       np.asarray(d.spread_dexist)))
+    else:
+        print("sp_fetch_s / cdom_fetch_s skipped: no topology plugin in "
+              "this profile (rerun with --c4)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
