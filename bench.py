@@ -554,6 +554,13 @@ def run_child() -> None:
             if s0 and s1:
                 detail["explain_overhead_pct"] = round(
                     100.0 * (s1 - s0) / s0, 1)
+                # Absolute overhead too: at the 1k scale this phase runs
+                # at (full-fidelity explain cannot materialize (F,P,N)
+                # stacks at 50k x 10k — that regime uses the byte-
+                # budgeted filter-bitmask tier, measured below), a small
+                # base makes the percentage look dramatic while the
+                # absolute cost is tens of milliseconds.
+                detail["explain_overhead_abs_s"] = round(s1 - s0, 4)
     except Exception as e:
         detail["explain_error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(result))

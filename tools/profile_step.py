@@ -94,8 +94,12 @@ def main() -> None:
     if d.spread_pre.shape[0]:
         timed("sp_fetch_s", lambda: np.array(_pack_spread(
             d.spread_pre, d.spread_dom, d.spread_min, d.scan_groups)))
-        timed("cdom_fetch_s", lambda: (np.asarray(d.spread_cdom),
-                                       np.asarray(d.spread_dexist)))
+        # +0 forces a FRESH device array per call: np.asarray on the same
+        # jax.Array caches the host copy (_npy_value), so timing the raw
+        # conversion twice would report the cached no-op, not the (G,D)
+        # transfer this phase exists to attribute
+        timed("cdom_fetch_s", lambda: (np.asarray(d.spread_cdom + 0),
+                                       np.asarray(d.spread_dexist ^ False)))
     else:
         print("sp_fetch_s / cdom_fetch_s skipped: no topology plugin in "
               "this profile (rerun with --c4)", flush=True)
