@@ -270,3 +270,115 @@ def test_two_profiles_share_one_cache_and_informer_at_10k_nodes():
         assert pa.spec.node_name and pb.spec.node_name
     finally:
         svc.shutdown_scheduler()
+
+
+def test_kitchen_sink_mesh_multiprofile_integration():
+    """Cross-feature integration on the virtual 8-device mesh: TWO
+    profiles sharing one informer set and one mesh-sharded engine
+    config, scheduling hard topology spread, a gang, a PVC-backed pod
+    (PV controller running), and a priority preemption — in one cluster.
+    Every capability is tested alone elsewhere; this pins their
+    interactions (shared cache accounting across profiles, preemption
+    over mesh-sharded features, spread arbitration beside gang
+    admission, volume readiness gating beside both)."""
+    import jax
+
+    from minisched_tpu.parallel import make_mesh
+    from minisched_tpu.scenario import Cluster
+
+    devs = jax.devices("cpu")[:8]
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual CPU devices")
+    ZONE = "topology.kubernetes.io/zone"
+    sel = obj.LabelSelector(match_labels={"app": "web"})
+    c = Cluster()
+    try:
+        c.start(profile=[
+            Profile(name="default-scheduler",
+                    plugins=["NodeUnschedulable", "NodeResourcesFit",
+                             "PodTopologySpread", "InterPodAffinity",
+                             "VolumeBinding", "DefaultPreemption"],
+                    plugin_args={"NodeResourcesFit":
+                                 {"score_strategy": None}}),
+            Profile(name="batch-sched",
+                    plugins=["NodeUnschedulable", "NodeResourcesFit"]),
+        ], config=SchedulerConfig(mesh=make_mesh(devs),
+                                  backoff_initial_s=0.05,
+                                  backoff_max_s=0.2,
+                                  batch_window_s=0.1),
+            with_pv_controller=True)
+        for i in range(8):
+            # n0 is the ONLY node with an accelerator: the preemption
+            # below is deterministic on that scarce axis, independent of
+            # how the cpu packing falls out
+            c.create_node(f"n{i}", cpu=1000,
+                          labels={ZONE: f"z{i % 4}"},
+                          accelerator=1 if i == 0 else 0)
+        # 1) low-priority filler takes the single accelerator
+        c.create_pod("filler", spec=obj.PodSpec(
+            requests={"cpu": 100, "accelerator": 1}))
+        filler_node = c.wait_for_pod_bound(
+            "filler", timeout=30.0).spec.node_name
+        assert filler_node == "n0"
+
+        # 2) hard-spread burst through the default profile
+        for i in range(8):
+            c.create_pod(
+                f"web{i}", labels={"app": "web"},
+                spec=obj.PodSpec(
+                    requests={"cpu": 100},
+                    topology_spread_constraints=[
+                        obj.TopologySpreadConstraint(
+                            max_skew=1, topology_key=ZONE,
+                            when_unsatisfiable="DoNotSchedule",
+                            label_selector=sel)]))
+        # 3) gang of 4 (min 4) routed to the second profile
+        for i in range(4):
+            c.create_pod(f"gang{i}",
+                         spec=obj.PodSpec(requests={"cpu": 100},
+                                          scheduler_name="batch-sched",
+                                          pod_group="team",
+                                          pod_group_min=4))
+        # 4) PVC-backed pod: the PV controller must bind the claim, the
+        # VolumeBinding filter gates until it does
+        c.create_pv("pv1", storage=1 << 30)
+        c.create_pvc("claim1")
+        c.create_pod("db", spec=obj.PodSpec(
+            requests={"cpu": 100},
+            volumes=[obj.VolumeClaim(claim_name="claim1")]))
+
+        for name in ([f"web{i}" for i in range(8)]
+                     + [f"gang{i}" for i in range(4)] + ["db"]):
+            # per-pod wait: a stuck pod fails HERE with its name and the
+            # recorded unschedulable_plugins, not as a baffling
+            # missing-Node error downstream
+            c.wait_for_pod_bound(name, timeout=60.0)
+
+        # spread held: one web pod per zone pair (8 pods / 4 zones)
+        zcounts = {}
+        for i in range(8):
+            nd = c.store.get("Node", c.get_pod(f"web{i}").spec.node_name)
+            z = nd.metadata.labels[ZONE]
+            zcounts[z] = zcounts.get(z, 0) + 1
+        assert max(zcounts.values()) - min(zcounts.values()) <= 1, zcounts
+        # gang atomic
+        assert all(c.get_pod(f"gang{i}").spec.node_name for i in range(4))
+        # claim bound
+        assert c.store.get("PersistentVolumeClaim",
+                           "default/claim1").phase == "Bound"
+
+        # 5) preemption: the accelerator exists only on n0 and the
+        # low-priority filler holds it — eviction is the only cure
+        c.create_pod("critical",
+                     spec=obj.PodSpec(requests={"cpu": 100,
+                                                "accelerator": 1},
+                                      priority=100))
+        crit = c.wait_for_pod_bound("critical", timeout=60.0)
+        assert crit.spec.node_name == filler_node, (
+            crit.spec.node_name, filler_node)
+        # the filler was evicted (deleted by the preemption commit)
+        from minisched_tpu.errors import NotFoundError
+        with pytest.raises(NotFoundError):
+            c.store.get("Pod", "default/filler")
+    finally:
+        c.shutdown()
