@@ -50,9 +50,10 @@ def serve(store_only: bool = False) -> None:
                     max_inflight=int(os.environ.get(
                         "MINISCHED_API_MAX_INFLIGHT", "0"))
                     ).start()
-    if svc is not None and svc.scheduler is not None:
-        # one /metrics scrape covers the whole co-located simulator
-        api.metrics_providers.append(svc.scheduler.metrics)
+    if svc is not None:
+        # one /metrics scrape covers the whole co-located simulator,
+        # every profile included
+        api.metrics_providers.append(svc.metrics)
     print(f"LISTENING {api.address}", flush=True)
     try:
         sys.stdin.read()  # parent closes the pipe → exit

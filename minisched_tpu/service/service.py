@@ -49,6 +49,27 @@ class SchedulerService:
         """Profile name → engine."""
         return dict(self._scheds)
 
+    def metrics(self) -> Dict[str, float]:
+        """Engine cycle metrics across every profile, flattened for one
+        /metrics scrape (APIServer.metrics_providers): single-profile
+        services expose the engine's keys unprefixed (the common case,
+        stable dashboards); MULTI-PROFILE configurations prefix each key
+        with the profile name — keyed on the config style (``_multi``,
+        the same bit that decides pod routing), not the engine count, so
+        a one-profile multi-config keeps stable prefixed names when a
+        second profile is added later. Numeric-only consumers skip the
+        diagnostic list fields either way."""
+        scheds = self.schedulers
+        if not scheds:
+            return {}
+        if not self._multi:
+            return next(iter(scheds.values())).metrics()
+        out: Dict[str, float] = {}
+        for name, engine in scheds.items():
+            for k, v in engine.metrics().items():
+                out[f"{name}_{k}"] = v
+        return out
+
     def start_scheduler(self, profile: ProfileSpec = None,
                         config: Optional[SchedulerConfig] = None) -> Scheduler:
         if self._scheds:

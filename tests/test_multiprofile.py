@@ -382,3 +382,35 @@ def test_kitchen_sink_mesh_multiprofile_integration():
             c.store.get("Pod", "default/filler")
     finally:
         c.shutdown()
+
+
+def test_service_metrics_flatten_across_profiles():
+    """SchedulerService.metrics() feeds one /metrics scrape: engine keys
+    unprefixed for the single-profile common case, profile-prefixed when
+    several engines run."""
+    store = ClusterStore()
+    svc = SchedulerService(store)
+    assert svc.metrics() == {}  # nothing running yet
+    svc.start_scheduler([
+        Profile(name="default-scheduler",
+                plugins=["NodeUnschedulable", "NodeResourcesFit"]),
+        Profile(name="batch-sched",
+                plugins=["NodeUnschedulable", "NodeResourcesFit"]),
+    ], SchedulerConfig(batch_window_s=0.05))
+    try:
+        m = svc.metrics()
+        assert "default-scheduler_batches" in m
+        assert "batch-sched_batches" in m
+        assert "batches" not in m  # multi-profile keys are prefixed
+    finally:
+        svc.shutdown_scheduler()
+    store2 = ClusterStore()
+    svc2 = SchedulerService(store2)
+    svc2.start_scheduler(Profile(name="default-scheduler",
+                                 plugins=["NodeUnschedulable",
+                                          "NodeResourcesFit"]),
+                         SchedulerConfig(batch_window_s=0.05))
+    try:
+        assert "batches" in svc2.metrics()  # single profile: unprefixed
+    finally:
+        svc2.shutdown_scheduler()
