@@ -347,3 +347,50 @@ def test_metrics_requires_auth_when_enabled():
                 in text)
     finally:
         api.shutdown()
+
+
+def test_metrics_scrape_covers_live_engine():
+    """The co-located service's cycle metrics appear in the same scrape
+    as server/store gauges (the remote scenario's wiring), reflecting
+    real scheduling work."""
+    import urllib.request
+
+    from minisched_tpu.config import SchedulerConfig
+    from minisched_tpu.service.defaultconfig import Profile
+    from minisched_tpu.service.service import SchedulerService
+
+    import time as _t
+
+    store = ClusterStore()
+    api = APIServer(store).start()  # apiserver first: a bind failure
+    svc = SchedulerService(store)   # here must not leak engine threads
+    try:
+        svc.start_scheduler(
+            Profile(name="default-scheduler",
+                    plugins=["NodeUnschedulable", "NodeResourcesFit"]),
+            SchedulerConfig(batch_window_s=0.05, backoff_initial_s=0.05))
+        api.metrics_providers.append(svc.metrics)
+        store.create(obj.Node(
+            metadata=obj.ObjectMeta(name="mm-n0"),
+            status=obj.NodeStatus(allocatable={"cpu": 1000.0,
+                                               "pods": 110.0})))
+        store.create(_pod("mm-p0"))
+        # poll the METRIC, not spec.node_name: the binder sets node_name
+        # before the scheduling thread's metrics update, so a node_name
+        # wait could scrape ahead of pods_assigned (review-caught race)
+        end = _t.monotonic() + 30
+        while _t.monotonic() < end:
+            if svc.metrics().get("pods_assigned", 0) >= 1:
+                break
+            _t.sleep(0.1)
+        else:
+            raise AssertionError(
+                "pod never scheduled: " + repr(svc.metrics()))
+        text = urllib.request.urlopen(
+            f"{api.address}/metrics", timeout=5).read().decode()
+        assert "minisched_engine_batches" in text
+        assert "minisched_engine_pods_assigned 1" in text
+        assert 'minisched_store_objects{kind="Pod"} 1' in text
+    finally:
+        api.shutdown()
+        svc.shutdown_scheduler()
