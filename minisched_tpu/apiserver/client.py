@@ -234,6 +234,12 @@ class RemoteStore:
             events.append(e)
         return events, out["cursor"]
 
+    def checkpoint(self) -> dict:
+        """POST /checkpoint — force a durability point now (the etcdctl
+        snapshot analog). ConflictError when the server has no
+        persist_path."""
+        return self._call("POST", "/checkpoint")
+
     def healthz(self) -> bool:
         try:
             return bool(self._call("GET", "/healthz").get("ok"))

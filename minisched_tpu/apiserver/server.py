@@ -26,6 +26,9 @@ store's existing CRUD + versioned watch:
            the binding subresource: CAS, 409 if already bound)
     POST   /bind                         → bulk bind ([[key, node], ...]
            body → {"bound": [keys]}; already-bound/gone pods skipped)
+    POST   /checkpoint                   → force a durability point now
+           (requires persist_path; 409 otherwise) — the etcdctl-snapshot
+           analog; interval + shutdown checkpoints run automatically
     GET    /healthz
     GET    /metrics                      → Prometheus text exposition:
            server request/rejection counters, per-kind object counts,
@@ -78,9 +81,24 @@ class APIServer:
 
     def __init__(self, store: ClusterStore, host: str = "127.0.0.1",
                  port: int = 0, token: str | None = None,
-                 max_inflight: int = 0):
+                 max_inflight: int = 0, persist_path: str | None = None,
+                 persist_interval_s: float = 30.0):
+        """``persist_path`` enables the etcd-durability analog at the
+        apiserver tier, where the reference keeps it (state lives behind
+        the apiserver in etcd, k8sapiserver/k8sapiserver.go:93-105;
+        docker-compose.yml:20-21 mounts the data volume): interval
+        checkpoints while serving, a final one on shutdown(), and an
+        on-demand POST /checkpoint (the etcdctl-snapshot analog; makes
+        kill-tests deterministic). Boot the store with
+        state.persistence.open_or_restore(persist_path) to resume."""
         self.store = store
         self.token = token
+        self.checkpointer = None
+        if persist_path:
+            from ..state.persistence import Checkpointer
+
+            self.checkpointer = Checkpointer(store, persist_path,
+                                             interval_s=persist_interval_s)
         # exposed for tests: deterministic saturation without timing games
         self._inflight = (threading.BoundedSemaphore(max_inflight)
                           if max_inflight > 0 else None)
@@ -94,7 +112,7 @@ class APIServer:
         self._counters_lock = threading.Lock()
         handler = _make_handler(store, token, self._inflight,
                                 self.metrics_providers, self._counters,
-                                self._counters_lock)
+                                self._counters_lock, self.checkpointer)
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
@@ -116,13 +134,19 @@ class APIServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self.checkpointer is not None:
+            # after the listener stops: no request can mutate past the
+            # final snapshot
+            self.checkpointer.close()
+            self.checkpointer = None
 
 
 def _make_handler(store: ClusterStore, token: str | None = None,
                   inflight: threading.BoundedSemaphore | None = None,
                   metrics_providers: list | None = None,
                   counters: dict | None = None,
-                  counters_lock: threading.Lock | None = None):
+                  counters_lock: threading.Lock | None = None,
+                  checkpointer=None):
     if counters is None:
         counters = {}
     if counters_lock is None:
@@ -210,7 +234,13 @@ def _make_handler(store: ClusterStore, token: str | None = None,
             route = urlparse(self.path).path.strip("/")
             if route == "healthz":
                 return fn()
-            bump(f"requests_{self.command.lower()}")
+            if route == "metrics":
+                # A Prometheus scrape loop must not inflate the request
+                # counters it reports — scrapes get their own counter
+                # (still behind auth/flow control below).
+                bump("scrapes_metrics")
+            else:
+                bump(f"requests_{self.command.lower()}")
             if token is not None:
                 auth = self.headers.get("Authorization", "")
                 if auth != f"Bearer {token}":
@@ -370,6 +400,19 @@ def _make_handler(store: ClusterStore, token: str | None = None,
 
         def _post(self):
             kind, key, q = self._route()
+            if kind == "checkpoint":
+                # On-demand durability point (the etcdctl-snapshot
+                # analog); 409 when the server wasn't started with a
+                # persist path — there is nowhere to write.
+                def run():
+                    if checkpointer is None:
+                        return self._error(
+                            409, "server has no persist_path configured",
+                            reason="Conflict")
+                    wrote = checkpointer.checkpoint()
+                    self._send(200, {"checkpointed": True, "wrote": wrote,
+                                     "path": checkpointer.path})
+                return self._guard(run)
             if kind == "bind":
                 def run():
                     if key:  # single: the CAS contract, typed errors

@@ -82,6 +82,11 @@ def step_bucket(n: int, minimum: int = 16) -> int:
     or two: batch sizes are max_batch_size-capped and the node count is
     quasi-static, so compiles amortize exactly like the pow2 ladder's.
     """
+    # The guarantees above (256-multiples, lane alignment, pow2-mesh
+    # divisibility) derive from base/step being built over pow2 octaves —
+    # a non-pow2 minimum would silently yield unaligned pads, so round it
+    # up to the next power of two first.
+    minimum = bucket_for(max(minimum, 1), 1)
     b = bucket_for(n, minimum)
     if b <= 2048 or b <= minimum:
         # Below the ladder, or the caller's floor IS the bucket (a

@@ -1359,7 +1359,15 @@ class Scheduler:
         feasible[rows] = p2[3][:n_res]
         feasible_static[rows] = p2[4][:n_res]
         rejects[:, rows] = p2[5:][:, :n_res]
-        if sp is not None and sp.shape[0] > 1:
+        if sp is not None:
+            # Only the per-pod pre/dom rows merge; the batch's
+            # spread_min/scan_groups rows stay as the MAIN step computed
+            # them. That is sound only because hard-spread batches never
+            # sample (_sampled_step full_axis invariant) — a residual
+            # exists only for soft-spread batches, where min/scan rows
+            # are advisory.
+            assert not decision.scan_groups.any(), \
+                "residual merge on a hard-spread (scan-enforced) batch"
             sp2 = np.asarray(_pack_spread(
                 d2.spread_pre, d2.spread_dom, d2.spread_min,
                 d2.scan_groups))

@@ -37,7 +37,15 @@ def serve(store_only: bool = False) -> None:
 
     import os
 
-    store = ClusterStore()
+    # Durability (reference: etcd's data volume, docker-compose.yml:20-21):
+    # restore the store from the last snapshot and keep checkpointing.
+    persist_path = os.environ.get("MINISCHED_PERSIST_PATH") or None
+    if persist_path:
+        from ..state.persistence import open_or_restore
+
+        store = open_or_restore(persist_path)
+    else:
+        store = ClusterStore()
     svc = None
     if not store_only:
         svc = SchedulerService(store)
@@ -48,7 +56,10 @@ def serve(store_only: bool = False) -> None:
                     port=int(os.environ.get("MINISCHED_API_PORT", "0")),
                     token=os.environ.get("MINISCHED_API_TOKEN") or None,
                     max_inflight=int(os.environ.get(
-                        "MINISCHED_API_MAX_INFLIGHT", "0"))
+                        "MINISCHED_API_MAX_INFLIGHT", "0")),
+                    persist_path=persist_path,
+                    persist_interval_s=float(os.environ.get(
+                        "MINISCHED_PERSIST_INTERVAL", "30"))
                     ).start()
     if svc is not None:
         # one /metrics scrape covers the whole co-located simulator,
@@ -60,9 +71,13 @@ def serve(store_only: bool = False) -> None:
     except KeyboardInterrupt:
         pass
     finally:
-        api.shutdown()
+        # Scheduler FIRST: api.shutdown() writes the final checkpoint,
+        # and the co-located engine mutates the store in-process (not
+        # via HTTP) — stopping it after the snapshot would lose binds
+        # committed in the gap on a clean shutdown.
         if svc is not None:
             svc.shutdown_scheduler()
+        api.shutdown()
 
 
 def _wait(pred, timeout: float = 30.0, interval: float = 0.1):

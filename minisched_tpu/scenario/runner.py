@@ -35,9 +35,32 @@ class Cluster:
     """Scenario-facing cluster client (the reference passes a client-go
     clientset; the verbs the scenario needs are mirrored 1:1)."""
 
-    def __init__(self, store: Optional[ClusterStore] = None):
-        self.store = store or ClusterStore()
-        self.service = SchedulerService(self.store)
+    def __init__(self, store: Optional[ClusterStore] = None,
+                 persist_path: Optional[str] = None,
+                 persist_interval_s: float = 30.0):
+        """``persist_path``: boot from the last snapshot at that path (if
+        any) and checkpoint on an interval + at shutdown — the reference's
+        restart-against-the-same-etcd durability (docker-compose.yml:20-21)
+        for the in-process deployment."""
+        if store is not None and persist_path:
+            # A pre-built store + a persist path would SKIP the restore
+            # yet still checkpoint over whatever snapshot lives at that
+            # path — destroying pre-crash state silently. Misuse is loud.
+            raise ValueError(
+                "pass either store= or persist_path=, not both: a "
+                "pre-built store would clobber the snapshot it never "
+                "restored")
+        if store is None:
+            if persist_path:
+                from ..state.persistence import open_or_restore
+
+                store = open_or_restore(persist_path)
+            else:
+                store = ClusterStore()
+        self.store = store
+        self.service = SchedulerService(
+            self.store, checkpoint_path=persist_path,
+            checkpoint_interval_s=persist_interval_s)
         self.pv_controller: Optional[PVController] = None
 
     # ---- boot (reference sched.go:30-68) -------------------------------

@@ -411,14 +411,21 @@ class ClusterStore:
                 fn(o)
 
     def snapshot(self) -> Dict[str, Any]:
+        # Only the reference grab runs under the lock; the O(objects)
+        # to_dict conversion happens outside it (stored objects are
+        # replacement-only, so the references are immutable snapshots) —
+        # an interval checkpoint at 50k nodes must not stall every
+        # scheduling-cycle read for the whole serialization.
         with self._cond:
-            return {
-                "resource_version": self._rv,
-                "objects": {
-                    kind: {k: obj.to_dict(o) for k, o in col.items()}
-                    for kind, col in self._objects.items()
-                },
-            }
+            rv = self._rv
+            cols = {kind: dict(col) for kind, col in self._objects.items()}
+        return {
+            "resource_version": rv,
+            "objects": {
+                kind: {k: obj.to_dict(o) for k, o in col.items()}
+                for kind, col in cols.items()
+            },
+        }
 
     def save(self, path: str) -> None:
         with open(path, "w") as f:

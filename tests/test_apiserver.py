@@ -324,6 +324,11 @@ def test_metrics_endpoint_prometheus_exposition():
         assert "minisched_engine_batches 3" in text
         assert "minisched_engine_pods_assigned 7" in text
         assert "batch_sizes" not in text
+        # the scrape itself must not inflate the request counters it
+        # reports: exactly one GET counted (the /apis/Node hit), and
+        # scrapes land on their own counter
+        assert "minisched_apiserver_requests_get_total 1" in text
+        assert "minisched_apiserver_scrapes_metrics_total 1" in text
     finally:
         api.shutdown()
 

@@ -276,6 +276,13 @@ def test_step_bucket_geometry():
     assert step_bucket(1, 4096) == 4096
     assert step_bucket(3000, 4096) == 4096
     assert step_bucket(5000, 4096) == 5120
+    # a non-pow2 minimum is rounded UP to a power of two first — the
+    # alignment guarantees (256-multiples, pow2-mesh divisibility) derive
+    # from pow2 octaves and would silently break otherwise
+    assert step_bucket(1, 24) == 32
+    assert step_bucket(100, 24) == 128
+    for n in (3000, 10_000, 50_000):
+        assert step_bucket(n, 3000) % 256 == 0
 
 
 def test_rows_high_water_tracks_allocations():

@@ -1,0 +1,254 @@
+"""Process-restart durability wired into the lifecycle — the reference
+persists ALL cluster state ambiently in etcd (reference
+k8sapiserver/k8sapiserver.go:93-105; docker-compose.yml:20-21 mounts the
+etcd data volume): kill the process, restart it against the same etcd,
+and the workload survives. The rebuild's analog: Checkpointer interval/
+shutdown/on-demand snapshots + open_or_restore at boot, owned by the
+apiserver (wire deployments) or the scheduler service (in-process).
+
+The kill test is a REAL process kill: a server subprocess with
+persistence on, SIGKILLed mid-workload, restarted on the same path —
+bound pods stay bound, pending pods reschedule, the uid counter
+advances past every pre-crash uid.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from minisched_tpu.errors import ConflictError
+from minisched_tpu.scenario.runner import Cluster
+from minisched_tpu.state import objects as obj
+from minisched_tpu.state.persistence import Checkpointer, open_or_restore
+from minisched_tpu.state.store import ClusterStore
+
+
+def _node(name, unschedulable=False):
+    return obj.Node(metadata=obj.ObjectMeta(name=name),
+                    spec=obj.NodeSpec(unschedulable=unschedulable),
+                    status=obj.NodeStatus(allocatable={
+                        "cpu": 4000.0, "memory": 16 << 30, "pods": 110.0}))
+
+
+def _pod(name):
+    return obj.Pod(metadata=obj.ObjectMeta(name=name, namespace="default"),
+                   spec=obj.PodSpec(requests={"cpu": 100.0}))
+
+
+def _wait(pred, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+# ---- Checkpointer unit behavior -----------------------------------------
+
+
+def test_checkpoint_atomic_and_skip_unchanged(tmp_path):
+    path = str(tmp_path / "snap.json")
+    store = ClusterStore()
+    store.create(_node("n1"))
+    cp = Checkpointer(store, path)  # no interval thread
+    assert cp.checkpoint() is True
+    assert cp.checkpoint() is False  # rv unchanged → no write
+    mtime = os.path.getmtime(path)
+    store.create(_node("n2"))
+    assert cp.checkpoint() is True
+    restored = open_or_restore(path)
+    assert restored.count("Node") == 2
+    assert restored.resource_version() == store.resource_version()
+    # no temp litter (atomic rename)
+    assert [p for p in os.listdir(tmp_path) if ".tmp." in p] == []
+    assert os.path.getmtime(path) >= mtime
+    cp.close()
+
+
+def test_interval_checkpoint_runs(tmp_path):
+    path = str(tmp_path / "snap.json")
+    store = ClusterStore()
+    cp = Checkpointer(store, path, interval_s=0.05)
+    store.create(_node("n1"))
+    _wait(lambda: os.path.exists(path), timeout=5.0)
+    _wait(lambda: json.load(open(path))["resource_version"] >= 1,
+          timeout=5.0)
+    cp.close()
+    assert open_or_restore(path).count("Node") == 1
+
+
+def test_open_or_restore_fresh_when_missing(tmp_path):
+    store = open_or_restore(str(tmp_path / "nope.json"))
+    assert store.resource_version() == 0
+    assert sum(store.stats()["objects"].values()) == 0
+
+
+def test_torn_write_never_observed(tmp_path):
+    """A checkpoint racing a crash leaves the PREVIOUS complete snapshot:
+    the temp file is private until os.replace. Simulated by asserting the
+    target is always loadable between rapid checkpoints."""
+    path = str(tmp_path / "snap.json")
+    store = ClusterStore()
+    cp = Checkpointer(store, path)
+    for i in range(20):
+        store.create(_node(f"n{i}"))
+        cp.checkpoint()
+        # every observation of the file parses and is internally
+        # consistent (rv matches the retained objects' max rv)
+        snap = json.load(open(path))
+        assert len(snap["objects"]["Node"]) == i + 1
+    cp.close()
+
+
+# ---- service-lifecycle wiring (in-process deployment) -------------------
+
+
+def test_cluster_restart_resumes_from_checkpoint(tmp_path):
+    """Workload → shutdown (final checkpoint) → fresh Cluster on the same
+    path: bound pods stay bound, the pending pod reschedules once its
+    node arrives, and new uids advance past every pre-crash uid
+    (store.restore bumps the counter, state/objects.py:70)."""
+    path = str(tmp_path / "cluster.json")
+    c1 = Cluster(persist_path=path)
+    c1.start()
+    c1.create_node("node-a")
+    c1.create_pod("bound-pod")
+    c1.wait_for_pod_bound("bound-pod", timeout=60.0)
+    # a pod nothing can host (every node full/unschedulable for it)
+    c1.create_node("node-b", unschedulable=True)
+    c1.create_pod("pending-pod", cpu=999999)
+    c1.wait_for_pod_pending("pending-pod", timeout=30.0)
+    pre_uids = {p.metadata.uid for p in c1.list_pods()}
+    c1.shutdown()  # final checkpoint fires here
+
+    c2 = Cluster(persist_path=path)
+    c2.start()
+    try:
+        bound = c2.get_pod("bound-pod")
+        assert bound.spec.node_name == "node-a"  # stayed bound
+        # the pending pod is rediscovered by the informers and
+        # reschedules when capacity appears
+        c2.create_node("node-big", cpu=2_000_000)
+        p = c2.wait_for_pod_bound("pending-pod", timeout=60.0)
+        assert p.spec.node_name == "node-big"
+        fresh = c2.create_pod("post-restart-pod")
+        assert fresh.metadata.uid not in pre_uids  # uid counter advanced
+        c2.wait_for_pod_bound("post-restart-pod", timeout=30.0)
+    finally:
+        c2.shutdown()
+
+
+def test_service_rejects_checkpoint_path_on_remote_store(tmp_path):
+    """The REAL RemoteStore (which does have a snapshot() method — the
+    /snapshot verb) must be rejected too: its durability belongs to the
+    serving side."""
+    from minisched_tpu.apiserver import RemoteStore
+    from minisched_tpu.service.service import SchedulerService
+
+    with pytest.raises(ValueError):
+        SchedulerService(RemoteStore("http://127.0.0.1:1"),
+                         checkpoint_path=str(tmp_path / "x.json"))
+
+
+def test_cluster_rejects_store_plus_persist_path(tmp_path):
+    """A pre-built store + persist path would skip the restore yet still
+    checkpoint over the existing snapshot — rejected loudly."""
+    with pytest.raises(ValueError):
+        Cluster(store=ClusterStore(),
+                persist_path=str(tmp_path / "x.json"))
+
+
+# ---- the kill -9 e2e over the wire --------------------------------------
+
+
+SERVER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from minisched_tpu.scenario import remote
+remote.serve()
+"""
+
+
+def _spawn_server(tmp_path, persist_path):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               MINISCHED_PERSIST_PATH=persist_path,
+               MINISCHED_PERSIST_INTERVAL="0.2")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", SERVER.format(repo=repo)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, env=env,
+        cwd=str(tmp_path))
+    line = proc.stdout.readline().strip()
+    assert line.startswith("LISTENING "), line
+    return proc, line.split(" ", 1)[1]
+
+
+def test_kill_dash_nine_resume(tmp_path):
+    """The VERDICT scenario verbatim: create workload → SIGKILL the
+    simulator process → restart on the same snapshot path → bound pods
+    stayed bound, pending pods reschedule, uids advance."""
+    from minisched_tpu.apiserver import RemoteStore
+
+    persist = str(tmp_path / "wire.json")
+    proc, addr = _spawn_server(tmp_path, persist)
+    try:
+        rs = RemoteStore(addr)
+        _wait(rs.healthz, timeout=30)
+        rs.create(_node("node-a"))
+        rs.create(_pod("bound-pod"))
+        _wait(lambda: rs.get("Pod", "default/bound-pod").spec.node_name,
+              timeout=90.0)
+        # pending: nothing can host it yet
+        big = _pod("pending-pod")
+        big.spec.requests["cpu"] = 999999.0
+        rs.create(big)
+        _wait(lambda: rs.get(
+            "Pod", "default/pending-pod").status.unschedulable_plugins,
+            timeout=60.0)
+        pre_uids = {p.metadata.uid for p in rs.list("Pod")}
+        out = rs.checkpoint()  # deterministic durability point
+        assert out["checkpointed"] is True
+    finally:
+        proc.send_signal(signal.SIGKILL)  # no shutdown checkpoint
+        proc.wait(timeout=10)
+
+    # restart against the same snapshot (same "etcd volume")
+    proc, addr = _spawn_server(tmp_path, persist)
+    try:
+        rs = RemoteStore(addr)
+        _wait(rs.healthz, timeout=30)
+        assert rs.get("Pod", "default/bound-pod").spec.node_name == "node-a"
+        pend = rs.get("Pod", "default/pending-pod")
+        assert pend.spec.node_name == ""
+        node_big = _node("node-big")
+        node_big.status.allocatable["cpu"] = 2_000_000.0
+        rs.create(node_big)
+        _wait(lambda: rs.get(
+            "Pod", "default/pending-pod").spec.node_name, timeout=90.0)
+        fresh = rs.create(_pod("post-restart-pod"))
+        assert fresh.metadata.uid not in pre_uids
+    finally:
+        try:
+            proc.stdin.close()
+            proc.wait(timeout=10)
+        except Exception:
+            proc.kill()
+
+
+def test_checkpoint_route_409_without_persistence():
+    from minisched_tpu.apiserver import APIServer, RemoteStore
+
+    api = APIServer(ClusterStore()).start()
+    try:
+        with pytest.raises(ConflictError):
+            RemoteStore(api.address).checkpoint()
+    finally:
+        api.shutdown()
