@@ -10,7 +10,7 @@ PY ?= python
 CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: test start start-remote start-client-engine demo docs bench \
-        bench_sharded bench-cpu dryrun soak
+        bench_sharded bench-cpu dryrun dryrun-dcn soak
 
 # Unit + integration suite on a virtual 8-device CPU mesh.
 test:
@@ -69,6 +69,13 @@ bench-cpu:
 # step on an 8-device virtual mesh.
 dryrun:
 	$(CPU_MESH) $(PY) __graft_entry__.py
+
+# Multi-PROCESS (DCN) dryrun: two OS processes federate their CPU devices
+# via jax.distributed; the product sharded step runs over the hybrid
+# (pod=DCN, node=ICI) mesh with cross-process collectives and must match
+# single-device bit-for-bit (minisched_tpu/parallel/dcn_dryrun.py).
+dryrun-dcn:
+	JAX_PLATFORMS=cpu $(PY) -m minisched_tpu.parallel.dcn_dryrun
 
 # Concurrency soak: repeat the chaos suite (threaded churn + invariants).
 # SOAK_N overrides the repeat count.

@@ -20,12 +20,13 @@ client-go parity knobs:
 """
 from __future__ import annotations
 
+import http.client
 import json
 import logging
+import socket
 import threading
 import time
-import urllib.error
-import urllib.request
+import urllib.parse
 from typing import Any, List, Optional, Tuple
 
 from ..errors import (AlreadyExistsError, ConflictError, NotFoundError,
@@ -70,28 +71,96 @@ class RemoteStore:
                  token: Optional[str] = None,
                  qps: float = 5000.0, burst: int = 5000):
         self.address = address.rstrip("/")
+        u = urllib.parse.urlparse(self.address)
+        if u.scheme not in ("http", "https"):
+            raise ValueError(f"unsupported scheme in {address!r}; "
+                             "expected http:// or https://")
+        self._https = u.scheme == "https"
+        self._host = u.hostname
+        self._port = u.port or (443 if self._https else 80)
         self.timeout = timeout
         self.token = token
         self._limiter = _TokenBucket(qps, burst) if qps > 0 else None
+        # One persistent keep-alive connection PER THREAD (informer pump,
+        # binder workers, scenario thread each get their own — http.client
+        # connections are not thread-safe). Reuse kills the
+        # per-request TCP setup urllib paid; TCP_NODELAY on both ends
+        # kills the Nagle/delayed-ACK stall (see server.py).
+        self._local = threading.local()
 
     # ---- wire plumbing --------------------------------------------------
+
+    def _conn(self) -> http.client.HTTPConnection:
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            cls = (http.client.HTTPSConnection if self._https
+                   else http.client.HTTPConnection)
+            c = cls(self._host, self._port, timeout=self.timeout)
+            self._local.conn = c
+        return c
+
+    def _drop_conn(self) -> None:
+        c = getattr(self._local, "conn", None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:
+                pass
+            self._local.conn = None
+
+    def _request(self, method: str, path: str, data, headers,
+                 timeout: float):
+        """One HTTP exchange over the thread's persistent connection →
+        (status, headers, body). ONLY an IDEMPOTENT request (GET) that
+        hits a stale keep-alive failure on a REUSED connection retries
+        once on a fresh one — for a mutating verb even a
+        RemoteDisconnected does not prove the request never reached the
+        server (it may have applied the mutation and died before writing
+        a response byte — the kill -9 durability scenario), so resending
+        could double-apply; the error propagates and the CALLER owns the
+        ambiguity, exactly as with the old one-connection-per-request
+        transport. Timeouts and mid-exchange failures always propagate;
+        every failure path drops the connection."""
+        stale = (http.client.RemoteDisconnected,
+                 http.client.CannotSendRequest, BrokenPipeError,
+                 ConnectionResetError)
+        for attempt in (0, 1):
+            conn = self._conn()
+            fresh = conn.sock is None
+            try:
+                if fresh:
+                    conn.connect()
+                    conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                         socket.TCP_NODELAY, 1)
+                conn.sock.settimeout(timeout)
+                conn.request(method, path, body=data, headers=headers)
+                resp = conn.getresponse()
+                body = resp.read()  # drain fully so the conn is reusable
+                return resp.status, resp.headers, body
+            except stale:
+                self._drop_conn()
+                if fresh or attempt or method != "GET":
+                    raise
+            except (http.client.HTTPException, OSError):
+                self._drop_conn()  # timeout/mid-exchange: never resend
+                raise
+        raise AssertionError("unreachable")
 
     def _call(self, method: str, path: str, body=None,
               timeout: Optional[float] = None, _retries: int = 2):
         if self._limiter is not None:
             self._limiter.take()
-        data = None if body is None else json.dumps(body).encode()
-        headers = {"Content-Type": "application/json"}
+        data = (None if body is None
+                else json.dumps(body, separators=(",", ":")).encode())
+        headers = {"Content-Type": "application/json",
+                   "Content-Length": str(len(data) if data else 0)}
         if self.token is not None:
             headers["Authorization"] = f"Bearer {self.token}"
-        req = urllib.request.Request(
-            self.address + path, data=data, method=method, headers=headers)
-        try:
-            with urllib.request.urlopen(
-                    req, timeout=timeout or self.timeout) as resp:
-                body = resp.read()
+        status, rheaders, raw = self._request(
+            method, path, data, headers, timeout or self.timeout)
+        if status < 400:
             try:
-                return json.loads(body)
+                return json.loads(raw)
             except ValueError:  # JSONDecodeError AND UnicodeDecodeError
                 # A truncated/mangled 200 body is a TRANSPORT failure —
                 # it must surface as the retryable RuntimeError class,
@@ -99,43 +168,41 @@ class RemoteStore:
                 # the 410 fell-behind signal.
                 raise RuntimeError(
                     f"apiserver returned malformed JSON "
-                    f"({len(body)} bytes)") from None
-        except urllib.error.HTTPError as e:
-            reason = None
-            retry_after = e.headers.get("Retry-After") if e.headers else None
+                    f"({len(raw)} bytes)") from None
+        reason = None
+        retry_after = rheaders.get("Retry-After")
+        try:
+            payload = json.loads(raw)
+            msg = payload.get("error", f"HTTP {status}")
+            reason = payload.get("reason")
+        except Exception:
+            msg = f"HTTP {status}"
+        if status == 404:
+            raise NotFoundError(msg) from None
+        if status == 401:
+            raise UnauthorizedError(msg) from None
+        if status == 429 and _retries > 0:
+            # server flow control: honor Retry-After and retry
+            # (client-go's default 429 handling)
             try:
-                payload = json.loads(e.read())
-                msg = payload.get("error", str(e))
-                reason = payload.get("reason")
-            except Exception:
-                msg = str(e)
-            if e.code == 404:
-                raise NotFoundError(msg) from None
-            if e.code == 401:
-                raise UnauthorizedError(msg) from None
-            if e.code == 429 and _retries > 0:
-                # server flow control: honor Retry-After and retry
-                # (client-go's default 429 handling)
-                try:
-                    delay = min(max(0.0, float(retry_after or 1.0)), 5.0)
-                except ValueError:
-                    delay = 1.0
-                time.sleep(delay)
-                return self._call(method, path, body=None if data is None
-                                  else json.loads(data), timeout=timeout,
-                                  _retries=_retries - 1)
-            if e.code == 409:
-                # the server folds AlreadyExists and Conflict into 409
-                # and disambiguates with a structured ``reason`` field
-                # (the client-go status-reason analog); the message
-                # sniff is only a fallback for pre-reason servers.
-                if reason == "AlreadyExists" or (
-                        reason is None and "already exists" in msg):
-                    raise AlreadyExistsError(msg) from None
-                raise ConflictError(msg) from None
-            if e.code == 410:
-                raise WatchFellBehindError(msg) from None
-            raise RuntimeError(f"apiserver {e.code}: {msg}") from None
+                delay = min(max(0.0, float(retry_after or 1.0)), 5.0)
+            except ValueError:
+                delay = 1.0
+            time.sleep(delay)
+            return self._call(method, path, body=body, timeout=timeout,
+                              _retries=_retries - 1)
+        if status == 409:
+            # the server folds AlreadyExists and Conflict into 409
+            # and disambiguates with a structured ``reason`` field
+            # (the client-go status-reason analog); the message
+            # sniff is only a fallback for pre-reason servers.
+            if reason == "AlreadyExists" or (
+                    reason is None and "already exists" in msg):
+                raise AlreadyExistsError(msg) from None
+            raise ConflictError(msg) from None
+        if status == 410:
+            raise WatchFellBehindError(msg) from None
+        raise RuntimeError(f"apiserver {status}: {msg}")
 
     # ---- store verbs ----------------------------------------------------
 
@@ -145,12 +212,19 @@ class RemoteStore:
             "POST", f"/apis/{kind}", obj.to_dict(o)))
 
     def create_many(self, objs: List[Any]) -> List[Any]:
+        """Bulk create with the slim response: the server stamps
+        rv/creation_timestamp and returns ONLY those (we already hold the
+        full objects) — matching the in-process create_many contract,
+        which stamps the caller's own objects and returns them."""
         if not objs:
             return []
         kind = obj.kind_of(objs[0])
-        out = self._call("POST", f"/apis/{kind}?bulk=1",
+        out = self._call("POST", f"/apis/{kind}?bulk=1&slim=1",
                          [obj.to_dict(o) for o in objs])
-        return [obj.from_dict(kind, d) for d in out["items"]]
+        for o, (rv, ts) in zip(objs, out["stamps"]):
+            o.metadata.resource_version = rv
+            o.metadata.creation_timestamp = ts
+        return objs
 
     def get(self, kind: str, key: str) -> Any:
         return obj.from_dict(kind, self._call("GET", f"/apis/{kind}/{key}"))

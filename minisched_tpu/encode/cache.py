@@ -217,6 +217,131 @@ class NodeFeatureCache:
             self.version += 1
             self.static_version += 1
 
+    def upsert_nodes_bulk(self, nodes) -> None:
+        """Bulk node insert for the informer's initial sync / re-list: one
+        lock hold and per-signature MEMOIZED encoding instead of one
+        upsert_node per node. A 50k-node cluster carries a handful of
+        distinct allocatable/label/taint signatures, so the per-node work
+        collapses to dict hits + row assignments — this is the
+        restart-to-first-batch cost (VERDICT r4 #7). Nodes already
+        present re-route through upsert_node (the re-encode path with its
+        incarnation/topology bookkeeping); fresh rows never have bound
+        pods or claims, so free = allocatable by construction."""
+        existing = []
+        with self._lock:
+            fresh = []
+            batch_names: set = set()
+            for node in nodes:
+                # A duplicated name WITHIN the batch must take the
+                # update path too: two "fresh" rows for one name would
+                # leave a ghost valid row (double capacity) only the
+                # second of which is indexed/removable.
+                if (node.metadata.name in self._index
+                        or node.metadata.name in batch_names):
+                    existing.append(node)
+                else:
+                    batch_names.add(node.metadata.name)
+                    fresh.append(node)
+            self._ensure_node_capacity(len(fresh))
+            feats = self._feats
+            keys_snapshot = self.registry.keys()
+            alloc_memo: Dict[tuple, np.ndarray] = {}
+            label_memo: Dict[tuple, tuple] = {}
+            taint_memo: Dict[tuple, tuple] = {}
+            topo_memo: Dict[tuple, np.ndarray] = {}
+            L = feats.label_pairs.shape[1]
+            T = feats.taint_pairs.shape[1]
+            vol_idx = RESOURCE_INDEX["attachable-volumes"]
+            for node in fresh:
+                name = node.metadata.name
+                i = self._alloc_row()
+                self._index[name] = i
+                self._names[i] = name
+                self._inc_counter += 1
+                self._row_inc[i] = self._inc_counter
+
+                feats.valid[i] = True
+                feats.unschedulable[i] = node.spec.unschedulable
+                alloc = node.status.allocatable
+                asig = tuple(sorted(alloc.items()))
+                v = alloc_memo.get(asig)
+                if v is None:
+                    v = F.resources_vector(alloc)
+                    if "attachable-volumes" not in alloc:
+                        v[vol_idx] = obj_mod.DEFAULT_ATTACHABLE_VOLUMES
+                    for axis, limit in (
+                            obj_mod.DEFAULT_CLOUD_VOLUME_LIMITS.items()):
+                        if axis not in alloc:
+                            v[RESOURCE_INDEX[axis]] = limit
+                    alloc_memo[asig] = v
+                feats.allocatable[i] = v
+                feats.free[i] = v  # fresh row: nothing bound, no claims
+                feats.name_suffix[i] = F.name_suffix_digit(name)
+                feats.name_hash[i] = F._h(name)
+                feats.avoid_pods[i] = (F.PREFER_AVOID_PODS_ANNOTATION
+                                       in node.metadata.annotations)
+
+                lsig = tuple(node.metadata.labels.items())
+                rows = label_memo.get(lsig)
+                if rows is None:
+                    pairs = np.zeros(L, dtype=np.int32)
+                    lkeys = np.zeros(L, dtype=np.int32)
+                    for j, (k, val) in enumerate(lsig[:L]):
+                        pairs[j] = F.pair_hash(k, val)
+                        lkeys[j] = F.key_hash(k)
+                    rows = label_memo[lsig] = (pairs, lkeys)
+                if len(lsig) > L:
+                    self.overflow.append(
+                        f"node {node.key} labels: {len(lsig)} > {L} slots")
+                feats.label_pairs[i] = rows[0]
+                feats.label_keys[i] = rows[1]
+
+                tsig = tuple((t.key, t.value, t.effect)
+                             for t in node.spec.taints)
+                trows = taint_memo.get(tsig)
+                if trows is None:
+                    tp = np.zeros(T, dtype=np.int32)
+                    tk = np.zeros(T, dtype=np.int32)
+                    te = np.full(T, F.EFFECT_NONE, dtype=np.int32)
+                    for j, (k, val, eff) in enumerate(tsig[:T]):
+                        tp[j] = F.pair_hash(k, val)
+                        tk[j] = F.key_hash(k)
+                        te[j] = F._EFFECT_CODE.get(eff, F.EFFECT_NO_SCHEDULE)
+                    trows = taint_memo[tsig] = (tp, tk, te)
+                if len(tsig) > T:
+                    self.overflow.append(f"node {node.key} taints overflow")
+                feats.taint_pairs[i] = trows[0]
+                feats.taint_keys[i] = trows[1]
+                feats.taint_effects[i] = trows[2]
+
+                feats.images[i] = 0
+                if node.status.images:
+                    F._fill_slots(feats.images[i],
+                                  [F._h(im) for im in node.status.images],
+                                  f"node {node.key} images", self.overflow)
+
+                tcol = topo_memo.get(lsig)
+                if tcol is None:
+                    # ONE implementation of the domain derivation: run
+                    # the real per-row function on this (first) row, then
+                    # memoize its label-dependent output. Slot 0
+                    # (hostname — every node its own domain) is
+                    # row-dependent: reset in the memo, patched per node.
+                    F.compute_topo_domains_row(feats, i, self.registry,
+                                               self.cfg,
+                                               keys=keys_snapshot)
+                    tcol = feats.topo_domains[:, i].copy()
+                    tcol[0] = -1
+                    topo_memo[lsig] = tcol
+                else:
+                    feats.topo_domains[:, i] = tcol
+                feats.topo_domains[0, i] = i
+            if fresh:
+                self.version += 1
+                self.static_version += 1
+        for node in existing:
+            self.upsert_node(node)
+
     def remove_node(self, name: str) -> List[str]:
         """Drop a node row. Returns the keys of bound pods whose accounting
         was dropped with it — the caller decides their fate (the engine
@@ -317,13 +442,14 @@ class NodeFeatureCache:
             # the fast path defers its _bound inserts, so the membership
             # check alone cannot see an earlier in-batch occurrence.
             for k, (pod, node_name) in enumerate(items):
-                if pod.key in batch_seen:
+                key = pod.key  # f-string property: build it ONCE per pod
+                if key in batch_seen:
                     continue
-                batch_seen.add(pod.key)
+                batch_seen.add(key)
                 exp = None if expected_inc is None else expected_inc[k]
                 if (reqs is None or pod.spec.volumes or pod.spec.ports
                         or self._pod_has_anti(pod)
-                        or pod.key in self._bound):
+                        or key in self._bound):
                     if not self._account_bind_locked(
                             pod, node_name,
                             None if reqs is None else reqs[k].copy(),
@@ -335,12 +461,12 @@ class NodeFeatureCache:
                                  and self._row_inc[i] != exp):
                     missed.append(k)
                     continue
-                fast.append((k, i, pod))
+                fast.append((k, i, pod, key))
             if fast:
                 self._ensure_assigned_capacity(len(fast))
-                kk = np.fromiter((k for k, _, _ in fast), dtype=np.int64,
+                kk = np.fromiter((k for k, _, _, _ in fast), dtype=np.int64,
                                  count=len(fast))
-                ii = np.fromiter((i for _, i, _ in fast), dtype=np.int64,
+                ii = np.fromiter((i for _, i, _, _ in fast), dtype=np.int64,
                                  count=len(fast))
                 # Several pods may land on one node row — unbuffered
                 # subtract so duplicates accumulate.
@@ -355,18 +481,18 @@ class NodeFeatureCache:
                 self._assigned.node_row[aa] = ii
                 self._assigned.requests[aa] = reqs[kk]
                 self._assigned.priority[aa] = np.fromiter(
-                    (pod.spec.priority for _, _, pod in fast),
+                    (pod.spec.priority for _, _, pod, _ in fast),
                     dtype=np.int32, count=len(fast))
                 ns_memo: Dict[str, int] = {}
                 row_memo: Dict[tuple, np.ndarray] = {}
                 max_labels = self.cfg.max_labels
-                for (k, i, pod), a in zip(fast, a_rows):
-                    self._bound[pod.key] = (i, reqs[k], (), [])
-                    self._a_row[pod.key] = a
-                    self._a_key[a] = pod.key
+                for (k, i, pod, key), a in zip(fast, a_rows):
+                    self._bound[key] = (i, reqs[k], (), [])
+                    self._a_row[key] = a
+                    self._a_key[a] = key
                     group = gang_key(pod)
                     if group:
-                        self._key_gang[pod.key] = group
+                        self._key_gang[key] = group
                         self._gang_bound[group] = \
                             self._gang_bound.get(group, 0) + 1
                     ns = pod.metadata.namespace
@@ -883,8 +1009,8 @@ class NodeFeatureCache:
 
     # ---- internals ------------------------------------------------------
 
-    def _alloc_row(self) -> int:
-        if not self._free_rows:
+    def _ensure_node_capacity(self, need: int) -> None:
+        while len(self._free_rows) < need:
             new_cap = self._capacity * 2
             grown = F.empty_node_features(new_cap, self.cfg)
             for name, a, g in zip(self._feats._fields, self._feats, grown):
@@ -894,11 +1020,15 @@ class NodeFeatureCache:
                     g[: self._capacity] = a
             self._feats = grown
             self._names += [None] * (new_cap - self._capacity)
-            self._free_rows = list(range(new_cap - 1, self._capacity - 1, -1))
+            self._free_rows = list(range(new_cap - 1, self._capacity - 1,
+                                         -1)) + self._free_rows
             inc = np.zeros(new_cap, dtype=np.int64)
             inc[: self._capacity] = self._row_inc
             self._row_inc = inc
             self._capacity = new_cap
+
+    def _alloc_row(self) -> int:
+        self._ensure_node_capacity(1)
         row = self._free_rows.pop()
         if row >= self._rows_hw:
             self._rows_hw = row + 1

@@ -26,10 +26,12 @@ Full-N filter coverage: the JSON annotations are top-k bounded, but the
 question a scheduler simulator most often answers — "why did node X
 specifically reject this pod", for ARBITRARY X (reference
 resultstore/store.go:137-168 records every node) — is served by
-``filter_verdict``: per pod, a compact (N,) uint32 bitmask of failing
-filter plugins (bit f = plugin f rejected) retained for the most recent
-``full_n_retain`` pods. One uint32 per (pod, node) instead of the
-annotation's per-plugin JSON strings — no O(P×N) JSON blowup.
+``filter_verdict``: per pod, bit-plane-packed failing-filter masks
+((F, ⌈N/8⌉) uint8 — plane f bit j set ⇔ plugin f rejected node j)
+retained for the most recent ``full_n_retain`` pods. One BIT per
+(pod, node, filter) instead of the annotation's per-plugin JSON
+strings — dense enough that the default 128 MB budget retains every
+row of a full 10k×50k headline batch.
 """
 from __future__ import annotations
 
@@ -79,13 +81,13 @@ class ResultStore:
         self._lock = threading.Lock()
         # pod key → (batch record, pod row)
         self._results: Dict[str, tuple] = {}
-        # pod key → (name→col, (N,) uint32 failing-plugin bits, fnames);
-        # FIFO-bounded by ``full_n_retain`` rows when given, else by a
-        # BYTE budget (a fixed row count would silently cost ~0.8 GB at
-        # 50k nodes; the budget scales the row cap with N). Rows are
-        # COPIES out of the per-batch (P,N) array (views would pin the
-        # whole batch array while the budget counts only the row), so
-        # real residency tracks the budgeted bytes.
+        # pod key → (name→col, (F, ceil(N/8)) uint8 fail bit-planes,
+        # fnames); FIFO-bounded by ``full_n_retain`` rows when given,
+        # else by a BYTE budget (a fixed row count would silently blow up
+        # with N; the budget scales the row cap). Rows are COPIES out of
+        # the per-batch packed array (views would pin the whole batch
+        # array while the budget counts only the row), so real residency
+        # tracks the budgeted bytes.
         self._filter_bits: Dict[str, tuple] = {}
         self._full_n_retain = full_n_retain
         self._full_n_budget = full_n_budget_bytes
@@ -188,12 +190,17 @@ class ResultStore:
             fnames=fnames, snames=snames, weights=weights,
             filter_masks=filter_masks, raw=raw, norm=norm)
 
-        # Full-N failing-plugin bitmask: one uint32 per (pod, node) —
-        # loop over F keeps the working set at (P,N), never (F,P,N)x4.
-        # Only the first 32 filters fit the mask; the fnames stored with
+        # Full-N failing-plugin record, BIT-PLANE PACKED: per retained pod
+        # a (F, ceil(N/8)) uint8 array — plane f bit j set ⇔ filter f
+        # rejected node j (np.packbits big-endian bit order). 32/F× denser
+        # than the previous one-uint32-per-(pod,node) layout, which is
+        # what lets the budget hold EVERY row of a headline batch
+        # (10k pods × 50k nodes × 1 filter = 6.25 KB/row → the default
+        # 128 MB budget retains >20k rows; the uint32 layout held 668).
+        # Only the first 32 filters are recorded; the fnames stored with
         # each row are truncated to the RECORDED plugins so filter_verdict
         # never fabricates PASSED for an unrecorded overflow plugin.
-        fail_bits = col_of = None
+        packed = col_of = None
         bit_fnames = fnames[:32]
         if len(fnames) > 32 and not self._warned_overflow:
             self._warned_overflow = True  # once — fires per batch otherwise
@@ -205,20 +212,20 @@ class ResultStore:
         first_kept = 0
         if filter_masks.shape[0]:
             if retain is None:
-                row_bytes = max(1, filter_masks.shape[2] * 4)
+                row_bytes = max(
+                    1, len(bit_fnames) * ((filter_masks.shape[2] + 7) // 8))
                 retain = max(64, self._full_n_budget // row_bytes)
             # Rows below ``first_kept`` would be FIFO-evicted before this
             # batch finishes inserting — don't even compute their
             # bitmasks (at 10k pods x 50k nodes with the default budget
-            # ~93% of the OR-loop's work would be discarded otherwise).
+            # ~93% of the packing work would be discarded otherwise).
             # Slice by len(pods), NOT filter_masks.shape[1]: the mask's P
             # axis is the padded bucket, and the pad rows beyond the live
             # pods need no bits either.
             first_kept = max(0, len(pods) - retain)
-            kept = filter_masks[:, first_kept:len(pods), :]
-            fail_bits = np.zeros(kept.shape[1:], dtype=np.uint32)
-            for f in range(len(bit_fnames)):
-                fail_bits |= (~kept[f]).astype(np.uint32) << f
+            kept = filter_masks[:len(bit_fnames),
+                                first_kept:len(pods), :]
+            packed = np.packbits(~kept, axis=2)  # (F, K, ceil(N/8))
             col_of = {n: j for j, n in enumerate(names) if n is not None}
 
         keys = []
@@ -226,7 +233,7 @@ class ResultStore:
             for i, pod in enumerate(pods):
                 self._results[pod.key] = (batch, i)
                 keys.append(pod.key)
-                if fail_bits is not None:
+                if packed is not None:
                     self._filter_bits.pop(pod.key, None)  # refresh order
                     if i >= first_kept:
                         # .copy(): a retained VIEW would pin the whole
@@ -234,9 +241,9 @@ class ResultStore:
                         # accounts the row — copies keep real residency
                         # equal to the budgeted bytes.
                         self._filter_bits[pod.key] = (
-                            col_of, fail_bits[i - first_kept].copy(),
+                            col_of, packed[:, i - first_kept, :].copy(),
                             bit_fnames)
-            if fail_bits is not None:
+            if packed is not None:
                 while len(self._filter_bits) > retain:
                     self._filter_bits.pop(next(iter(self._filter_bits)))
         return keys
@@ -376,12 +383,12 @@ class ResultStore:
             rec = self._filter_bits.get(pod_key)
         if rec is None:
             return None
-        col_of, bits_row, fnames = rec
+        col_of, planes, fnames = rec  # planes: (F, ceil(N/8)) uint8
         j = col_of.get(node_name)
         if j is None:
             return None
-        b = int(bits_row[j])
-        return {fn: (FAILED if (b >> f) & 1 else PASSED)
+        byte, bit = j >> 3, 7 - (j & 7)  # packbits big-endian bit order
+        return {fn: (FAILED if (int(planes[f, byte]) >> bit) & 1 else PASSED)
                 for f, fn in enumerate(fnames)}
 
     def delete_data(self, key: str) -> None:

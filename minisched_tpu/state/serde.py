@@ -45,5 +45,10 @@ def _from(tp: Any, value: Any) -> Any:
     return value
 
 
+from .codec import build as _codec_build  # noqa: E402
+
+
 def from_dict(kind: str, d: Dict[str, Any]) -> Any:
-    return _from(_KIND_TYPES[kind], d)
+    # Compiled codec — a 50k-node snapshot restore walks every object,
+    # and restore time is the restart-to-first-batch cost.
+    return _codec_build(_KIND_TYPES[kind], d)

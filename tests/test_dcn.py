@@ -1,0 +1,18 @@
+"""Multi-process (DCN) sharding dryrun — the across-hosts half of
+SURVEY §2's ICI+DCN distributed answer, executed for real: two OS
+processes federate their CPU devices via jax.distributed, the hybrid
+(pod=DCN, node=ICI) mesh runs the PRODUCT sharded step with
+cross-process Gloo collectives, and both processes must report the
+identical decision, bit-equal to a single-device recompute.
+
+Subprocess-based by necessity (jax.distributed.initialize must precede
+backend init, which the test process has long since done)."""
+from minisched_tpu.parallel.dcn_dryrun import run_dcn_dryrun
+
+
+def test_two_process_dcn_dryrun():
+    out = run_dcn_dryrun(nprocs=2, timeout_s=240.0)
+    assert "DCN-OK 0" in out and "DCN-OK 1" in out
+    # the success line carries the verified claims
+    assert "DCN == single-device" in out
+    assert "16/16 scheduled" in out

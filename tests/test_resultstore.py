@@ -351,3 +351,34 @@ def test_filter_bitmask_retention_skips_doomed_rows():
         assert rs.filter_verdict(pods[i].key, "na") is None
     for i in range(5, 8):
         assert rs.filter_verdict(pods[i].key, "na") is not None
+
+
+def test_filter_bitmask_packed_rows_retain_full_headline_ratio():
+    """Bit-plane packing (VERDICT r4 #8): rows cost F×⌈N/8⌉ bytes, so a
+    budget that held only ~2/3 of a batch under the old one-uint32-per-
+    (pod,node) layout now holds EVERY row. Scaled-down headline: the
+    exact 10k×50k×(F=1) ratio — budget = rows × N/8 exactly — with
+    verdicts spot-checked against the raw masks on both byte boundaries
+    and interior bits."""
+    from minisched_tpu.explain.resultstore import FAILED, PASSED
+
+    store = ClusterStore()
+    plugin_set = PluginSet([NodeUnschedulable()], {})
+    P, N = 100, 520  # N/8 = 65 B/row; budget = P rows exactly
+    rs = ResultStore(store, flush=False,
+                     full_n_budget_bytes=P * (N // 8))
+    names = [f"n{i}" for i in range(N)]
+    rng = np.random.default_rng(3)
+    fm = rng.random((1, P, N)) > 0.1
+    raw = np.zeros((0, P, N), dtype=np.float32)
+    pods = [store.create(_pod(f"hp{i}")) for i in range(P)]
+    rs.record_batch(pods, names, FakeDecision(fm, raw, raw), plugin_set)
+    assert len(rs._filter_bits) == P  # 100% retention at the ratio
+    # the old uint32 layout (4 B/node) would have held only P/32 rows
+    held = sum(v[1].nbytes for v in rs._filter_bits.values())
+    assert held <= P * (N // 8)
+    for i in (0, 37, P - 1):
+        for j in (0, 7, 8, 255, N - 1):
+            want = PASSED if fm[0, i, j] else FAILED
+            got = rs.filter_verdict(pods[i].key, f"n{j}")
+            assert got == {"NodeUnschedulable": want}, (i, j)
