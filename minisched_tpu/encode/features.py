@@ -247,6 +247,10 @@ class PodFeatures(NamedTuple):
     ports: np.ndarray        # (P,PP) i32 host ports requested
     images: np.ndarray       # (P,IM) i32
     required_node: np.ndarray  # (P,) i32 hash of spec.required_node_name (0=none)
+    # Pod is controlled by a ReplicationController/ReplicaSet (a
+    # controller ownerReference of those kinds) — the scope upstream's
+    # NodePreferAvoidPods applies avoidance to.
+    rc_owned: np.ndarray       # (P,) bool
     volumes_ready: np.ndarray  # (P,) bool — all referenced PVCs are bound
     # claim_rows[c] = node row the pod's c-th claim is currently mounted on
     # (-1 = unused/unrestricted). VolumeRestrictions' RWO exclusivity.
@@ -797,6 +801,9 @@ def _make_pod_sig():
             tuple(p.host_port for p in spec.ports) if spec.ports else (),
             tuple(spec.images) if spec.images else (),
             spec.required_node_name,
+            tuple((r.kind, r.name, r.controller)
+                  for r in pod.metadata.owner_references)
+            if pod.metadata.owner_references else (),
             tuple(spec.node_selector.items()) if spec.node_selector else (),
             tuple((c.topology_key, c.max_skew, c.when_unsatisfiable,
                    sel_sig(c.label_selector)) for c in cons)
@@ -813,7 +820,8 @@ def _make_pod_sig():
 _PROTO_COPY_FIELDS = (
     "requests", "priority", "ns_hash", "label_pairs", "na_group",
     "tol_pairs", "tol_keys", "tol_ops", "tol_effects", "ports", "images",
-    "required_node", "spread_group", "spread_max_skew", "spread_mode",
+    "required_node", "rc_owned",
+    "spread_group", "spread_max_skew", "spread_mode",
     "aff_req_group", "aff_req_self", "aff_pref_group", "aff_pref_weight",
     "anti_req_group", "anti_pref_group", "anti_pref_weight",
     "anti_forbid_key", "anti_forbid_dom", "anti_forbid_row",
@@ -880,6 +888,7 @@ def encode_pods(pods: List[Pod], p_pad: int,
         ports=np.zeros((P, cfg.max_pod_ports), dtype=np.int32),
         images=np.zeros((P, cfg.max_images), dtype=np.int32),
         required_node=np.zeros(P, dtype=np.int32),
+        rc_owned=np.zeros(P, dtype=bool),
         volumes_ready=np.ones(P, dtype=bool),
         claim_rows=np.full((P, cfg.max_pod_claims), -1, dtype=np.int32),
         claim_typed=np.zeros((P, cfg.max_pod_claims), dtype=bool),
@@ -964,6 +973,11 @@ def encode_pods(pods: List[Pod], p_pad: int,
 
         if pod.spec.required_node_name:
             f.required_node[i] = _h(pod.spec.required_node_name)
+        if pod.metadata.owner_references:
+            f.rc_owned[i] = any(
+                r.controller and r.kind in ("ReplicationController",
+                                            "ReplicaSet")
+                for r in pod.metadata.owner_references)
         if pod.spec.volumes:
             if volumes_ready_fn is not None:
                 f.volumes_ready[i] = bool(volumes_ready_fn(pod))

@@ -323,12 +323,45 @@ class ResultStore:
             log.warning("failed to flush scheduling results for %s", key)
         return ok
 
+    def on_pod_events(self, keys) -> None:
+        """Bulk form of on_pod_event for MODIFIED bursts (a 10k bulk
+        bind emits 10k back-to-back events): ONE lock acquisition to
+        find pending keys, then enqueue only the matches."""
+        with self._lock:
+            pending = [k for k in keys if k in self._results]
+        for k in pending:
+            if self._q is not None:
+                if not self._closed:
+                    self._q.put(("flush", k))
+            else:
+                self.flush_pod(k)
+
+    def on_pod_event(self, key: str) -> None:
+        """Informer-event flush trigger (the reference's contract:
+        results land on the pod's NEXT update event, then evict —
+        store.go:60-68,90-135). The proactive post-ingest flush makes
+        this a no-op at steady state; it matters exactly where that
+        flush exhausted its retries (CAS races) and downgraded the entry
+        — the next pod update re-drives it instead of stranding the
+        results until shutdown."""
+        with self._lock:
+            if key not in self._results:
+                return
+        if self._q is not None:
+            if not self._closed:
+                self._q.put(("flush", key))
+        else:
+            self.flush_pod(key)
+
     def _flush_loop(self) -> None:
         while True:
             item = self._q.get()
             try:
                 if item is None:
                     return
+                if len(item) == 2 and item[0] == "flush":
+                    self.flush_pod(item[1])  # informer-event re-drive
+                    continue
                 pods, names, decision, plugin_set = item
                 try:
                     keys = self._ingest(pods, names, decision, plugin_set)

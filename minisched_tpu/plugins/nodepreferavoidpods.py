@@ -6,10 +6,11 @@ scheduler/plugin/plugins.go:24-70): nodes carrying the
 ``scheduler.alpha.kubernetes.io/preferAvoidPods`` annotation score 0 for
 workload pods, everything else scores the max. Upstream gives it weight
 10000 so it dominates other scorers — effectively a soft filter; the
-default_weight here mirrors that. (Upstream additionally scopes avoidance
-to pods owned by a ReplicationController/ReplicaSet; the rebuild's pod
-model carries no owner refs, so the annotation avoids all pods —
-documented simplification.)
+default_weight here mirrors that. Avoidance is scoped exactly as
+upstream scopes it: only pods CONTROLLED by a ReplicationController or
+ReplicaSet (metadata.ownerReferences with controller=true; encoded as
+pf.rc_owned) are steered away — bare pods score every node equally, the
+upstream behavior for pods with no matching controllerRef.
 """
 from __future__ import annotations
 
@@ -27,8 +28,8 @@ class NodePreferAvoidPods(BatchedPlugin):
         return [ClusterEvent(GVK.NODE, ActionType.ADD | ActionType.UPDATE)]
 
     def score(self, pf, nf, ctx) -> jnp.ndarray:
-        # (P,N): 100 for normal nodes, 0 for annotated ones (upstream
-        # scores {0, MaxNodeScore} the same way).
-        return jnp.broadcast_to(
-            jnp.where(nf.avoid_pods, 0.0, 100.0)[None, :],
-            (pf.valid.shape[0], nf.valid.shape[0])).astype(jnp.float32)
+        # (P,N): 100 everywhere except (RC/RS-owned pod, annotated node)
+        # cells, which score 0 (upstream scores {0, MaxNodeScore} and
+        # only for pods with a RC/RS controllerRef).
+        avoid = nf.avoid_pods[None, :] & pf.rc_owned[:, None]
+        return jnp.where(avoid, 0.0, 100.0).astype(jnp.float32)

@@ -143,6 +143,23 @@ class SchedulerService:
         # a node neither would alone. All engines must register before
         # the first start() syncs the informers.
         self._shared_state = SharedClusterState(self._store)
+        if recorder is not None:
+            # Reference resultstore contract (store.go:60-68): pod-update
+            # informer events drive annotation flushes. The recorder's
+            # worker already flushes after ingest; this event hook
+            # re-drives pods whose flush exhausted its CAS retries, so
+            # results still land on the pod's next update.
+            from ..state.informer import ResourceEventHandlers
+
+            self._shared_state.informer_factory.add_handlers(
+                "Pod", ResourceEventHandlers(
+                    on_update=lambda old, new: recorder.on_pod_event(
+                        new.key),
+                    # bulk-bind MODIFIED bursts: one lock acquisition for
+                    # the whole run, not one per pod on the dispatch
+                    # thread
+                    on_update_many=lambda pairs: recorder.on_pod_events(
+                        [new.key for _old, new in pairs])))
         for p, plugin_set in built:
             # In multi-profile mode each engine only takes pods naming its
             # profile; a single profile keeps the accept-everything legacy

@@ -75,12 +75,27 @@ def bump_uid_counter(past: int) -> None:
 
 
 @dataclass
+class OwnerReference:
+    """metadata.ownerReferences entry (the subset scheduling reads:
+    upstream's NodePreferAvoidPods scopes avoidance to pods whose
+    CONTROLLER owner is a ReplicationController/ReplicaSet).
+    ``controller`` defaults False like the k8s API (the field is
+    optional and absent means not-the-controller): a wire object
+    missing the flag must NOT be treated as controller-owned."""
+
+    kind: str = ""
+    name: str = ""
+    controller: bool = False
+
+
+@dataclass
 class ObjectMeta:
     name: str = ""
     namespace: str = ""
     uid: str = field(default_factory=_next_uid)
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
+    owner_references: List["OwnerReference"] = field(default_factory=list)
     resource_version: int = 0
     creation_timestamp: float = 0.0
 

@@ -13,7 +13,11 @@ def _rich_pod() -> obj.Pod:
     return obj.Pod(
         metadata=obj.ObjectMeta(
             name="p1", namespace="ns", labels={"app": "web", "tier": "fe"},
-            annotations={"k": "v"}),
+            annotations={"k": "v"},
+            owner_references=[obj.OwnerReference(kind="ReplicaSet",
+                                                 name="rs1",
+                                                 controller=True),
+                              obj.OwnerReference(kind="Job", name="j1")]),
         spec=obj.PodSpec(
             requests={"cpu": 500.0, "memory": float(2 << 30)},
             node_selector={"zone": "z1"},

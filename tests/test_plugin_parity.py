@@ -91,8 +91,15 @@ def test_cloud_limits_default_ceilings(cluster):
 # ---- NodePreferAvoidPods ------------------------------------------------
 
 def test_node_prefer_avoid_pods_steers_away(cluster):
+    """Upstream scoping (the wrapped plugin checks the pod's CONTROLLER
+    ownerRef): only ReplicationController/ReplicaSet-owned pods are
+    steered off annotated nodes; a bare pod ignores the annotation. The
+    avoid node is made strictly preferable to every other scorer
+    (bigger = higher LeastAllocated score), so the bare pod provably
+    CHOOSES it while the owned pods provably flee it."""
     cluster.start(profile=Profile(plugins=["NodeUnschedulable",
-                                           "NodePreferAvoidPods"]),
+                                           "NodePreferAvoidPods",
+                                           "NodeResourcesLeastAllocated"]),
                   config=fast_config(), with_pv_controller=False)
     avoid = obj.Node(
         metadata=obj.ObjectMeta(
@@ -100,16 +107,26 @@ def test_node_prefer_avoid_pods_steers_away(cluster):
             annotations={
                 "scheduler.alpha.kubernetes.io/preferAvoidPods": "[]"}),
         spec=obj.NodeSpec(),
-        status=obj.NodeStatus(allocatable={"cpu": 4000.0,
-                                           "memory": float(16 << 30),
+        status=obj.NodeStatus(allocatable={"cpu": 64000.0,
+                                           "memory": float(64 << 30),
                                            "pods": 110.0}))
     cluster.store.create(avoid)
-    cluster.create_node("ok-node")
+    cluster.create_node("ok-node")  # 4000 cpu — always more allocated
     for i in range(4):
-        cluster.create_pod(f"avoid-p{i}")
+        p = obj.Pod(metadata=obj.ObjectMeta(
+            name=f"avoid-p{i}", namespace="default",
+            owner_references=[obj.OwnerReference(
+                kind="ReplicaSet", name="rs1", controller=True)]),
+            spec=obj.PodSpec(requests={"cpu": 100.0}))
+        cluster.store.create(p)
     for i in range(4):
         pod = cluster.wait_for_pod_bound(f"avoid-p{i}", timeout=30)
         assert pod.spec.node_name == "ok-node"
+    # a BARE pod is out of the annotation's scope: LeastAllocated makes
+    # the big avoid-node the winner, and nothing steers it away
+    cluster.create_pod("bare-p0")
+    pod = cluster.wait_for_pod_bound("bare-p0", timeout=30)
+    assert pod.spec.node_name == "avoid-node"
 
 
 # ---- WaitForFirstConsumer ----------------------------------------------

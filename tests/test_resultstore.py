@@ -382,3 +382,60 @@ def test_filter_bitmask_packed_rows_retain_full_headline_ratio():
             want = PASSED if fm[0, i, j] else FAILED
             got = rs.filter_verdict(pods[i].key, f"n{j}")
             assert got == {"NodeUnschedulable": want}, (i, j)
+
+
+def test_pod_update_event_redrives_failed_flush():
+    """Reference store.go:60-68 contract: annotations land on the pod's
+    NEXT update event even when the proactive flush exhausted its CAS
+    retries — the event hook re-drives the downgraded entry."""
+
+    class FlakyStore:
+        """Update fails with ConflictError until released."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.fail = True
+
+        def get(self, kind, key):
+            return self.inner.get(kind, key)
+
+        def update(self, o, **kw):
+            if self.fail:
+                from minisched_tpu.errors import ConflictError
+
+                raise ConflictError("induced")
+            return self.inner.update(o, **kw)
+
+    inner = ClusterStore()
+    p = inner.create(_pod("ev0"))
+    flaky = FlakyStore(inner)
+    rs = ResultStore(flaky, flush=True, retry_initial_s=0.001,
+                     retry_steps=2)
+    plugin_set = PluginSet([NodeUnschedulable()], {})
+    fm = np.ones((1, 1, 1), dtype=bool)
+    raw = np.zeros((0, 1, 1), dtype=np.float32)
+    rs.record_batch([p], ["na"], FakeDecision(fm, raw, raw), plugin_set)
+    # the inline flush exhausted retries; results still pending
+    assert p.key in rs.pending_keys()
+    from minisched_tpu.explain.annotation import FILTER_RESULT_KEY
+
+    assert FILTER_RESULT_KEY not in inner.get("Pod", p.key).metadata.annotations
+    # the pod's next update event re-drives the flush
+    flaky.fail = False
+    rs.on_pod_event(p.key)
+    pod = inner.get("Pod", p.key)
+    assert FILTER_RESULT_KEY in pod.metadata.annotations
+    assert p.key not in rs.pending_keys()  # evicted after success
+    rs.on_pod_event(p.key)  # idempotent no-op after eviction
+
+
+def test_on_pod_events_bulk_redrive():
+    """Bulk form: one lock pass finds the pending keys; non-pending keys
+    are skipped without flushes."""
+    store, pods, ps, rs, names, dec = _setup(n_pods=2, flush=False)
+    rs.record_batch(pods, names, dec, ps)
+    assert len(rs.pending_keys()) == 2
+    rs.on_pod_events([pods[0].key, pods[1].key, "ns/ghost"])
+    assert rs.pending_keys() == []  # both flushed, ghost ignored
+    pod = store.get("Pod", pods[0].key)
+    assert FILTER_RESULT_KEY in pod.metadata.annotations

@@ -40,6 +40,14 @@ def main() -> None:
     ap.add_argument("--c4", action="store_true",
                     help="profile the config-4 topology profile instead "
                          "of the resources-only headline profile")
+    ap.add_argument("--passes", action="store_true",
+                    help="per-pass attribution ladder: time the step "
+                         "with an increasing plugin subset; successive "
+                         "deltas attribute each plugin's (P,N) pass, and "
+                         "the first rung (a trivial mask + the greedy "
+                         "scan) bounds the assignment stage — the "
+                         "roofline's 'bound by X' evidence (VERDICT r4 "
+                         "#6)")
     args = ap.parse_args()
 
     import jax
@@ -86,6 +94,33 @@ def main() -> None:
         jax.block_until_ready(out)
         print(f"{label} = {time.perf_counter() - t0:.4f} s", flush=True)
         return out
+
+    if args.passes:
+        # Ladder: each rung adds one plugin; the step-time delta is that
+        # plugin's marginal pass cost at these shapes (fusion included —
+        # which is the honest number: XLA may fold a pass into a
+        # neighbor, and then its marginal cost IS ~0). Rung 0 ≈ the
+        # assignment scan + dispatch floor.
+        prev = None
+        for k in range(1, len(plugins) + 1):
+            if k == len(plugins):
+                substep = step  # the full profile is already compiled
+            else:
+                sub = Profile(name=f"prof{k}", plugins=plugins[:k],
+                              plugin_args={"NodeResourcesFit":
+                                           {"score_strategy": None}}
+                              ).build()
+                substep = build_step(sub, explain=False)
+            out = substep(eb, nf, af, key)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            out = substep(eb, nf, af, key)
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            delta = "" if prev is None else f"  (+{dt - prev:.4f} marginal)"
+            print(f"pass_ladder[{k}] {plugins[k-1]:32s} = {dt:.4f} s"
+                  f"{delta}", flush=True)
+            prev = dt
 
     d = timed("step_s", lambda: step(eb, nf, af, key))
     timed("pack_fetch_s", lambda: np.array(_pack_decision(
