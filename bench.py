@@ -714,6 +714,15 @@ def run_child() -> None:
             w_nodes, w_pods = _mw(w_n, w_p, seed=7)
             detail.update(engine_bench(w_n, w_p, w_nodes, w_pods,
                                        plugins, prefix="wire", wire=True))
+            # Same-shape in-process comparator: the r4 verdict compared
+            # the wire number against a DIFFERENT-shape in-process one;
+            # this makes "wire ≥ 50% of in-process" checkable directly.
+            detail.update(engine_bench(w_n, w_p, w_nodes, w_pods,
+                                       plugins, prefix="inproc_wshape"))
+            wp = detail.get("wire_pods_per_sec", 0)
+            ip = detail.get("inproc_wshape_pods_per_sec", 0)
+            if wp and ip:
+                detail["wire_vs_inprocess_pct"] = round(100.0 * wp / ip, 1)
     except Exception as e:
         detail["wire_error"] = f"{type(e).__name__}: {e}"[:300]
 

@@ -801,9 +801,13 @@ def _make_pod_sig():
             tuple(p.host_port for p in spec.ports) if spec.ports else (),
             tuple(spec.images) if spec.images else (),
             spec.required_node_name,
-            tuple((r.kind, r.name, r.controller)
-                  for r in pod.metadata.owner_references)
-            if pod.metadata.owner_references else (),
+            # only the DERIVED rc_owned bit reaches the encoding — keying
+            # on the full refs would fragment the prototype memo per
+            # ReplicaSet (100 RS × identical pods = 100 signatures)
+            any(r.controller and r.kind in ("ReplicationController",
+                                            "ReplicaSet")
+                for r in pod.metadata.owner_references)
+            if pod.metadata.owner_references else False,
             tuple(spec.node_selector.items()) if spec.node_selector else (),
             tuple((c.topology_key, c.max_skew, c.when_unsatisfiable,
                    sel_sig(c.label_selector)) for c in cons)

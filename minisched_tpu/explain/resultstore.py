@@ -344,14 +344,7 @@ class ResultStore:
         flush exhausted its retries (CAS races) and downgraded the entry
         — the next pod update re-drives it instead of stranding the
         results until shutdown."""
-        with self._lock:
-            if key not in self._results:
-                return
-        if self._q is not None:
-            if not self._closed:
-                self._q.put(("flush", key))
-        else:
-            self.flush_pod(key)
+        self.on_pod_events((key,))
 
     def _flush_loop(self) -> None:
         while True:

@@ -422,3 +422,109 @@ def test_chaos_hard_skew_drain_under_node_churn():
                 <= 1 + dropped), (counts, dropped)
     finally:
         c.shutdown()
+
+
+def test_chaos_checkpoint_under_churn_restores_consistent_state(tmp_path):
+    """Interval checkpoints race live scheduling/churn; EVERY observable
+    snapshot must be a consistent POINT-IN-TIME capture: parseable
+    (atomic rename — never torn), its resource_version at least every
+    contained object's rv (snapshot() grabs refs under one lock), and
+    rv monotonically non-decreasing across observations. (A bound pod
+    referencing a deleted node is NOT asserted — the store legitimately
+    holds that state transiently during node churn, exactly like
+    kubernetes; the engine's incarnation/orphan machinery owns it.)
+    The LAST snapshot must restore into a cluster the engine can keep
+    scheduling against."""
+    import json as _json
+    import os as _os
+
+    from minisched_tpu.state.persistence import Checkpointer, open_or_restore
+
+    path = str(tmp_path / "churn.json")
+    c = Cluster()
+    c.start(config=SchedulerConfig(backoff_initial_s=0.05,
+                                   backoff_max_s=0.3,
+                                   batch_window_s=0.0),
+            with_pv_controller=False)
+    cp = Checkpointer(c.store, path, interval_s=0.02)
+    errors: list = []
+    stop = threading.Event()
+
+    for i in range(10):
+        c.create_node(f"ck-n{i}")
+
+    @_guarded(errors)
+    def pod_churn():
+        i = 0
+        while not stop.is_set():
+            c.create_pod(f"ck-p{i}")
+            if i >= 6 and i % 3 == 0:
+                try:
+                    c.delete_pod(f"ck-p{i - 6}")
+                except NotFoundError:
+                    pass
+            i += 1
+            time.sleep(0.003)
+
+    @_guarded(errors)
+    def node_churn():
+        j = 0
+        while not stop.is_set():
+            try:
+                c.delete_node(f"ck-n{j % 10}")
+                time.sleep(0.004)
+                c.create_node(f"ck-n{j % 10}")
+            except (NotFoundError, AlreadyExistsError):
+                pass
+            j += 1
+            time.sleep(0.004)
+
+    @_guarded(errors)
+    def snapshot_reader():
+        # every observation of the file must be a consistent capture
+        last_rv = -1
+        while not stop.is_set():
+            if _os.path.exists(path):
+                with open(path) as f:
+                    snap = _json.load(f)  # parseable always (atomic rename)
+                rv = snap["resource_version"]
+                if rv < last_rv:
+                    errors.append(AssertionError(
+                        f"snapshot rv went backwards: {rv} < {last_rv}"))
+                    return
+                last_rv = rv
+                for kind, col in snap["objects"].items():
+                    for key, d in col.items():
+                        orv = d["metadata"]["resource_version"]
+                        if orv > rv:
+                            errors.append(AssertionError(
+                                f"snapshot rv {rv} < contained {kind} "
+                                f"{key} rv {orv} (mid-mutation capture)"))
+                            return
+            time.sleep(0.005)
+
+    threads = [threading.Thread(target=t, daemon=True)
+               for t in (pod_churn, node_churn, snapshot_reader)]
+    for t in threads:
+        t.start()
+    time.sleep(2.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    c.shutdown()
+    cp.close()
+    assert not errors, errors[:3]
+
+    # the final checkpoint restores into a schedulable cluster
+    restored = open_or_restore(path)
+    c2 = Cluster(store=restored)
+    c2.start(config=SchedulerConfig(backoff_initial_s=0.05,
+                                    backoff_max_s=0.3),
+             with_pv_controller=False)
+    try:
+        c2.create_node("ck-fresh")
+        c2.create_pod("ck-post")
+        pod = c2.wait_for_pod_bound("ck-post", timeout=30)
+        assert pod.spec.node_name
+    finally:
+        c2.shutdown()

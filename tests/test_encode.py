@@ -381,3 +381,28 @@ def test_upsert_nodes_bulk_duplicate_name_in_batch():
     from minisched_tpu.state.objects import RESOURCE_INDEX
     assert float(np.asarray(f.allocatable)[row, RESOURCE_INDEX["cpu"]]) \
         == 9000.0
+
+
+def test_pod_sig_keys_on_derived_rc_owned_not_owner_identity():
+    """The encode-memo signature must not fragment per ReplicaSet: 100
+    otherwise-identical pods owned by 100 different RS share ONE
+    signature (only the derived rc_owned bit reaches the encoding),
+    while owned vs bare pods differ."""
+    from minisched_tpu.encode.features import _make_pod_sig
+    from minisched_tpu.state.objects import OwnerReference
+
+    sig = _make_pod_sig()
+
+    def owned(i):
+        return Pod(metadata=ObjectMeta(
+            name=f"o{i}", namespace="d",
+            owner_references=[OwnerReference(kind="ReplicaSet",
+                                             name=f"rs{i}",
+                                             controller=True)]),
+            spec=PodSpec(requests={"cpu": 100.0}))
+
+    sigs = {sig(owned(i)) for i in range(100)}
+    assert len(sigs) == 1
+    bare = Pod(metadata=ObjectMeta(name="b0", namespace="d"),
+               spec=PodSpec(requests={"cpu": 100.0}))
+    assert sig(bare) not in sigs
