@@ -726,6 +726,43 @@ def run_child() -> None:
     except Exception as e:
         detail["wire_error"] = f"{type(e).__name__}: {e}"[:300]
 
+    print(json.dumps(result))  # flush bitmask/wire numbers before the
+    sys.stdout.flush()         # multi-second persist phase can be killed
+
+    # ---- durability cost at headline shape (round-5 persistence) -------
+    # Checkpoint + restore of the MAIN store (already holding every node
+    # and the whole pod population): the two halves of
+    # restart-to-first-batch the lifecycle now owns (interval/shutdown
+    # checkpoints; open_or_restore at boot). Bulk node sync
+    # (engine_sync_s above) is the third term.
+    try:
+        if in_budget("persist_save_s"):
+            import tempfile
+
+            from minisched_tpu.state.persistence import (Checkpointer,
+                                                         open_or_restore)
+
+            with tempfile.TemporaryDirectory() as td:
+                ppath = os.path.join(td, "bench-snap.json")
+                cp = Checkpointer(store, ppath)
+                t0 = time.perf_counter()
+                cp.checkpoint()
+                detail["persist_save_s"] = round(time.perf_counter() - t0, 3)
+                detail["persist_snapshot_mb"] = round(
+                    os.path.getsize(ppath) / 1e6, 1)
+                t0 = time.perf_counter()
+                restored = open_or_restore(ppath)
+                detail["persist_restore_s"] = round(
+                    time.perf_counter() - t0, 3)
+                counts = restored.stats()["objects"]
+                if (counts["Node"] != n_nodes or counts["Pod"] != n_pods
+                        or restored.resource_version()
+                        != store.resource_version()):
+                    detail["error"] = "persist roundtrip mismatch"
+                cp.close()
+    except Exception as e:
+        detail["persist_error"] = f"{type(e).__name__}: {e}"[:300]
+
     emit_and_exit(0)
 
 
