@@ -29,6 +29,13 @@ class SchedulerConfig:
     # window (full deterministic batches → stable pad buckets, no
     # mid-burst recompiles).
     batch_window_s: float = 0.0
+    # Idle-exit for the gather window (engine/queue.py pop_batch): stop
+    # gathering once no pod has arrived for this long — the burst's TAIL
+    # batch otherwise stalls for the whole window. Only meaningful with
+    # batch_window_s > 0; size it above expected informer stalls (a
+    # too-small grace splits straggler batches onto fresh pad buckets,
+    # costing compiles). 0 = pure-window behavior.
+    batch_idle_s: float = 0.0
     pod_bucket_min: int = 16         # bucket ladder minimum (pad P)
     node_bucket_min: int = 16        # bucket ladder minimum (pad N)
     backoff_initial_s: float = 1.0   # reference queue.go:218-221

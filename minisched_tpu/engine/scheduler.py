@@ -664,7 +664,8 @@ class Scheduler:
         while not self._stop.is_set():
             batch = self.queue.pop_batch(
                 self.config.max_batch_size, timeout=0.2,
-                gather_window=self.config.batch_window_s)
+                gather_window=self.config.batch_window_s,
+                gather_idle=self.config.batch_idle_s)
             if not batch:
                 # Genuine idle (no pending pods) is not inter-batch
                 # overhead; only back-to-back batches feed the gap metric.

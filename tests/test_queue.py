@@ -218,3 +218,46 @@ def test_closed_queue_returns_empty():
     q = make_queue()
     q.close()
     assert q.pop_batch(10, timeout=0.1) == []
+
+
+def test_gather_idle_exits_on_quiescent_tail():
+    """gather_idle: a burst TAIL (fewer than max_n left) pops once no new
+    pod arrives for the grace period — not after the whole window."""
+    q = make_queue()
+    for i in range(4):
+        q.add(pod(f"t{i}"))
+    t0 = time.monotonic()
+    batch = q.pop_batch(10, timeout=5, gather_window=5.0, gather_idle=0.05)
+    took = time.monotonic() - t0
+    assert len(batch) == 4
+    assert took < 1.0, f"idle-exit should beat the 5s window (took {took})"
+    q.close()
+
+
+def test_gather_idle_resets_on_arrivals():
+    """Arrivals inside the grace keep the gather alive: a trickle slower
+    than nothing-but-faster-than-the-grace still forms one batch."""
+    q = make_queue()
+    q.add(pod("r0"))
+
+    def feed():
+        for i in range(1, 6):
+            time.sleep(0.1)  # well under the 0.5s grace: resets it, with
+            q.add(pod(f"r{i}"))  # headroom for CI scheduler stalls
+    t = threading.Thread(target=feed)
+    t.start()
+    batch = q.pop_batch(6, timeout=5, gather_window=5.0, gather_idle=0.5)
+    t.join()
+    assert len(batch) == 6
+    q.close()
+
+
+def test_gather_idle_zero_keeps_pure_window():
+    q = make_queue()
+    q.add(pod("w0"))
+    t0 = time.monotonic()
+    batch = q.pop_batch(10, timeout=5, gather_window=0.3, gather_idle=0.0)
+    took = time.monotonic() - t0
+    assert len(batch) == 1
+    assert took >= 0.25, "without gather_idle the window must run out"
+    q.close()

@@ -15,17 +15,24 @@ if ! timeout 90 python -c "import jax; print(jax.devices()[0].platform)"; then
 fi
 
 echo "running full bench (budget 2400 s — do NOT interrupt mid-compile)"
-MINISCHED_BENCH_TIMEOUT=2400 python bench.py | tail -1 > /tmp/bench_line.json
+LINE_FILE="$(mktemp)"  # fixed /tmp path would let concurrent runs clobber
+trap 'rm -f "$LINE_FILE"' EXIT
+MINISCHED_BENCH_TIMEOUT=2400 python bench.py | tail -1 > "$LINE_FILE"
 
-python - <<'EOF'
-import json, sys
-line = open("/tmp/bench_line.json").read().strip()
+BENCH_LINE_FILE="$LINE_FILE" python - <<'EOF'
+import json, os, sys
+line = open(os.environ["BENCH_LINE_FILE"]).read().strip()
 d = json.loads(line)
-plat = d.get("detail", {}).get("platform")
+det = d.get("detail", {})
+plat = det.get("platform")
 if plat != "tpu":
     sys.exit(f"platform={plat!r}, not tpu — NOT updating BENCH_TPU.json")
-if "error" in d.get("detail", {}):
-    sys.exit(f"bench reported error: {d['detail']['error']!r} — not saving")
+# ANY failed phase disqualifies the artifact: per-phase failures land in
+# *_error keys with no top-level "error", and committing a partial
+# artifact silently drops headline lines from the regenerated README.
+bad = {k: v for k, v in det.items() if k == "error" or k.endswith("_error")}
+if bad:
+    sys.exit(f"bench reported phase errors {bad!r} — not saving")
 json.dump(d, open("BENCH_TPU.json", "w"), indent=2)
 print("BENCH_TPU.json updated:",
       {k: d["detail"].get(k) for k in
