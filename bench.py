@@ -873,16 +873,20 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
         # instead of fragmenting into partial batches that each pay a
         # fresh XLA compile. Gathering terminates exactly when all
         # n_pods are queued; the window is only the stall-tolerant cap.
-        # Idle-exit at 20 ms for the STREAMING phases (batch < n_pods):
+        # Idle-exit at 100 ms for the STREAMING phases (batch < n_pods):
         # the burst's tail batch must not stall for the whole gather
         # window (a 1000-pod burst at batch 256 paid the full window on
         # its 232-pod tail — ~half the measured stream window at the
-        # CPU-fallback shape was that artifact). Single-batch BURST
-        # phases keep the pure window: their batch fills and pops on the
-        # count check, and an idle heuristic could only ever split them.
+        # CPU-fallback shape was that artifact). The grace sits AT the
+        # pop_batch docstring's informer-stall floor (gen-2 GC / wire
+        # long-poll hiccups): smaller would risk splitting a straggler
+        # batch onto a cold pad bucket and absorbing its XLA compile
+        # into the measured window. Single-batch BURST phases keep the
+        # pure window: their batch fills and pops on the count check,
+        # and an idle heuristic could only ever split them.
         cfg = SchedulerConfig(max_batch_size=batch_size,
                               batch_window_s=window_s, explain=explain,
-                              batch_idle_s=(0.02 if batch_size < n_pods
+                              batch_idle_s=(0.1 if batch_size < n_pods
                                             else 0.0))
         if backoff_s is not None:
             # Skew-style convergence workloads retry revoked pods across
