@@ -118,6 +118,8 @@ def config_from_env() -> SchedulerConfig:
         mesh = make_mesh(devs[:mesh_devices])
     return SchedulerConfig(
         max_batch_size=int(_req("MINISCHED_MAX_BATCH", "1024")),
+        batch_window_s=float(_req("MINISCHED_BATCH_WINDOW", "0.0")),
+        batch_idle_s=float(_req("MINISCHED_BATCH_IDLE", "0.0")),
         explain=_req("MINISCHED_EXPLAIN", "0") == "1",
         seed=int(_req("MINISCHED_SEED", "0")),
         backoff_initial_s=float(_req("MINISCHED_BACKOFF_INITIAL", "1.0")),

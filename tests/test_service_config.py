@@ -155,10 +155,14 @@ def test_config_from_env_overrides(monkeypatch):
     monkeypatch.setenv("MINISCHED_MAX_BATCH", "64")
     monkeypatch.setenv("MINISCHED_EXPLAIN", "1")
     monkeypatch.setenv("MINISCHED_SEED", "7")
+    monkeypatch.setenv("MINISCHED_BATCH_WINDOW", "0.5")
+    monkeypatch.setenv("MINISCHED_BATCH_IDLE", "0.1")
     cfg = config_from_env()
     assert cfg.max_batch_size == 64
     assert cfg.explain is True
     assert cfg.seed == 7
+    assert cfg.batch_window_s == 0.5
+    assert cfg.batch_idle_s == 0.1
 
 
 def test_config_from_env_empty_is_typed_error(monkeypatch):
