@@ -9,12 +9,37 @@ PY ?= python
 # a wedged tunnel can't hang backend init.
 CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: test start start-remote start-client-engine demo docs bench \
-        bench_sharded bench-cpu dryrun dryrun-dcn soak
+# tier1 uses pipefail/PIPESTATUS (bash-isms).
+SHELL := /bin/bash
+
+.PHONY: test tier1 profile-smoke start start-remote start-client-engine \
+        demo docs bench bench_sharded bench-cpu bench-pipeline dryrun \
+        dryrun-dcn soak
 
 # Unit + integration suite on a virtual 8-device CPU mesh.
 test:
 	$(CPU_MESH) $(PY) -m pytest tests/ -x -q
+
+# The EXACT ROADMAP tier-1 verify command (dots count + exit code
+# preserved) — what the driver runs after every PR; run it locally
+# before shipping.
+tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+	  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; \
+	rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' \
+	  /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# Pass-ladder attribution smoke at CPU shapes (headline + topology
+# profiles): catches step/pass-cost regressions in the marginal-cost
+# ladder without TPU hardware (tools/profile_step.py --passes).
+profile-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/profile_step.py --nodes 512 --pods 128 \
+	  --passes
+	JAX_PLATFORMS=cpu $(PY) tools/profile_step.py --nodes 512 --pods 128 \
+	  --passes --c4
 
 # Run the README scenario end-to-end (reference `make start`): 9
 # unschedulable nodes + 1 pod pending → node10 added → pod bound.
@@ -64,6 +89,11 @@ bench_sharded:
 bench-cpu:
 	MINISCHED_BENCH_NODES=2000 MINISCHED_BENCH_PODS=500 \
 	  MINISCHED_BENCH_TIMEOUT=1200 JAX_PLATFORMS=cpu $(PY) bench.py
+
+# Pipelined-vs-synchronous engine comparison at CPU shapes (the
+# committed BENCH_PIPELINE.json modes section).
+bench-pipeline:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_pipeline.py
 
 # Compile-check the flagship single-chip step and the multi-chip sharded
 # step on an 8-device virtual mesh.

@@ -183,10 +183,13 @@ def run_child() -> None:
     sys.stdout.flush()
 
     # ---- engine-through bench (the product number: right after the ----
-    # headline so a budget overrun can only cost supplementary phases)
+    # headline so a budget overrun can only cost supplementary phases).
+    # Burst phases repeat lat_samples times so the published p50/p99
+    # come from ≥ 20 distinct create→bind windows (verdict r5 #8).
+    lat_samples = int(os.environ.get("MINISCHED_BENCH_LAT_SAMPLES", "20"))
     try:
         detail.update(engine_bench(n_nodes, n_pods, make_nodes, make_pods,
-                                   plugins))
+                                   plugins, lat_samples=lat_samples))
     except Exception as e:
         detail["engine_error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(result))
@@ -230,7 +233,7 @@ def run_child() -> None:
             c4e_nodes, c4e_pods = make_c4_workload(n_nodes, n_pods)
             detail.update(engine_bench(
                 n_nodes, n_pods, c4e_nodes, c4e_pods, C4_PLUGINS,
-                prefix="engine_c4"))
+                prefix="engine_c4", lat_samples=lat_samples))
             # The verdict's named key: p50 create→bound on the c4 profile.
             if "engine_c4_p50_latency_s" in detail:
                 detail["engine_c4_p50"] = detail["engine_c4_p50_latency_s"]
@@ -301,12 +304,14 @@ def run_child() -> None:
             from minisched_tpu.ops.select import NEG as _NEG
 
             import jax.numpy as jnp
+            from minisched_tpu.state.objects import RESOURCES as _RES
+
             rng_k = np.random.default_rng(3)
             ks = rng_k.random((p_pad, n_pad)).astype(np.float32) * 100
             ks[rng_k.random((p_pad, n_pad)) < 0.2] = float(_NEG)
-            kreq = (rng_k.integers(1, 4, (p_pad, 9)) * 100).astype(
+            kreq = (rng_k.integers(1, 4, (p_pad, len(_RES))) * 100).astype(
                 np.float32)
-            kfree = (rng_k.integers(1, 5, (n_pad, 9)) * 250).astype(
+            kfree = (rng_k.integers(1, 5, (n_pad, len(_RES))) * 250).astype(
                 np.float32)
             kargs = (jnp.array(ks), jnp.array(kreq), jnp.array(kfree),
                      jax.random.PRNGKey(9))
@@ -826,7 +831,8 @@ def roofline(seconds: float, p: int, n: int, n_filters: int,
 
 def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                  batch_size=None, prefix="engine", window_s=15.0,
-                 explain=False, backoff_s=None, wire=False) -> dict:
+                 explain=False, backoff_s=None, wire=False,
+                 lat_samples=1) -> dict:
     """Schedule the same workload through the REAL engine: store + informers
     + queue + batched cycle + bulk bind; throughput from scheduler.metrics().
     Two passes — the first eats XLA compiles for the engine's pad buckets,
@@ -843,7 +849,17 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
     sits behind the HTTP apiserver with bearer-token auth + flow control
     ON, the scheduler attaches via RemoteStore (informers long-polling
     /watch, bindings through /bind), and the pod burst is submitted over
-    the wire too."""
+    the wire too.
+
+    ``lat_samples`` > 1 repeats the measured burst that many times
+    (fresh uniquely-named pods per round, previous round's pods deleted
+    so capacity and pad buckets stay constant): single-burst phases
+    otherwise commit every pod in ONE bulk transaction — one
+    scheduled_time stamp — and the published p50/p99 collapse to one
+    sample dressed as a distribution (round-5 verdict weak #6). The
+    latency percentiles then span ≥ lat_samples distinct
+    creation→bind windows BY CONSTRUCTION; throughput keys keep their
+    historical first-round meaning."""
     from minisched_tpu.config import SchedulerConfig
     from minisched_tpu.service.defaultconfig import Profile
     from minisched_tpu.service.service import SchedulerService
@@ -887,7 +903,12 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
         cfg = SchedulerConfig(max_batch_size=batch_size,
                               batch_window_s=window_s, explain=explain,
                               batch_idle_s=(0.1 if batch_size < n_pods
-                                            else 0.0))
+                                            else 0.0),
+                              # honor the engine's sync-fallback knob so
+                              # pipelined-vs-synchronous comparisons run
+                              # through the same harness
+                              pipeline=os.environ.get(
+                                  "MINISCHED_PIPELINE", "1") != "0")
         if backoff_s is not None:
             # Skew-style convergence workloads retry revoked pods across
             # cycles; the reference's 1 s initial backoff would dominate
@@ -899,6 +920,7 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
         # engine_total_s includes this bootstrap, engine_sched_s (the
         # create→all-bound window) does not.
         sync_s = time.perf_counter() - t0
+        base_assigned = sched.cache.assigned_count()
         # Freeze the synced cluster out of gen-2 GC (see raw-step bench);
         # unfrozen, collection pauses over ~10^6 long-lived objects land
         # randomly inside the measured window and dominate its variance.
@@ -907,22 +929,76 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
         # Build the workload objects BEFORE the clock starts: the
         # create→bound window measures the scheduler from submission,
         # not the client's own object construction.
-        pod_objs = make_pods()
-        t_pods = time.perf_counter()
-        # Bulk submission: the workload burst arrives as one store
-        # transaction (one watch wake-up); the informer drains it in
-        # batches — the creation loop itself is off the critical path.
-        (client if wire else store).create_many(pod_objs)
-        deadline = time.time() + float(
-            os.environ.get("MINISCHED_BENCH_ENGINE_DEADLINE", "240"))
+        # Warmup runs TWO rounds when latency sampling is on: round 2 is
+        # the first to see the post-bind assigned-corpus pad bucket, and
+        # its XLA compile must land in the warmup pass, not in the
+        # measured p99.
+        rounds = lat_samples if attempt == "measured" else min(
+            2, lat_samples)
+        per_pod_lat: list = []
+        round_times: list = []
+        short = [None]  # non-convergence note from any measured round
+        sched_s = 0.0
         bound = 0
-        while time.time() < deadline:
-            m = sched.metrics()
-            bound = int(m["pods_bound"])
-            if bound >= n_pods:
-                break
-            time.sleep(0.02)
-        sched_s = time.perf_counter() - t_pods
+        deadline_s = float(
+            os.environ.get("MINISCHED_BENCH_ENGINE_DEADLINE", "240"))
+        for r in range(max(1, rounds)):
+            pod_objs = make_pods()
+            if r:
+                # fresh identities per extra latency round (same shape)
+                for p in pod_objs:
+                    p.metadata.name = f"{p.metadata.name}-r{r}"
+            t_pods = time.perf_counter()
+            # Bulk submission: the workload burst arrives as one store
+            # transaction (one watch wake-up); the informer drains it in
+            # batches — the creation loop is off the critical path.
+            (client if wire else store).create_many(pod_objs)
+            deadline = time.time() + deadline_s
+            target = n_pods * (r + 1)
+            while time.time() < deadline:
+                m = sched.metrics()
+                bound = int(m["pods_bound"])
+                if bound >= target:
+                    break
+                time.sleep(0.02)
+            round_s = time.perf_counter() - t_pods
+            round_times.append(round_s)
+            if r == 0:
+                # throughput keys keep their historical single-burst
+                # meaning: the FIRST round's create→all-bound window
+                sched_s = round_s
+                bound_r0 = min(bound, n_pods)
+            if attempt == "measured":
+                keys = {p.key for p in pod_objs}
+                per_pod_lat.extend(
+                    p.status.scheduled_time - p.metadata.creation_timestamp
+                    for p in store.list("Pod")
+                    if p.status.scheduled_time and p.key in keys)
+            if bound < target:
+                # Surface the shortfall explicitly: the first-round keys
+                # would otherwise publish a healthy-looking benchmark
+                # while later latency rounds silently stalled.
+                short[0] = (f"round {r} bound {bound - r * n_pods}"
+                            f"/{n_pods} at deadline")
+                break  # did not converge; stop burning rounds
+            if r < rounds - 1:
+                # Return to the pre-burst cluster (untimed): capacity,
+                # assigned-corpus high water, and pad buckets stay
+                # constant, so every round measures the same problem.
+                for p in pod_objs:
+                    try:
+                        store.delete("Pod", p.key)
+                    except Exception:
+                        pass
+                # Barrier: wait for the engine to PROCESS the unbinds
+                # (informer drain + cache accounting) so the cleanup's
+                # asynchronous tail cannot bleed into the next round's
+                # timed create→bind window.
+                cleanup_dl = time.time() + 30
+                while time.time() < cleanup_dl:
+                    if sched.cache.assigned_count() <= base_assigned:
+                        break
+                    time.sleep(0.01)
         total_s = time.perf_counter() - t0
         m = sched.metrics()
         svc.shutdown_scheduler()
@@ -940,20 +1016,25 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                         "warmup pass reported; did not converge"}
         if attempt == "measured":
             # Per-pod schedule latency: creation → binding commit stamps
-            # (the BASELINE metric "p50 schedule-one latency @ 50k nodes").
+            # (the BASELINE metric "p50 schedule-one latency @ 50k
+            # nodes"), collected per round so multi-round burst phases
+            # span lat_samples distinct creation→bind windows.
             import numpy as _np
 
-            lat = [p.status.scheduled_time - p.metadata.creation_timestamp
-                   for p in store.list("Pod") if p.status.scheduled_time]
-            pcts = (_np.percentile(lat, [50, 99]) if lat else (0.0, 0.0))
+            pcts = (_np.percentile(per_pod_lat, [50, 99])
+                    if per_pod_lat else (0.0, 0.0))
             out = {
                 f"{prefix}_p50_latency_s": round(float(pcts[0]), 4),
                 f"{prefix}_p99_latency_s": round(float(pcts[1]), 4),
-                f"{prefix}_bound": bound,
+                f"{prefix}_lat_samples": len(round_times),
+                **({f"{prefix}_note": f"did not converge: {short[0]}"}
+                   if short[0] else {}),
+                f"{prefix}_bound": bound_r0,
                 f"{prefix}_total_s": round(total_s, 4),
                 f"{prefix}_sync_s": round(sync_s, 4),
                 f"{prefix}_sched_s": round(sched_s, 4),
-                f"{prefix}_pods_per_sec": round(bound / max(sched_s, 1e-9), 1),
+                f"{prefix}_pods_per_sec":
+                    round(bound_r0 / max(sched_s, 1e-9), 1),
                 f"{prefix}_batches": int(m["batches"]),
                 f"{prefix}_batch_sizes": m.get("batch_sizes", []),
                 f"{prefix}_encode_s": round(m["encode_s_total"], 4),
@@ -962,6 +1043,12 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                     round(m["step_dispatch_s_total"], 4),
                 f"{prefix}_pad_shapes": list(m.get("last_shapes", ())),
                 f"{prefix}_commit_s": round(m["commit_s_total"], 4),
+                # Pipelined-cycle overlap evidence (engine/scheduler.py):
+                # host work hidden behind the device step / later stages.
+                f"{prefix}_encode_overlap_s":
+                    round(m.get("encode_overlap_s", 0.0), 4),
+                f"{prefix}_commit_overlap_s":
+                    round(m.get("commit_overlap_s", 0.0), 4),
                 f"{prefix}_gap_s": round(m.get("gap_s_total", 0.0), 4),
                 f"{prefix}_bind_conflicts": int(m["bind_conflicts"]),
                 # revocations + terminal failures summed over cycles —

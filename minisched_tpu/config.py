@@ -78,6 +78,16 @@ class SchedulerConfig:
     # big enough that the node axis is worth splitting, where each
     # shard's slice is already the sample-sized problem.
     mesh: object = None
+    # Pipelined engine cycle (engine/scheduler.py _run_pipelined): while
+    # batch k's jitted step executes on device (JAX async dispatch), the
+    # host flushes batch k-1's commit work (store status writes, queue
+    # requeues, event emission) on a dedicated worker and gathers batch
+    # k+1 from the queue; batch k+1 is encoded only AFTER batch k's
+    # arbitration + assume accounting (the batch-internal causality
+    # rule), so decisions are identical to the synchronous loop. False
+    # (MINISCHED_PIPELINE=0) restores the strictly synchronous cycle —
+    # the debugging/regression-triage fallback.
+    pipeline: bool = True
     # Intra-cycle repair for topology-revoked pods: after the batch's
     # survivors are assumed, re-run the step on the revoked rows against
     # the refreshed counts up to this many times before falling back to
@@ -127,5 +137,6 @@ def config_from_env() -> SchedulerConfig:
         platform=os.environ.get("MINISCHED_PLATFORM", ""),
         percentage_of_nodes_to_score=int(
             _req("MINISCHED_PCT_NODES_TO_SCORE", "0")),
+        pipeline=_req("MINISCHED_PIPELINE", "1") != "0",
         mesh=mesh,
     )

@@ -156,6 +156,14 @@ class NodeFeatureCache:
         self._anti_terms: Dict[tuple, Dict[int, List[int]]] = {}
         # pod key → (priority, sigs)
         self._pod_anti: Dict[str, Tuple[int, List[tuple]]] = {}
+        # Owner-spread pairs in the assigned corpus's label rows
+        # (SelectorSpread): OFF unless a profile actually runs the
+        # plugin — the pair would otherwise fragment the bulk-rebuild
+        # label-row memo per controller (100 same-labeled ReplicaSets =
+        # 100 rows instead of 1) and emit under-count diagnostics for a
+        # plugin nobody enabled. Enable BEFORE any bind accounting
+        # (engines construct before their informers start).
+        self._owner_pairs = False
         # Encoding-slot overflow reports: deduplicated and bounded — bind
         # churn re-reports the same pod's overflow on every account_bind,
         # and nothing drains this sink in production.
@@ -181,6 +189,14 @@ class NodeFeatureCache:
         # run as one vectorized mask over the assigned arrays instead of
         # an O(all bound pods) dict walk under the cache lock.
         self._a_key: List[Optional[str]] = [None] * a_cap
+
+    def enable_owner_pairs(self) -> None:
+        """Record controller-owner spread pairs in assigned label rows
+        (SelectorSpread's population signal). Call before the first bind
+        is accounted — rows accounted earlier carry no pair and would be
+        under-counted until their pods churn."""
+        with self._lock:
+            self._owner_pairs = True
 
     # ---- node lifecycle -------------------------------------------------
 
@@ -500,17 +516,33 @@ class NodeFeatureCache:
                     if h is None:
                         h = ns_memo[ns] = F._h(ns) if ns else 0
                     self._assigned.ns_hash[a] = h
-                    sig = tuple(pod.metadata.labels.items())
+                    # Owner pair in the memo KEY (when enabled):
+                    # same-labeled pods of different controllers must not
+                    # share a label row — SelectorSpread counts by owner.
+                    opair = (F.owner_spread_pair(pod.metadata)
+                             if self._owner_pairs else 0)
+                    lsig = tuple(pod.metadata.labels.items())
+                    sig = (opair, lsig)
                     row = row_memo.get(sig)
                     if row is None:
                         row = np.zeros(max_labels, dtype=np.int32)
-                        for j, (lk, lv) in enumerate(sig[:max_labels]):
+                        for j, (lk, lv) in enumerate(lsig[:max_labels]):
                             row[j] = F.pair_hash(lk, lv)
+                        if opair and len(lsig) < max_labels:
+                            row[len(lsig)] = opair
                         row_memo[sig] = row
-                    if len(sig) > max_labels:
+                    if len(lsig) > max_labels:
                         self.overflow.append(
-                            f"assigned pod {pod.key} labels: {len(sig)} > "
-                            f"{max_labels} slots")
+                            f"assigned pod {pod.key} labels: "
+                            f"{len(lsig)} > {max_labels} slots")
+                    if opair and len(lsig) >= max_labels:
+                        # same diagnostic as the per-pod path: the owner
+                        # pair found no free slot (independent of the
+                        # labels-overflow report above)
+                        self.overflow.append(
+                            f"assigned pod {pod.key}: no label slot left "
+                            "for the owner spread pair; SelectorSpread "
+                            "under-counts it")
                     self._assigned.label_pairs[a] = row
             self.version += 1
             return missed
@@ -582,6 +614,20 @@ class NodeFeatureCache:
                 f"{self.cfg.max_labels} slots")
         for j, (k, v) in enumerate(labels[:self.cfg.max_labels]):
             self._assigned.label_pairs[a, j] = F.pair_hash(k, v)
+        # Controller-owner pair (SelectorSpread, gated on the profile —
+        # enable_owner_pairs): rides the label row so owner-population
+        # counting reuses the selector-group match machinery unchanged.
+        # Superset labels never break other groups' matching (a group
+        # matches when ITS pairs are all present).
+        opair = (F.owner_spread_pair(pod.metadata)
+                 if self._owner_pairs else 0)
+        if opair:
+            if len(labels) < self.cfg.max_labels:
+                self._assigned.label_pairs[a, len(labels)] = opair
+            else:
+                self.overflow.append(
+                    f"assigned pod {pod.key}: no label slot left for the "
+                    "owner spread pair; SelectorSpread under-counts it")
         return True
 
     def account_unbind(self, pod_key: str) -> None:

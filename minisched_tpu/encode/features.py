@@ -118,6 +118,32 @@ def pair_hash(key: str, value: str) -> int:
     return _h(f"{key}={value}")
 
 
+# Synthetic pair hash carrying a pod's CONTROLLER owner identity
+# (SelectorSpread): bind accounting appends it to the assigned corpus's
+# label rows, and encode_pods (selector_spread=True) registers owner
+# selector groups over the same pair — so owner-population counting
+# rides the existing selector-group match/count machinery
+# (ops/topology.py) unchanged. The hash input is NUL-separated, which no
+# real label pair can produce through pair_hash's "key=value" form
+# (labels cannot contain NUL), so a user label can never forge an owner
+# pair at the string level — residual 32-bit hash collisions remain, the
+# same class every hashed-pair match in the encoder accepts.
+_OWNER_SPREAD_TAG = "minisched.io/owner\x00"
+
+# Zone topology key for SelectorSpread's zone-weighted term (the same
+# well-known key VolumeZone / the engine use).
+SELECTOR_SPREAD_ZONE_KEY = "topology.kubernetes.io/zone"
+
+
+def owner_spread_pair(meta) -> int:
+    """Hashed synthetic pair for the pod's controller owner identity, or
+    0 when the pod has no controller ownerReference."""
+    owner = obj.controller_owner(meta)
+    if owner is None:
+        return 0
+    return _h(f"{_OWNER_SPREAD_TAG}{owner.kind}/{owner.name}")
+
+
 def key_hash(key: str) -> int:
     return _h(key)
 
@@ -271,6 +297,14 @@ class PodFeatures(NamedTuple):
     spread_group: np.ndarray     # (P,C) i32 group index, -1 = unused slot
     spread_max_skew: np.ndarray  # (P,C) i32
     spread_mode: np.ndarray      # (P,C) i32 SPREAD_* code
+    # SelectorSpread owner groups (encoded only when the profile enables
+    # the plugin — encode_pods(selector_spread=True)): selector groups
+    # over the pod's controller-owner pair (owner_spread_pair), slot 0
+    # under kubernetes.io/hostname, slot 1 under the zone key. -1 = no
+    # controller owner / zone key unavailable. Score-only (upstream
+    # SelectorSpread has no filter point), so these groups never enter
+    # the hard-spread arbitration.
+    selspread_group: np.ndarray  # (P,2) i32
     aff_req_group: np.ndarray    # (P,T) i32 required pod-affinity terms
     aff_req_self: np.ndarray     # (P,T) bool — the pod itself matches the
     #   term's selector+namespace (upstream: a required affinity term with
@@ -548,6 +582,21 @@ class GroupBuilder:
             self._by_obj[obj_key] = (gid, self.last_weakened)
         return gid
 
+    def group_of_pairs(self, key_idx: int, ns_hash: int,
+                       pairs: Tuple[int, ...]) -> int:
+        """Group id for an already-hashed selector-pair tuple (the
+        SelectorSpread owner pair) — same dedup space as group_of, so an
+        owner group and a label-selector group with identical signatures
+        correctly share one id."""
+        if key_idx < 0:
+            return -1
+        sig = (key_idx, ns_hash, tuple(pairs))
+        gid = self._groups.get(sig)
+        if gid is None:
+            gid = len(self._groups)
+            self._groups[sig] = gid
+        return gid
+
     def build(self, pad: Optional[int] = None) -> GroupFeatures:
         n = len(self._groups)
         target = pad if pad is not None else max(8, _next_pow2(n))
@@ -720,7 +769,7 @@ def _encode_pod_affinity_terms(i, terms, group_arr, weight_arr, builder,
     return hard_dropped
 
 
-def _make_pod_sig():
+def _make_pod_sig(owner_identity: bool = False):
     """Build a per-batch pod-signature function (see encode_pods): the
     signature covers every pod field the batch encoder reads, so two
     pods with equal signatures produce IDENTICAL feature rows and group
@@ -803,10 +852,15 @@ def _make_pod_sig():
             spec.required_node_name,
             # only the DERIVED rc_owned bit reaches the encoding — keying
             # on the full refs would fragment the prototype memo per
-            # ReplicaSet (100 RS × identical pods = 100 signatures)
-            any(r.controller and r.kind in ("ReplicationController",
-                                            "ReplicaSet")
-                for r in pod.metadata.owner_references)
+            # ReplicaSet (100 RS × identical pods = 100 signatures).
+            # With selector_spread the owner IDENTITY feeds group
+            # registration, so it must key the memo (owner_identity) —
+            # that fragmentation is then the plugin's real cost model
+            # (replicas of one controller still share a signature).
+            (owner_spread_pair(pod.metadata) if owner_identity else
+             any(r.controller and r.kind in ("ReplicationController",
+                                             "ReplicaSet")
+                 for r in pod.metadata.owner_references))
             if pod.metadata.owner_references else False,
             tuple(spec.node_selector.items()) if spec.node_selector else (),
             tuple((c.topology_key, c.max_skew, c.when_unsatisfiable,
@@ -825,7 +879,7 @@ _PROTO_COPY_FIELDS = (
     "requests", "priority", "ns_hash", "label_pairs", "na_group",
     "tol_pairs", "tol_keys", "tol_ops", "tol_effects", "ports", "images",
     "required_node", "rc_owned",
-    "spread_group", "spread_max_skew", "spread_mode",
+    "spread_group", "spread_max_skew", "spread_mode", "selspread_group",
     "aff_req_group", "aff_req_self", "aff_pref_group", "aff_pref_weight",
     "anti_req_group", "anti_pref_group", "anti_pref_weight",
     "anti_forbid_key", "anti_forbid_dom", "anti_forbid_row",
@@ -841,7 +895,8 @@ def encode_pods(pods: List[Pod], p_pad: int,
                 gang_bound_fn=None,
                 volume_info_fn=None,
                 anti_forbidden_fn=None,
-                hard_failed: Optional[Dict[int, List[Tuple[str, str]]]] = None):
+                hard_failed: Optional[Dict[int, List[Tuple[str, str]]]] = None,
+                selector_spread: bool = False):
     """Encode a batch of pending pods, padded to ``p_pad`` rows.
 
     Returns an EncodedBatch: pod features plus the batch's distinct
@@ -861,6 +916,11 @@ def encode_pods(pods: List[Pod], p_pad: int,
     DoNotSchedule spread) could not be represented in the encoding slots —
     the engine fails such pods closed instead of scheduling them against a
     silently weakened constraint.
+    ``selector_spread``: also register owner selector groups
+    (PodFeatures.selspread_group) for pods with a controller
+    ownerReference — gated on the profile actually running the
+    SelectorSpread plugin, because every distinct owner in the batch
+    grows the group axis (and with it the (G,N) topology tables).
     """
     if registry is None:
         registry = TopologyKeyRegistry(cfg)
@@ -901,6 +961,7 @@ def encode_pods(pods: List[Pod], p_pad: int,
         spread_group=np.full((P, C), -1, dtype=np.int32),
         spread_max_skew=np.ones((P, C), dtype=np.int32),
         spread_mode=np.zeros((P, C), dtype=np.int32),
+        selspread_group=np.full((P, 2), -1, dtype=np.int32),
         aff_req_group=np.full((P, T), -1, dtype=np.int32),
         aff_req_self=np.zeros((P, T), dtype=bool),
         aff_pref_group=np.full((P, T), -1, dtype=np.int32),
@@ -923,7 +984,7 @@ def encode_pods(pods: List[Pod], p_pad: int,
     # per-pod Python encode was ~40% of the engine's host time at 10k).
     proto_of: Dict[tuple, int] = {}
     proto_copies: Dict[int, List[int]] = {}
-    _pod_sig = _make_pod_sig()
+    _pod_sig = _make_pod_sig(owner_identity=selector_spread)
     for i, pod in enumerate(pods):
         if i >= P:
             raise ValueError(f"{len(pods)} pods > pad {P}")
@@ -982,6 +1043,19 @@ def encode_pods(pods: List[Pod], p_pad: int,
                 r.controller and r.kind in ("ReplicationController",
                                             "ReplicaSet")
                 for r in pod.metadata.owner_references)
+            if selector_spread:
+                opair = owner_spread_pair(pod.metadata)
+                if opair:
+                    ns_h0 = (_h(pod.metadata.namespace)
+                             if pod.metadata.namespace else 0)
+                    # hostname is registry slot 0 by construction; the
+                    # zone term only engages when the key registers
+                    f.selspread_group[i, 0] = builder.group_of_pairs(
+                        0, ns_h0, (opair,))
+                    f.selspread_group[i, 1] = builder.group_of_pairs(
+                        registry.index_of(SELECTOR_SPREAD_ZONE_KEY,
+                                          overflow),
+                        ns_h0, (opair,))
         if pod.spec.volumes:
             if volumes_ready_fn is not None:
                 f.volumes_ready[i] = bool(volumes_ready_fn(pod))

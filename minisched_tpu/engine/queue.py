@@ -204,6 +204,35 @@ class SchedulingQueue:
             qpi.last_failure_at = time.monotonic()
             self._push_backoff(qpi)
 
+    def requeue_failures(self, retryable: List[QueuedPodInfo],
+                         unsched: List[tuple]) -> None:
+        """Bulk failure requeue: one lock acquisition for a whole commit
+        flush — ``retryable`` qpis go to the backoff heap, ``unsched``
+        (qpi, plugins) pairs park in unschedulableQ (or backoff when a
+        move request fired mid-attempt, exactly like add_unschedulable).
+        The per-pod paths cost one lock round-trip per revocation; a
+        skew-constrained burst revokes thousands per cycle."""
+        now = time.monotonic()
+        with self._cond:
+            for qpi in retryable:
+                if not self._may_requeue(qpi):
+                    continue
+                qpi.attempts += 1
+                qpi.last_failure_at = now
+                self._push_backoff(qpi)
+            for qpi, plugins in unsched:
+                if not self._may_requeue(qpi):
+                    continue
+                qpi.attempts += 1
+                qpi.last_failure_at = now
+                qpi.unschedulable_plugins = set(plugins)
+                if qpi.popped_at_cycle < self._move_cycle:
+                    self._push_backoff(qpi)
+                    continue
+                qpi.where, qpi.gone = "unsched", False
+                self._index[qpi.key] = qpi
+                self._unschedulable[qpi.key] = qpi
+
     # ---- event-driven requeue ------------------------------------------
 
     def move_all_to_active_or_backoff(self, event: ClusterEvent) -> None:

@@ -50,6 +50,32 @@ _SPREAD_REVOKE_MSG = (
     "anti-affinity) within this batch; retrying against committed counts")
 
 
+class _InflightBatch:
+    """One batch moving through the prepare → resolve → commit phases of
+    the engine cycle (Scheduler._run_pipelined). Slots keep field drift
+    between the phases loud instead of silent."""
+
+    __slots__ = ("batch", "pods", "vol_memo", "fail_closed", "eb", "names",
+                 "row_incs", "nf", "af", "key", "sample_k", "decision",
+                 "packed_dev", "spread_dev", "failures", "n_assigned",
+                 "shapes", "seq", "t0", "t_encode", "t_dispatch",
+                 "t_fetch_start", "t_step", "t_resolved", "commit_t0",
+                 "commit_t1")
+
+    def __init__(self):
+        self.failures: List[tuple] = []  # (qpi, plugins, message, retryable)
+        self.seq = 0
+        self.n_assigned = 0
+        self.shapes = (0, 0, 0)
+        self.t0 = self.t_encode = self.t_dispatch = 0.0
+        self.t_fetch_start = 0.0
+        self.t_step = self.t_resolved = 0.0
+        self.commit_t0 = self.commit_t1 = 0.0
+        self.decision: Optional[Decision] = None
+        self.spread_dev = None
+        self.sample_k = None
+
+
 @jax.jit
 def _pack_decision(chosen, assigned, gang_rejected, feasible,
                    feasible_static, rejects):
@@ -547,10 +573,33 @@ class Scheduler:
                                  assignment=self.config.assignment))
         self._key = jax.random.PRNGKey(self.config.seed)
         self._step_counter = 0
+        self._batch_seq = 0  # prepare-order sequence (scheduling thread)
         self.waiting_pods: Dict[str, WaitingPod] = {}
         self._waiting_lock = threading.Lock()
         self._binder = ThreadPoolExecutor(
             max_workers=self.config.bind_workers, thread_name_prefix="binder")
+        # Commit worker for the pipelined cycle (_run_pipelined): batch
+        # k-1's failure flush runs here while the scheduling thread
+        # encodes batch k+1 and the device executes batch k. ONE worker —
+        # commits must apply in batch order — and the pipeline is bounded
+        # at one commit in flight (_await_commit).
+        self._committer = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="commit")
+        # Gather worker for the pipelined cycle: batch k+1's queue pop —
+        # including its full batch-formation window — runs here while
+        # the scheduling thread resolves/commits batch k. Popping on the
+        # scheduling thread would stall k's binds and failure verdicts
+        # for up to batch_window_s whenever arrivals trickle.
+        self._gatherer = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="gather")
+        # Deferred-failure sink: while the scheduling thread resolves a
+        # batch, _handle_failure APPENDS verdicts here instead of paying
+        # a store round-trip per pod; _commit_batch flushes them through
+        # the bulk machinery (store.fail_pods / queue.requeue_failures /
+        # failed_scheduling_many). Thread-gated: binder/permit threads
+        # always take the immediate path.
+        self._fail_sink: Optional[List[tuple]] = None
+        self._fail_sink_tid = 0
         # In-batch RWO arbitration only applies when the plugin enforcing
         # claim exclusivity is part of the profile.
         self._rwo_enabled = any(p.name == "VolumeRestrictions"
@@ -565,6 +614,16 @@ class Scheduler:
         # InterPodAffinity filter via encode.anti_forbid slots.
         self._anti_enabled = any(p.name == "InterPodAffinity"
                                  for p in plugin_set.plugins)
+        # SelectorSpread consumes owner-derived selector groups; encoding
+        # them is gated on the profile so batches never grow the group
+        # axis (and the (G,N) topology tables) for a plugin nobody runs.
+        # The shared assigned corpus must then carry owner pairs too —
+        # enabled here, BEFORE the informers sync (engines construct
+        # before any start()).
+        self._selspread_enabled = any(p.name == "SelectorSpread"
+                                      for p in plugin_set.plugins)
+        if self._selspread_enabled:
+            self.cache.enable_owner_pairs()
         # PostFilter preemption (upstream DefaultPreemption): enabled by
         # the marker plugin; terminally-unschedulable pods get a batched
         # victim-candidate search before parking.
@@ -603,12 +662,29 @@ class Scheduler:
         # observability, SURVEY §5): cumulative sums + last-batch values,
         # guarded by a dedicated lock (read from any thread).
         self._metrics_lock = threading.Lock()
+        # Pipelined-mode metric bookkeeping (guarded by _metrics_lock):
+        # commits can complete out of batch order (a no-failure batch
+        # folds inline while the previous batch's worker flush is still
+        # running), so last_* fields only accept the highest batch
+        # sequence seen; the prepare window lets the commit side compute
+        # the encode-vs-flush overlap regardless of which commit path
+        # the NEXT batch takes.
+        self._last_committed_seq = -1
+        self._prep_window: tuple = (0.0, 0.0)
         self._metrics: Dict[str, float] = {
             "batches": 0, "pods_seen": 0, "pods_assigned": 0,
             "pods_failed": 0, "pods_bound": 0, "bind_conflicts": 0,
             "encode_s_total": 0.0, "step_s_total": 0.0,
             "step_dispatch_s_total": 0.0, "commit_s_total": 0.0,
             "gap_s_total": 0.0,
+            # Pipelined-cycle overlap accounting (_run_pipelined): host
+            # work that ran CONCURRENTLY with other pipeline stages —
+            # commit_overlap_s = commit-flush time hidden behind the next
+            # batch's device step / host stages; encode_overlap_s = the
+            # slice of encode+dispatch that ran while the previous
+            # batch's commit was still flushing. Both stay 0 in
+            # synchronous mode (MINISCHED_PIPELINE=0).
+            "encode_overlap_s": 0.0, "commit_overlap_s": 0.0,
             "last_batch_size": 0, "last_encode_s": 0.0,
             "last_step_s": 0.0, "last_commit_s": 0.0,
         }
@@ -641,6 +717,13 @@ class Scheduler:
         if self._owns_shared:
             self._shared.shutdown()
         self._binder.shutdown(wait=False)
+        # Wait for the last commit flush: shutdown must leave failure
+        # statuses/queue state fully applied (tests and checkpoints read
+        # them right after). The run thread exited above, so no new
+        # submissions can race this. The gatherer needs no wait — the
+        # closed queue unblocks its pop immediately.
+        self._committer.shutdown(wait=True)
+        self._gatherer.shutdown(wait=False)
         if self.recorder is not None:
             # Budget past one flush's full retry backoff (~6 s at defaults)
             # so a mid-retry flush isn't abandoned silently.
@@ -659,7 +742,12 @@ class Scheduler:
     def run(self) -> None:
         """The scheduling loop (reference minisched.go:28-30
         wait.UntilWithContext(ctx, scheduleOne, 0)) — here each iteration
-        schedules a whole batch."""
+        schedules a whole batch. With ``config.pipeline`` (the default)
+        the loop is the bounded two-deep pipeline of _run_pipelined;
+        MINISCHED_PIPELINE=0 keeps the strictly synchronous cycle."""
+        if self.config.pipeline:
+            self._run_pipelined()
+            return
         last_done = None
         while not self._stop.is_set():
             batch = self.queue.pop_batch(
@@ -686,6 +774,204 @@ class Scheduler:
                     self.queue.requeue_backoff(qpi)
             last_done = time.perf_counter()
 
+    def _run_pipelined(self) -> None:
+        """Bounded two-deep pipelined scheduling loop.
+
+        While batch k's jitted step executes on device (JAX async
+        dispatch — nothing blocks on results until the resolve fetch),
+        the host (a) flushes batch k-1's commit work on the dedicated
+        commit worker and (b) gathers batch k+1 from the queue. Batch
+        k+1 is ENCODED only after batch k's arbitration + assume
+        accounting (_resolve_batch) — the batch-internal causality rule:
+        encode sees cache state that already includes k's *assumed*
+        placements (it waits on k's arbitration, not on its store
+        commit), so decisions are bit-identical to the synchronous loop
+        (tests/test_pipeline_engine.py). In-flight work is bounded: one
+        dispatched step + one commit flush, never more.
+
+        Stage timeline for batch k (sched = scheduling thread):
+
+            sched:  ...| pop k+1 | resolve k | commit k-1 wait | enc k+1 |
+            device:    [........ step k ..........]   [...... step k+1 ...
+            commit:    [... flush k-1 (worker) ...]        [... flush k ...
+        """
+        inflight = None            # prepared + dispatched, not resolved
+        pending = None             # (future, inflight) commit in flight
+        gather_fut = None          # in-flight pop on the gather worker
+        last_done = None
+
+        def pop():
+            return self.queue.pop_batch(
+                self.config.max_batch_size, timeout=0.2,
+                gather_window=self.config.batch_window_s,
+                gather_idle=self.config.batch_idle_s)
+
+        try:
+            while not self._stop.is_set():
+                if inflight is None:
+                    if gather_fut is not None:
+                        # plain result(): the last_done gap booking below
+                        # already covers this wait (using _take_gather
+                        # here would double-count it)
+                        batch, gather_fut = gather_fut.result(), None
+                    else:
+                        batch = pop()
+                    if not batch:
+                        last_done = None
+                        pending = self._await_commit(pending)
+                        continue
+                    if last_done is not None:
+                        with self._metrics_lock:
+                            self._metrics["gap_s_total"] += (
+                                time.perf_counter() - last_done)
+                    inflight, pending = self._prepare_or_trace(batch,
+                                                               pending)
+                    continue
+                # Device is executing `inflight`: start batch k+1's pop
+                # — with its FULL batch-formation window — on the gather
+                # worker, so it overlaps the device step AND this
+                # batch's resolve/commit. Popping here on the scheduling
+                # thread would delay k's binds and failure verdicts by
+                # up to batch_window_s whenever arrivals trickle.
+                if gather_fut is None and not self._stop.is_set():
+                    try:
+                        gather_fut = self._gatherer.submit(pop)
+                    except RuntimeError:  # executor torn down (shutdown)
+                        gather_fut = None
+                if self._resolve_guarded(inflight):
+                    if inflight.failures:
+                        pending = self._await_commit(pending)
+                        pending = self._submit_commit(inflight)
+                    else:
+                        # Nothing to flush — the commit is just a metrics
+                        # fold. Run it inline: two thread handoffs per
+                        # batch cost more than the fold itself, and with
+                        # no queue/store side effects the ordering
+                        # against an in-flight worker commit is
+                        # immaterial.
+                        self._commit_guarded(inflight)
+                last_done = time.perf_counter()
+                # Consume the overlapped pop; this blocks only when the
+                # loop genuinely has to wait for work — the same point
+                # the synchronous loop blocks in its own pop, and the
+                # wait is booked to gap_s like the sync loop's pop wait
+                # (per-stage numbers must stay comparable across modes).
+                nxt = []
+                if gather_fut is not None and not self._stop.is_set():
+                    nxt, gather_fut = self._take_gather(gather_fut)
+                    nxt = nxt or []
+                if nxt:
+                    inflight, pending = self._prepare_or_trace(nxt, pending)
+                else:
+                    inflight = None
+        finally:
+            # Drain: a dispatched batch is completed (sync semantics —
+            # the synchronous loop also finishes its in-flight batch
+            # before honoring stop), then the last commit is awaited. A
+            # gather that raced the stop and popped pods must not lose
+            # them: requeue (a no-op once the queue is closed; a restart
+            # re-lists pending pods from the store either way).
+            if inflight is not None:
+                if self._resolve_guarded(inflight):
+                    if inflight.failures:
+                        pending = self._await_commit(pending)
+                        pending = self._submit_commit(inflight)
+                    else:
+                        self._commit_guarded(inflight)
+            if gather_fut is not None:
+                for qpi in gather_fut.result():
+                    self.queue.requeue_backoff(qpi)
+            self._await_commit(pending)
+
+    def _take_gather(self, gather_fut):
+        """Consume an overlapped pop, booking the BLOCKING portion of a
+        PRODUCTIVE wait into gap_s_total — the synchronous loop's
+        between-batch pop waits land there too, so the metric stays
+        comparable across modes. An empty result is genuine idle (sync
+        resets its gap clock for those) and books nothing."""
+        t0 = time.perf_counter()
+        batch = gather_fut.result()
+        waited = time.perf_counter() - t0
+        if batch and waited > 0.0:
+            with self._metrics_lock:
+                self._metrics["gap_s_total"] += waited
+        return batch, None
+
+    def _prepare_or_trace(self, batch, pending):
+        """Prepare (encode + dispatch) a popped batch, or — when a
+        profiler trace is armed — drain the pipeline and run the whole
+        cycle synchronously under the trace scope. Returns
+        (inflight | None, pending)."""
+        with self._trace_lock:
+            trace_armed = self._trace_dir is not None
+        if trace_armed or "schedule_batch" in self.__dict__:
+            # A trace request needs the whole cycle inside one profiler
+            # scope; an instance-patched schedule_batch (test
+            # instrumentation wraps cycles that way) must keep seeing
+            # whole cycles. Both drain the pipeline and run this batch
+            # synchronously.
+            pending = self._await_commit(pending)
+            try:
+                self.schedule_batch(batch)
+            except Exception:
+                log.exception("schedule_batch failed; requeueing batch")
+                for qpi in batch:
+                    self.queue.requeue_backoff(qpi)
+            return None, pending
+        try:
+            return self._prepare_batch(batch), pending
+        except Exception:
+            log.exception("batch prepare failed; requeueing batch")
+            for qpi in batch:
+                self.queue.requeue_backoff(qpi)
+            return None, pending
+
+    def _resolve_guarded(self, inflight) -> bool:
+        """_resolve_batch with the synchronous loop's failure contract:
+        an exception requeues the whole batch and skips the commit."""
+        try:
+            self._resolve_batch(inflight)
+            return True
+        except Exception:
+            log.exception("batch resolve failed; requeueing batch")
+            for qpi in inflight.batch:
+                self.queue.requeue_backoff(qpi)
+            return False
+
+    def _submit_commit(self, inflight):
+        """Hand a resolved batch to the commit worker; inline fallback
+        when the executor is already torn down (shutdown race)."""
+        try:
+            return self._committer.submit(self._commit_guarded, inflight), \
+                inflight
+        except RuntimeError:
+            self._commit_guarded(inflight)
+            return None
+
+    def _commit_guarded(self, inflight) -> None:
+        try:
+            self._commit_batch(inflight)
+        except Exception:
+            log.exception("batch commit flush failed")
+
+    def _await_commit(self, pending):
+        """Bound the pipeline at ONE commit in flight and account
+        commit_overlap_s — the flush time the scheduling thread did NOT
+        have to wait for (it ran behind the device step / host stages).
+        encode_overlap_s is booked by _commit_batch itself, which knows
+        the flush window regardless of which commit path the next batch
+        takes."""
+        if pending is None:
+            return None
+        fut, done = pending
+        t0 = time.perf_counter()
+        fut.result()  # _commit_guarded never raises
+        waited = time.perf_counter() - t0
+        flush = max(0.0, done.commit_t1 - done.commit_t0)
+        with self._metrics_lock:
+            self._metrics["commit_overlap_s"] += max(0.0, flush - waited)
+        return None
+
     # ---- one batched scheduling cycle ----------------------------------
 
     def trace_next_batch(self, trace_dir: str) -> None:
@@ -706,6 +992,22 @@ class Scheduler:
         return self._schedule_batch_impl(batch)
 
     def _schedule_batch_impl(self, batch: List[QueuedPodInfo]) -> Decision:
+        """One synchronous cycle: the three pipeline phases back-to-back
+        on the calling thread. The pipelined run loop calls the phases
+        directly so they interleave across batches; results are
+        identical either way (the phase cut points are the batch-internal
+        causality boundaries)."""
+        inf = self._prepare_batch(batch)
+        self._resolve_batch(inf)
+        self._commit_batch(inf)
+        return inf.decision
+
+    def _prepare_batch(self, batch: List[QueuedPodInfo]) -> "_InflightBatch":
+        """PREPARE: gang pull → encode → snapshot → async step dispatch.
+        Returns with the device executing the batch (JAX async dispatch;
+        nothing here blocks on device results), so the pipelined loop can
+        overlap the previous batch's commit and the next pop with it."""
+        inf = _InflightBatch()
         cfg = self.config
         # Pull queued gang-mates so no batch boundary splits a gang (the
         # step would reject the partial group for missing quorum). This may
@@ -737,6 +1039,11 @@ class Scheduler:
             return st
 
         t0 = time.perf_counter()
+        with self._metrics_lock:
+            # prepare STARTED; end published when dispatch returns (None
+            # end = still encoding — the commit worker's encode-overlap
+            # booking clips such a window at its own flush end)
+            self._prep_window = (t0, None)
         # Fail closed on unrepresentable hard constraints: a pod whose
         # required anti-affinity/affinity term or DoNotSchedule spread
         # constraint cannot fit the encoding slots (or whose forbidden
@@ -776,7 +1083,8 @@ class Scheduler:
                          gang_bound_fn=self.cache.gang_bound_count,
                          volume_info_fn=lambda p: vol_state(p)[1:],
                          anti_forbidden_fn=anti_fn,
-                         hard_failed=encode_hard)
+                         hard_failed=encode_hard,
+                         selector_spread=self._selspread_enabled)
         # Only fail closed for constraints this profile's plugin set
         # actually ENFORCES: a profile without InterPodAffinity ignores
         # affinity terms entirely (encode always records them; only the
@@ -860,11 +1168,53 @@ class Scheduler:
                                    decision.spread_min, decision.scan_groups)
                       if needs_arb else None)
         # Dispatch returns before the device finishes (jax async); the
-        # first np.asarray below blocks. Splitting the two reveals whether
-        # step time is host→device feeding or device compute.
-        t_dispatch = time.perf_counter()
+        # first np.asarray in _resolve_batch blocks. Splitting the two
+        # reveals whether step time is host→device feeding or device
+        # compute — and is what the pipelined loop overlaps against.
+        inf.batch, inf.pods = batch, pods
+        inf.vol_memo, inf.fail_closed = vol_memo, fail_closed
+        inf.eb, inf.names, inf.row_incs = eb, names, row_incs
+        inf.nf, inf.af, inf.key, inf.sample_k = nf, af, key, sample_k
+        inf.decision = decision
+        inf.packed_dev, inf.spread_dev = packed_dev, spread_dev
+        inf.t0, inf.t_encode = t0, t_encode
+        inf.t_dispatch = time.perf_counter()
+        self._batch_seq += 1
+        inf.seq = self._batch_seq
+        with self._metrics_lock:
+            # published for the commit worker's encode-overlap booking
+            self._prep_window = (t0, inf.t_dispatch)
+        return inf
 
-        packed = np.array(packed_dev)  # writable: residual merge below
+    def _resolve_batch(self, inf: "_InflightBatch") -> None:
+        """RESOLVE: block on the device fetch, then run every host stage
+        the NEXT batch's encode depends on — residual pass, RWO/spread
+        arbitration, assume accounting, in-cycle repair, preemption —
+        and submit the bulk bind. Failure verdicts are DECIDED here (they
+        feed gang atomicity and the arbitration dead sets) but their side
+        effects — store status writes, queue requeues, events — are
+        deferred into ``inf.failures`` for _commit_batch, which the
+        pipelined loop overlaps with the next batch's device step."""
+        self._fail_sink = inf.failures
+        self._fail_sink_tid = threading.get_ident()
+        try:
+            self._resolve_batch_impl(inf)
+        finally:
+            self._fail_sink = None
+        inf.t_resolved = time.perf_counter()
+
+    def _resolve_batch_impl(self, inf: "_InflightBatch") -> None:
+        batch, pods, eb, names = inf.batch, inf.pods, inf.eb, inf.names
+        decision, row_incs = inf.decision, inf.row_incs
+        nf, af, key, sample_k = inf.nf, inf.af, inf.key, inf.sample_k
+        vol_memo, fail_closed = inf.vol_memo, inf.fail_closed
+        spread_dev = inf.spread_dev
+
+        # In pipelined mode the next batch's queue gather sits between
+        # dispatch and this fetch; stamping the fetch start keeps that
+        # host-side gap out of the step metric (it books as gap time).
+        inf.t_fetch_start = time.perf_counter()
+        packed = np.array(inf.packed_dev)  # writable: residual merge below
         chosen = packed[0]
         assigned = packed[1].astype(bool)
         gang_rejected = packed[2].astype(bool)
@@ -1210,33 +1560,128 @@ class Scheduler:
             # binding goroutine (minisched.go:96-112).
             self._binder.submit(self._bind_many, to_bind)
 
-        t_commit = time.perf_counter()
-        n_assigned = (int(assigned[:len(batch)].sum())
-                      - sum(1 for i in revoked if assigned[i])
-                      - n_ghost + n_repaired)
+        inf.t_step = t_step
+        inf.n_assigned = (int(assigned[:len(batch)].sum())
+                          - sum(1 for i in revoked if assigned[i])
+                          - n_ghost + n_repaired)
+        # Padded step shapes (P, N, A) — the pad-efficiency audit trail
+        # for the eighth-step buckets (encode/cache.step_bucket)
+        inf.shapes = (int(eb.pf.valid.shape[0]),
+                      int(nf.valid.shape[0]),
+                      int(af.valid.shape[0]))
+
+    def _commit_batch(self, inf: "_InflightBatch") -> None:
+        """COMMIT: flush the deferred failure verdicts through the bulk
+        machinery (one store transaction, one queue lock hold, one event
+        payload for the whole tranche) and fold the cycle's metrics. Runs
+        on the commit worker in pipelined mode — everything here is
+        thread-safe against the scheduling thread's next prepare/resolve
+        and against the binder pool."""
+        inf.commit_t0 = time.perf_counter()
+        if inf.failures:
+            try:
+                self._flush_failures(inf.failures)
+            except Exception:
+                # A flush error (transient wire failure on a RemoteStore,
+                # store teardown race) must not strand the tranche: the
+                # pods are popped, so nothing else will ever requeue
+                # them. Fall back to the synchronous loop's contract —
+                # backoff-requeue every failed pod; status/events land on
+                # the retry.
+                log.exception("bulk failure flush failed; requeueing the "
+                              "tranche with backoff")
+                for qpi, _plugins, _msg, _retry in inf.failures:
+                    self.queue.requeue_backoff(qpi)
+        t_flush = time.perf_counter()
+        inf.commit_t1 = t_flush
+        batch, t_step = inf.batch, inf.t_step
+        # commit_s keeps its historical meaning — everything after the
+        # step fetch: arbitration + assume + repair + preemption (the
+        # resolve tail) plus this flush.
+        commit_s = (inf.t_resolved - t_step) + (t_flush - inf.commit_t0)
+        # step_s keeps its sync-mode meaning — dispatch + device + fetch
+        # only. In pipelined mode the next batch's queue gather runs
+        # between dispatch and the fetch; that slice is inter-stage gap,
+        # not device time (booking it as step_s would corrupt the
+        # sync-vs-pipelined per-stage comparison).
+        gather_gap = max(0.0, inf.t_fetch_start - inf.t_dispatch)
+        step_s = (t_step - inf.t_encode) - gather_gap
         with self._metrics_lock:
             m = self._metrics
             m["batches"] += 1
             m["pods_seen"] += len(batch)
-            m["pods_assigned"] += n_assigned
-            m["pods_failed"] += len(batch) - n_assigned
-            m["encode_s_total"] += t_encode - t0
-            m["step_s_total"] += t_step - t_encode
-            m["step_dispatch_s_total"] += t_dispatch - t_encode
-            m["commit_s_total"] += t_commit - t_step
-            m["last_batch_size"] = len(batch)
-            sizes = m.setdefault("batch_sizes", [])
-            if len(sizes) < 16:  # bounded diagnostic trail
-                sizes.append(len(batch))
-            m["last_encode_s"] = t_encode - t0
-            m["last_step_s"] = t_step - t_encode
-            m["last_commit_s"] = t_commit - t_step
-            # Padded step shapes (P, N, A) — the pad-efficiency audit
-            # trail for the eighth-step buckets (encode/cache.step_bucket)
-            m["last_shapes"] = (int(eb.pf.valid.shape[0]),
-                                int(nf.valid.shape[0]),
-                                int(af.valid.shape[0]))
-        return decision
+            m["pods_assigned"] += inf.n_assigned
+            m["pods_failed"] += len(batch) - inf.n_assigned
+            m["encode_s_total"] += inf.t_encode - inf.t0
+            m["step_s_total"] += step_s
+            m["step_dispatch_s_total"] += inf.t_dispatch - inf.t_encode
+            m["gap_s_total"] += gather_gap
+            m["commit_s_total"] += commit_s
+            if inf.failures:
+                # Encode-vs-flush overlap, booked HERE where the flush
+                # window is known: the NEXT batch's prepare may take
+                # either commit path, so _await_commit cannot see every
+                # overlap. A still-encoding prepare (end None) is
+                # clipped at this flush's end.
+                w0, w1 = self._prep_window
+                if w1 is None:
+                    w1 = t_flush
+                m["encode_overlap_s"] += max(
+                    0.0, min(t_flush, w1) - max(inf.commit_t0, w0))
+            if inf.seq > self._last_committed_seq:
+                # Commits may finish out of batch order (inline
+                # no-failure commits vs worker flushes); only the newest
+                # batch writes the last_* diagnostics.
+                self._last_committed_seq = inf.seq
+                m["last_batch_size"] = len(batch)
+                sizes = m.setdefault("batch_sizes", [])
+                if len(sizes) < 16:  # bounded diagnostic trail
+                    sizes.append(len(batch))
+                m["last_encode_s"] = inf.t_encode - inf.t0
+                m["last_step_s"] = step_s
+                m["last_commit_s"] = commit_s
+                m["last_shapes"] = inf.shapes
+
+    def _flush_failures(self, items: List[tuple]) -> None:
+        """Apply a cycle's deferred failure verdicts in bulk — the
+        vectorized twin of _handle_failure's per-pod body: one
+        FailedScheduling event payload, one store transaction for the
+        status writes (per-pod get/update fallback when the store lacks
+        the bulk verb — RemoteStore), one queue lock hold for the
+        requeues. Pods deleted mid-flight are forgotten, exactly like
+        the per-pod NotFound path."""
+        self.broadcaster.failed_scheduling_many(
+            [(qpi.pod.key, qpi.pod.metadata.namespace, msg)
+             for qpi, _plugins, msg, _retry in items])
+        fail_bulk = getattr(self.store, "fail_pods", None)
+        missing: Set[str] = set()
+        if fail_bulk is not None:
+            missing = set(fail_bulk(
+                [(qpi.pod.key, plugins, msg)
+                 for qpi, plugins, msg, _retry in items]))
+        else:
+            for qpi, plugins, msg, _retry in items:
+                try:
+                    fresh = self.store.get("Pod", qpi.pod.key)
+                    if not fresh.spec.node_name:
+                        fresh.status.unschedulable_plugins = sorted(plugins)
+                        fresh.status.message = msg
+                        self.store.update(fresh)
+                        qpi.pod = fresh
+                except NotFoundError:
+                    missing.add(qpi.pod.key)
+        retryable: List[QueuedPodInfo] = []
+        unsched: List[tuple] = []
+        for qpi, plugins, _msg, retry in items:
+            if qpi.pod.key in missing:
+                self.queue.forget(qpi.pod.key)
+                self.drop_nomination(qpi.pod.key)
+            elif retry:
+                retryable.append(qpi)
+            else:
+                unsched.append((qpi, plugins))
+        if retryable or unsched:
+            self.queue.requeue_failures(retryable, unsched)
 
     # ---- multi-chip step (SchedulerConfig.mesh) --------------------------
 
@@ -2288,6 +2733,15 @@ class Scheduler:
 
     def _handle_failure(self, qpi: QueuedPodInfo, plugins: Set[str],
                         message: str, *, retryable: bool) -> None:
+        # Resolve-phase verdicts defer into the cycle's failure sink and
+        # flush in bulk at commit (_flush_failures) — a skew-constrained
+        # burst otherwise pays two store round-trips per revocation on
+        # the scheduling thread. Thread-gated: binder/permit threads (no
+        # sink of their own) keep the immediate path.
+        sink = self._fail_sink
+        if sink is not None and threading.get_ident() == self._fail_sink_tid:
+            sink.append((qpi, set(plugins), message, retryable))
+            return
         pod = qpi.pod
         self.broadcaster.failed_scheduling(pod, message)
         try:

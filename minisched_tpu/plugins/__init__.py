@@ -17,5 +17,6 @@ from .volumerestrictions import VolumeRestrictions  # noqa: F401
 from .volumezone import VolumeZone  # noqa: F401
 from .nodevolumelimits import NodeVolumeLimits  # noqa: F401
 from .podtopologyspread import PodTopologySpread  # noqa: F401
+from .selectorspread import SelectorSpread  # noqa: F401
 from .interpodaffinity import InterPodAffinity  # noqa: F401
 from .preemption import DefaultPreemption  # noqa: F401

@@ -95,3 +95,15 @@ class GCEPDLimits(CloudVolumeLimits):
 class AzureDiskLimits(CloudVolumeLimits):
     name = "AzureDiskLimits"
     axis_name = "attachable-volumes-azure-disk"
+
+
+class CinderLimits(CloudVolumeLimits):
+    """OpenStack Cinder attach limits — the last per-cloud variant the
+    reference registry wraps (scheduler/plugin/plugins.go:24-70; upstream
+    registers it but, like the other in-tree cloud filters, it only
+    gates clusters whose pods carry cinder-typed volumes). Default
+    ceiling is upstream's DefaultMaxCinderVolumes=256
+    (objects.DEFAULT_CLOUD_VOLUME_LIMITS)."""
+
+    name = "CinderLimits"
+    axis_name = "attachable-volumes-cinder"

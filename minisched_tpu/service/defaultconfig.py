@@ -60,9 +60,11 @@ from ..plugins.nodeaffinity import NodeAffinity  # noqa: E402
 from ..plugins.nodename import NodeName  # noqa: E402
 from ..plugins.nodeports import NodePorts  # noqa: E402
 from ..plugins.nodepreferavoidpods import NodePreferAvoidPods  # noqa: E402
-from ..plugins.nodevolumelimits import (AzureDiskLimits, EBSLimits,  # noqa: E402
-                                        GCEPDLimits, NodeVolumeLimits)
+from ..plugins.nodevolumelimits import (AzureDiskLimits, CinderLimits,  # noqa: E402
+                                        EBSLimits, GCEPDLimits,
+                                        NodeVolumeLimits)
 from ..plugins.podtopologyspread import PodTopologySpread  # noqa: E402
+from ..plugins.selectorspread import SelectorSpread  # noqa: E402
 from ..plugins.preemption import DefaultPreemption  # noqa: E402
 from ..plugins.tainttoleration import TaintToleration  # noqa: E402
 from ..plugins.volumebinding import VolumeBinding  # noqa: E402
@@ -81,6 +83,14 @@ register_plugin("NodeVolumeLimits", NodeVolumeLimits)
 register_plugin("EBSLimits", EBSLimits)
 register_plugin("GCEPDLimits", GCEPDLimits)
 register_plugin("AzureDiskLimits", AzureDiskLimits)
+# Registry parity with the reference's full wrap of the upstream 1.22
+# in-tree set (scheduler/plugin/plugins.go:24-70): CinderLimits and
+# SelectorSpread are REGISTERED but — matching upstream defaults, where
+# Cinder gates only cinder-typed volumes and SelectorSpread was
+# superseded by PodTopologySpread's default constraints — not enabled in
+# the default profile lists below; profiles opt in by name.
+register_plugin("CinderLimits", CinderLimits)
+register_plugin("SelectorSpread", SelectorSpread)
 register_plugin("NodePreferAvoidPods", NodePreferAvoidPods)
 register_plugin("PodTopologySpread", PodTopologySpread)
 register_plugin("InterPodAffinity", InterPodAffinity)

@@ -142,6 +142,25 @@ class EventBroadcaster:
             logging.getLogger(__name__).warning(
                 "dropped %d Scheduled events (queue full)", len(payload))
 
+    # Marker for a bulk-FailedScheduling payload (the revocation twin of
+    # _SCHED_BATCH): one queue item per failure flush, expanded on the
+    # sink thread — a skew burst fails thousands of pods per cycle.
+    _FAIL_BATCH = object()
+
+    def failed_scheduling_many(self, payload) -> None:
+        """Bulk ``failed_scheduling``: (pod_key, namespace, message)
+        triples, one queue item for the whole flush."""
+        if self._closed or not payload:
+            return
+        try:
+            self._q.put_nowait((self._FAIL_BATCH, payload))
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "dropped %d FailedScheduling events (queue full)",
+                len(payload))
+
     def _sink_loop(self) -> None:
         import logging
         import queue as _queue
@@ -177,6 +196,10 @@ class EventBroadcaster:
                         (f"Pod:{k}", "Scheduled",
                          f"Successfully assigned {k} to {n}", "Normal", ns)
                         for k, ns, n in i[1])
+                elif i[0] is self._FAIL_BATCH:
+                    batch.extend(
+                        (f"Pod:{k}", "FailedScheduling", msg, "Warning", ns)
+                        for k, ns, msg in i[1])
                 else:
                     batch.append(i)
             try:

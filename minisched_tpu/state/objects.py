@@ -33,7 +33,8 @@ RESOURCES: Tuple[str, ...] = ("cpu", "memory", "pods", "ephemeral-storage",
                               "accelerator", "attachable-volumes",
                               "attachable-volumes-aws-ebs",
                               "attachable-volumes-gce-pd",
-                              "attachable-volumes-azure-disk")
+                              "attachable-volumes-azure-disk",
+                              "attachable-volumes-cinder")
 RESOURCE_INDEX: Dict[str, int] = {r: i for i, r in enumerate(RESOURCES)}
 
 # Nodes that don't declare allocatable["attachable-volumes"] get this
@@ -50,12 +51,28 @@ CLOUD_VOLUME_AXES: Dict[str, str] = {
     "aws-ebs": "attachable-volumes-aws-ebs",
     "gce-pd": "attachable-volumes-gce-pd",
     "azure-disk": "attachable-volumes-azure-disk",
+    "cinder": "attachable-volumes-cinder",
 }
 DEFAULT_CLOUD_VOLUME_LIMITS: Dict[str, float] = {
     "attachable-volumes-aws-ebs": 39.0,
     "attachable-volumes-gce-pd": 16.0,
     "attachable-volumes-azure-disk": 16.0,
+    # upstream nodevolumelimits DefaultMaxCinderVolumes (the OpenStack
+    # attach ceiling the CinderLimits plugin defaults to)
+    "attachable-volumes-cinder": 256.0,
 }
+
+
+def controller_owner(meta: "ObjectMeta") -> Optional["OwnerReference"]:
+    """The object's CONTROLLER ownerReference (kind+name identity), or
+    None. SelectorSpread's owner-based spreading scope: upstream lists
+    the services/RCs/RSs/StatefulSets selecting the pod; the rebuild
+    uses the controller owner identity — replicas of one controller
+    share it, which is exactly the population upstream spreads."""
+    for r in meta.owner_references:
+        if r.controller and r.kind and r.name:
+            return r
+    return None
 
 ResourceList = Dict[str, float]
 

@@ -323,6 +323,45 @@ class ClusterStore:
                 self._cond.notify_all()
         return bound
 
+    def fail_pods(self, verdicts) -> List[str]:
+        """Bulk FailedScheduling status commit — the failure-path twin of
+        ``bind_pods``: one lock acquisition for a whole batch of
+        (pod_key, unschedulable_plugins, message) triples. Pods that were
+        bound or deleted mid-flight are skipped (their status must not be
+        clobbered with a stale verdict); returns the keys that were NOT
+        found so the caller can drop them from its queues. Uses
+        shallow_evolve (stored objects are replacement-only) and one
+        watcher wake-up for the whole batch — a skew-constrained burst
+        revokes thousands of pods per cycle, and the per-pod
+        get+mutate+update path was two deep copies plus a condvar
+        broadcast per revocation."""
+        evolve = obj.shallow_evolve
+        missing: List[str] = []
+        with self._cond:
+            pods_map = self._objects["Pod"]
+            dirty = False
+            for pod_key, plugins, message in verdicts:
+                pod = pods_map.get(pod_key)
+                if pod is None:
+                    missing.append(pod_key)
+                    continue
+                if pod.spec.node_name:
+                    continue  # bound by a competing path; verdict is stale
+                self._rv += 1
+                new = evolve(
+                    pod,
+                    metadata=evolve(pod.metadata, resource_version=self._rv),
+                    status=evolve(pod.status,
+                                  unschedulable_plugins=sorted(plugins),
+                                  message=message))
+                pods_map[pod_key] = new
+                self._append(WatchEvent(EventType.MODIFIED, "Pod", new, pod,
+                                        self._rv), notify=False)
+                dirty = True
+            if dirty:
+                self._cond.notify_all()
+        return missing
+
     # ---- Watch ----------------------------------------------------------
 
     def watch(self, kinds: Optional[List[str]] = None,

@@ -434,3 +434,57 @@ def test_volume_capacity_respected_within_one_batch(cluster):
     assert len(bound) == 2 and len(parked) == 1, (
         f"bound={[p.metadata.name for p in bound]}, "
         f"parked={[p.metadata.name for p in parked]}")
+
+
+# ---- CinderLimits (the last per-cloud variant of the wrapped set) -------
+
+def _cinder_spec(*claims, cpu: float = 100.0):
+    return obj.PodSpec(requests={"cpu": cpu},
+                       volumes=[obj.VolumeClaim(claim_name=c,
+                                                volume_type="cinder")
+                                for c in claims])
+
+
+def test_cinder_requests_charge_the_cinder_axis():
+    p = obj.Pod(metadata=obj.ObjectMeta(name="cv"),
+                spec=_cinder_spec("c1", "c2"))
+    req = obj.pod_requests(p)
+    assert req["attachable-volumes-cinder"] == 2
+    # cinder-typed claims never consume generic attach slots
+    assert "attachable-volumes" not in req
+    # upstream DefaultMaxCinderVolumes ceiling is the axis default
+    assert obj.DEFAULT_CLOUD_VOLUME_LIMITS["attachable-volumes-cinder"] == 256.0
+    assert "attachable-volumes-cinder" in obj.RESOURCES
+
+
+def test_cinder_limits_filter_blocks_over_limit_node(cluster):
+    cluster.start(profile=Profile(plugins=["CinderLimits"]),
+                  config=fast_config(), with_pv_controller=False)
+    cluster.create_node("cin-node1")
+    n = cluster.get_node("cin-node1")
+    n.status.allocatable["attachable-volumes-cinder"] = 1.0
+    cluster.store.update(n)
+    cluster.create_pvc("cin-a", phase="Bound")
+    cluster.create_pvc("cin-b", phase="Bound")
+    cluster.create_pod("cin-p1", spec=_cinder_spec("cin-a"))
+    cluster.wait_for_pod_bound("cin-p1", timeout=30)
+    # Second cinder attachment exceeds the node's declared ceiling →
+    # parks under CinderLimits (requeue-gated on pod delete/node update).
+    cluster.create_pod("cin-p2", spec=_cinder_spec("cin-b"))
+    pending = cluster.wait_for_pod_pending("cin-p2", timeout=30)
+    assert "CinderLimits" in pending.status.unschedulable_plugins
+    cluster.delete_pod("cin-p1")
+    cluster.wait_for_pod_bound("cin-p2", timeout=10)
+
+
+def test_cinder_default_ceiling_admits_plain_pods(cluster):
+    """Nodes that don't declare the cinder axis get the 256-slot default:
+    an ordinary pod (and a modest cinder pod) pass the filter."""
+    cluster.start(profile=Profile(plugins=["CinderLimits"]),
+                  config=fast_config(), with_pv_controller=False)
+    cluster.create_node("cin-free")
+    cluster.create_pvc("cin-z", phase="Bound")
+    cluster.create_pod("plain", spec=obj.PodSpec(requests={"cpu": 50}))
+    cluster.create_pod("cin-typed", spec=_cinder_spec("cin-z"))
+    cluster.wait_for_pod_bound("plain", timeout=30)
+    cluster.wait_for_pod_bound("cin-typed", timeout=30)

@@ -1,0 +1,142 @@
+"""Pipelined-vs-synchronous engine comparison at CPU shapes.
+
+Runs the three engine phases the pipelined cycle targets — single-burst
+(headline), sustained streaming, and the skew-convergence worst case
+(hard DoNotSchedule max_skew=1, every placement gated by intra-batch
+arbitration — the phase whose commit term was the worst number on
+record, BENCH_TPU.json skew_stream_commit_s = 15.95 s) — through
+bench.engine_bench twice: MINISCHED_PIPELINE=0 (strictly synchronous
+cycle) and the pipelined default. Emits one JSON document with both
+runs plus the ratios; tools of record commit it as BENCH_PIPELINE.json.
+
+    JAX_PLATFORMS=cpu python tools/bench_pipeline.py [> BENCH_PIPELINE.json]
+
+MINISCHED_BENCH_NODES / MINISCHED_BENCH_PODS override the 2000 x 1000
+CPU shape (the same shape `make bench-cpu` uses).
+"""
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def fail_flush_phase(n: int, p: int) -> dict:
+    """Terminal-verdict flush cost: ``p`` pods that can never schedule,
+    measured from submission to every pod parked (status written, event
+    emitted, unschedulableQ entry). This is the commit-path term the
+    bulk failure machinery (store.fail_pods / requeue_failures /
+    failed_scheduling_many) vectorizes — the synchronous seed engine
+    paid two store round-trips plus a condvar broadcast per pod, the
+    dominant slice of the TPU artifact's 15.95 s skew-stream commit.
+    Four passes; the first eats the XLA compile and the MIN of the rest
+    is reported (the 1-core bench hosts are noisy; a single sample of a
+    sub-second phase is mostly scheduler jitter)."""
+    import time
+
+    from bench_workload import make_workload
+    from minisched_tpu.config import SchedulerConfig
+    from minisched_tpu.service.defaultconfig import Profile
+    from minisched_tpu.service.service import SchedulerService
+    from minisched_tpu.state.objects import ObjectMeta, Pod, PodSpec
+    from minisched_tpu.state.store import ClusterStore
+
+    samples = []
+    for attempt in ("warmup", "m1", "m2", "m3"):
+        store = ClusterStore()
+        make_nodes, _ = make_workload(n, 1)
+        store.create_many(make_nodes())
+        svc = SchedulerService(store)
+        cfg = SchedulerConfig(
+            max_batch_size=p, batch_window_s=5.0,
+            backoff_initial_s=30.0, backoff_max_s=30.0,
+            pipeline=os.environ.get("MINISCHED_PIPELINE", "1") != "0")
+        sched = svc.start_scheduler(
+            Profile(name="bench",
+                    plugins=["NodeUnschedulable", "NodeResourcesFit"],
+                    plugin_args={"NodeResourcesFit":
+                                 {"score_strategy": None}}), cfg)
+        pods = [Pod(metadata=ObjectMeta(name=f"fat-{i}", namespace="bench"),
+                    spec=PodSpec(requests={"cpu": 1e12}))
+                for i in range(p)]
+        t0 = time.perf_counter()
+        store.create_many(pods)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if sched.metrics()["pods_failed"] >= p:
+                break
+            time.sleep(0.005)
+        dt = time.perf_counter() - t0
+        m = sched.metrics()
+        parked = m["pods_failed"]
+        svc.shutdown_scheduler()
+        if attempt != "warmup":
+            samples.append((dt, m["commit_s_total"], parked))
+    best = min(samples)
+    return {"failflush_pods": int(best[2]),
+            "failflush_s": round(best[0], 4),
+            # the isolated park term: everything after the step fetch —
+            # status writes + events + queue parking (engine
+            # commit_s_total; the slice the bulk failure machinery
+            # vectorizes into one store transaction)
+            "failflush_commit_s": round(min(s[1] for s in samples), 4),
+            "failflush_pods_per_sec": round(best[2] / max(best[0], 1e-9),
+                                            1)}
+
+
+def run_phases(n: int, p: int) -> dict:
+    import bench
+    from bench_workload import (BENCH_PLUGINS, C4_PLUGINS, make_c4_workload,
+                                make_workload)
+
+    out = {}
+    mn, mp = make_workload(n, p)
+    out.update(bench.engine_bench(n, p, mn, mp, BENCH_PLUGINS,
+                                  lat_samples=5))
+    out.update(bench.engine_bench(n, p, mn, mp, BENCH_PLUGINS,
+                                  batch_size=max(64, p // 4),
+                                  prefix="stream", window_s=0.25))
+    skn, skp = make_c4_workload(n, p, max_skew=1, hard=True)
+    out.update(bench.engine_bench(n, p, skn, skp, C4_PLUGINS,
+                                  batch_size=max(64, p // 4),
+                                  prefix="skew_stream", window_s=0.25,
+                                  backoff_s=0.05))
+    out.update(fail_flush_phase(n, 2 * p))
+    return out
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    n = int(os.environ.get("MINISCHED_BENCH_NODES", "2000"))
+    p = int(os.environ.get("MINISCHED_BENCH_PODS", "1000"))
+    doc = {"nodes": n, "pods": p, "platform": "cpu",
+           "methodology": "time keys are min-of-2 full phase runs per "
+                          "mode (sub-second phases on a 1-core host are "
+                          "dominated by scheduler/GC jitter otherwise)",
+           "modes": {}}
+    for label, knob in (("sync", "0"), ("pipelined", "1")):
+        os.environ["MINISCHED_PIPELINE"] = knob
+        a, b = run_phases(n, p), run_phases(n, p)
+        merged = dict(a)
+        for k, v in b.items():
+            if (k.endswith("_s") and isinstance(v, (int, float))
+                    and isinstance(a.get(k), (int, float))):
+                merged[k] = min(a[k], v)
+        doc["modes"][label] = merged
+    sync, pipe = doc["modes"]["sync"], doc["modes"]["pipelined"]
+
+    def ratio(key):
+        a, b = sync.get(key), pipe.get(key)
+        return round(a / b, 2) if a and b else None
+
+    doc["ratios_sync_over_pipelined"] = {
+        k: ratio(k) for k in (
+            "engine_sched_s", "engine_total_s", "stream_sched_s",
+            "stream_commit_s", "skew_stream_sched_s",
+            "skew_stream_commit_s", "failflush_s")}
+    print(json.dumps(doc))
+
+
+if __name__ == "__main__":
+    main()
