@@ -13,8 +13,8 @@ CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 SHELL := /bin/bash
 
 .PHONY: test tier1 profile-smoke start start-remote start-client-engine \
-        demo docs bench bench_sharded bench-cpu bench-pipeline dryrun \
-        dryrun-dcn soak
+        demo docs bench bench_sharded bench-cpu bench-pipeline \
+        bench-residency dryrun dryrun-dcn soak
 
 # Unit + integration suite on a virtual 8-device CPU mesh.
 test:
@@ -94,6 +94,12 @@ bench-cpu:
 # committed BENCH_PIPELINE.json modes section).
 bench-pipeline:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_pipeline.py
+
+# Device-residency before/after at CPU shapes, interleaved off/on
+# rounds (the committed BENCH_RESIDENCY.json): per-batch h2d/fetch
+# bytes + engine throughput, MINISCHED_DEVICE_RESIDENT=0 vs 1.
+bench-residency:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_residency.py
 
 # Compile-check the flagship single-chip step and the multi-chip sharded
 # step on an 8-device virtual mesh.

@@ -906,9 +906,13 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                                             else 0.0),
                               # honor the engine's sync-fallback knob so
                               # pipelined-vs-synchronous comparisons run
-                              # through the same harness
+                              # through the same harness, and the
+                              # residency fallback knob likewise
+                              # (tools/bench_residency.py toggles it)
                               pipeline=os.environ.get(
-                                  "MINISCHED_PIPELINE", "1") != "0")
+                                  "MINISCHED_PIPELINE", "1") != "0",
+                              device_resident=os.environ.get(
+                                  "MINISCHED_DEVICE_RESIDENT", "1") != "0")
         if backoff_s is not None:
             # Skew-style convergence workloads retry revoked pods across
             # cycles; the reference's 1 s initial backoff would dominate
@@ -1050,6 +1054,17 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                 f"{prefix}_commit_overlap_s":
                     round(m.get("commit_overlap_s", 0.0), 4),
                 f"{prefix}_gap_s": round(m.get("gap_s_total", 0.0), 4),
+                # Transfer observability (engine/scheduler.py counters):
+                # host→device node-feature bytes (static uploads, full
+                # dynamic uploads, residency correction deltas) and
+                # device→host decision/spread-fetch bytes, plus the
+                # residency protocol's hit/resync counts — the
+                # per-batch upload/readback claim, measurable on CPU.
+                f"{prefix}_h2d_bytes": int(m.get("h2d_bytes_total", 0)),
+                f"{prefix}_fetch_bytes": int(m.get("fetch_bytes_total", 0)),
+                f"{prefix}_residency_hits": int(m.get("residency_hits", 0)),
+                f"{prefix}_residency_resyncs":
+                    int(m.get("residency_resyncs", 0)),
                 f"{prefix}_bind_conflicts": int(m["bind_conflicts"]),
                 # revocations + terminal failures summed over cycles —
                 # the skew-convergence diagnostic (how much work the
@@ -1097,7 +1112,8 @@ def _attempt(env: dict, timeout_s: float) -> tuple:
 
 
 def _probe_accelerator(timeout_s: float = 90.0, retries: int = 3,
-                       retry_wait_s: float = 45.0) -> bool:
+                       retry_wait_s: float = 45.0,
+                       total_budget_s: float = 420.0) -> dict:
     """Cheap canary: can the ambient backend initialize? A wedged TPU
     tunnel hangs backend init forever — without this the first attempt
     burns its whole budget discovering that, and killing a larger child
@@ -1106,25 +1122,71 @@ def _probe_accelerator(timeout_s: float = 90.0, retries: int = 3,
     in-flight remote compile is itself a known wedge trigger; device
     enumeration is the safe thing to kill.
 
+    Returns a diagnostic dict — {"ok": bool, "platform": str|None,
+    "tries": [...], "elapsed_s": float} — so the final JSON reports the
+    RESOLVED platform (or the concrete per-try failure) instead of the
+    bare "failed/hung" string BENCH_r05 shipped.
+
+    Hard-timeout discipline (the r05 failure was the probe itself
+    hanging the driver): each try runs in its own process GROUP and is
+    killed group-wide on expiry — a TPU plugin that forks helpers can
+    otherwise keep the pipe open and hang the parent's read past the
+    subprocess timeout — and the retry loop is additionally capped by
+    ``total_budget_s`` wall clock (MINISCHED_BENCH_PROBE_BUDGET
+    overrides), so no retry arithmetic can exceed it.
+
     Retries: a BUSY (not wedged) tunnel can miss one 90 s enumeration
     window — e.g. another client's long compile in flight — and a single
     false negative forfeits the whole hardware capture to the CPU
     fallback. Enumeration probes are the documented-safe kill, so a few
-    spaced retries cost bounded time and nothing else. Total worst case:
-    retries × (timeout + wait) ≈ 6.7 min, well under the driver budget."""
+    spaced retries cost bounded time and nothing else."""
+    import signal
+
+    total_budget_s = float(os.environ.get("MINISCHED_BENCH_PROBE_BUDGET",
+                                          str(total_budget_s)))
     code = "import jax; print(jax.devices()[0].platform)"
+    t0 = time.monotonic()
+    out = {"ok": False, "platform": None, "tries": []}
+
+    def left() -> float:
+        return total_budget_s - (time.monotonic() - t0)
+
     for attempt in range(max(1, retries)):
         if attempt:
-            time.sleep(retry_wait_s)
+            wait = min(retry_wait_s, max(0.0, left() - timeout_s))
+            if wait <= 0 or left() <= 5.0:
+                out["tries"].append("probe budget exhausted")
+                break
+            time.sleep(wait)
+        budget = min(timeout_s, max(5.0, left()))
+        proc = subprocess.Popen([sys.executable, "-c", code],
+                                env=dict(os.environ),
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True,
+                                start_new_session=True)
         try:
-            proc = subprocess.run([sys.executable, "-c", code],
-                                  env=dict(os.environ), capture_output=True,
-                                  text=True, timeout=timeout_s)
-            if proc.returncode == 0:
-                return True
+            stdout, stderr = proc.communicate(timeout=budget)
         except subprocess.TimeoutExpired:
+            # Kill the whole process group: a forked TPU-plugin helper
+            # holding the pipe would otherwise hang communicate() even
+            # after the direct child dies.
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait()
+            out["tries"].append(f"hung past {budget:.0f}s (killed)")
             continue
-    return False
+        if proc.returncode == 0 and stdout.strip():
+            out["ok"] = True
+            out["platform"] = stdout.strip().splitlines()[-1]
+            out["tries"].append(f"ok: {out['platform']}")
+            break
+        tail = " | ".join((stderr or stdout or "").strip()
+                          .splitlines()[-3:])[:300]
+        out["tries"].append(f"rc={proc.returncode}: {tail}")
+    out["elapsed_s"] = round(time.monotonic() - t0, 1)
+    return out
 
 
 def main() -> None:
@@ -1135,12 +1197,23 @@ def main() -> None:
     # accelerator: a run already pinned to cpu strips the tunnel hook
     # inside the child and must not be failed by a wedged tunnel the
     # probe (which runs with the ambient env) would trip over.
-    if (os.environ.get("JAX_PLATFORMS", "") != "cpu"
-            and not _probe_accelerator()):
-        attempts["ambient"] = "accelerator probe failed/hung (wedged tunnel?)"
+    probe = None
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        probe = _probe_accelerator()
+        attempts["probe"] = probe
+    if probe is not None and not probe["ok"]:
+        # The probe's per-try outcomes name the concrete failure (hung
+        # past the hard timeout / nonzero rc + stderr tail) and the
+        # fallback is stated explicitly — BENCH_r05's bare "failed/hung
+        # (wedged tunnel?)" left the platform question open.
+        attempts["ambient"] = (
+            f"accelerator probe failed within {probe['elapsed_s']}s "
+            f"({'; '.join(probe['tries'])}); falling back to CPU at "
+            "reduced shapes")
         parsed, diag = None, attempts["ambient"]
     else:
-        # Attempt 1: ambient platform (TPU under axon).
+        # Attempt 1: ambient platform (TPU under axon) — or the
+        # CPU-pinned run, which needs no probe.
         parsed, diag = _attempt(dict(os.environ), timeout_s)
     if parsed is not None and "error" not in parsed.get("detail", {}):
         parsed.setdefault("detail", {})["attempts"] = attempts or None

@@ -88,6 +88,19 @@ class SchedulerConfig:
     # (MINISCHED_PIPELINE=0) restores the strictly synchronous cycle —
     # the debugging/regression-triage fallback.
     pipeline: bool = True
+    # Device-resident dynamic cluster state + slim decision readback
+    # (engine/scheduler.py _DeviceResidency, ops/residency.py): the
+    # dynamic node-feature leaves (free/used_ports) stay loop-carried on
+    # device — the jitted step's free_after IS the next batch's input —
+    # and the host uploads only sparse correction rows where its
+    # authoritative cache diverged from the device's optimistic view
+    # (revocations, failed binds, informer churn); the per-batch
+    # decision fetch packs bool planes as bits and narrows counts to
+    # i16. Decisions are bit-identical either way
+    # (tests/test_device_residency.py). False (MINISCHED_DEVICE_RESIDENT
+    # =0) restores the upload-every-batch path and the all-i32 fetch —
+    # the regression-triage fallback.
+    device_resident: bool = True
     # Intra-cycle repair for topology-revoked pods: after the batch's
     # survivors are assumed, re-run the step on the revoked rows against
     # the refreshed counts up to this many times before falling back to
@@ -138,5 +151,6 @@ def config_from_env() -> SchedulerConfig:
         percentage_of_nodes_to_score=int(
             _req("MINISCHED_PCT_NODES_TO_SCORE", "0")),
         pipeline=_req("MINISCHED_PIPELINE", "1") != "0",
+        device_resident=_req("MINISCHED_DEVICE_RESIDENT", "1") != "0",
         mesh=mesh,
     )

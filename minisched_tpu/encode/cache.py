@@ -67,6 +67,38 @@ def bucket_for(n: int, minimum: int = 16) -> int:
     return b
 
 
+class DynDeltaListener:
+    """One consumer's registration in the cache's dynamic-leaf elision
+    protocol (snapshot_resident): the cache records every node row whose
+    ``free``/``used_ports`` column it mutates into ``rows`` (under the
+    cache lock), and each collection drains the set into a
+    features.DynDelta while bumping ``epoch`` — the divergence counter
+    both sides carry so desync is structurally impossible (the consumer
+    applies a delta only when its device state sits at exactly
+    ``epoch - 1``; anything else forces a full re-upload).
+
+    ``valid``/``pad`` track whether the accumulated rows describe ALL
+    mutations since a full base at that pad: the consumer clears
+    ``valid`` when it drops its device state (resync), and a snapshot
+    resolved at a different pad rebases automatically. Cross-thread
+    notes: ``rows`` is only touched under the cache lock; ``valid`` is
+    a plain flag written by the consumer thread — a racing mark at
+    worst adds rows that the next full rebase discards."""
+
+    __slots__ = ("epoch", "rows", "valid", "pad")
+
+    def __init__(self):
+        self.epoch = 0
+        self.rows: set = set()
+        self.valid = False
+        self.pad = -1
+
+    def invalidate(self) -> None:
+        """Consumer dropped its device state: the next collection must
+        return full leaves (a new base), not a delta."""
+        self.valid = False
+
+
 def step_bucket(n: int, minimum: int = 16) -> int:
     """Padding bucket for the STEP's array shapes: power-of-two up to
     2048, then eighth-steps between octaves (2^k · (8+j)/8, j = 1..8).
@@ -189,6 +221,33 @@ class NodeFeatureCache:
         # run as one vectorized mask over the assigned arrays instead of
         # an O(all bound pods) dict walk under the cache lock.
         self._a_key: List[Optional[str]] = [None] * a_cap
+        # Dynamic-leaf mutation listeners (device-residency consumers);
+        # every mutator of free/used_ports marks the touched rows into
+        # each registered listener's set (see DynDeltaListener).
+        self._dyn_listeners: List[DynDeltaListener] = []
+
+    def register_dyn_listener(self) -> DynDeltaListener:
+        """Register a consumer of the dynamic-leaf elision protocol (one
+        per device-resident engine). Listeners are never unregistered —
+        engines live as long as their shared cache."""
+        lst = DynDeltaListener()
+        with self._lock:
+            self._dyn_listeners.append(lst)
+        return lst
+
+    def _mark_dyn_locked(self, rows) -> None:
+        """Record rows whose free/used_ports changed (caller holds the
+        lock). ``rows`` is an int, an iterable of ints, or an ndarray."""
+        if not self._dyn_listeners:
+            return
+        if isinstance(rows, (int, np.integer)):
+            for lst in self._dyn_listeners:
+                lst.rows.add(int(rows))
+            return
+        if isinstance(rows, np.ndarray):
+            rows = rows.tolist()
+        for lst in self._dyn_listeners:
+            lst.rows.update(rows)
 
     def enable_owner_pairs(self) -> None:
         """Record controller-owner spread pairs in assigned label rows
@@ -292,6 +351,7 @@ class NodeFeatureCache:
                     alloc_memo[asig] = v
                 feats.allocatable[i] = v
                 feats.free[i] = v  # fresh row: nothing bound, no claims
+                self._mark_dyn_locked(i)
                 feats.name_suffix[i] = F.name_suffix_digit(name)
                 feats.name_hash[i] = F._h(name)
                 feats.avoid_pods[i] = (F.PREFER_AVOID_PODS_ANNOTATION
@@ -369,6 +429,7 @@ class NodeFeatureCache:
             if i is None:
                 return []
             F.clear_node_row(self._feats, i)
+            self._mark_dyn_locked(i)
             self._names[i] = None
             self._free_rows.append(i)
             # Bound-pod accounting rows pointing at this node are dropped;
@@ -487,6 +548,7 @@ class NodeFeatureCache:
                 # Several pods may land on one node row — unbuffered
                 # subtract so duplicates accumulate.
                 np.subtract.at(self._feats.free, ii, reqs[kk])
+                self._mark_dyn_locked(ii)
                 a_rows = self._a_free[-len(fast):]
                 del self._a_free[-len(fast):]
                 aa = np.asarray(a_rows, dtype=np.int64)
@@ -588,6 +650,7 @@ class NodeFeatureCache:
         self._bound[pod.key] = (i, req, ports, claims)
         self._feats.free[i] -= req
         self._add_ports(i, ports)
+        self._mark_dyn_locked(i)
         for ck in claims:
             rows = self._claims.setdefault(ck, {})
             rows[i] = rows.get(i, 0) + 1
@@ -642,6 +705,7 @@ class NodeFeatureCache:
                 self._feats.free[i] += req
                 self._feats.free[i, _VOL] += released
                 self._remove_ports(i, ports)
+                self._mark_dyn_locked(i)
             a = self._a_row.pop(pod_key, None)
             if a is not None:
                 self._assigned.valid[a] = False
@@ -754,6 +818,43 @@ class NodeFeatureCache:
         detect a node replaced with different topology mid-cycle
         (account_bind's ``expected_inc``).
         """
+        feats, names, sv, incs, _delta = self._snapshot_impl(
+            pad, known_static, None)
+        return feats, names, sv, incs
+
+    def snapshot_resident(self,
+                          pad: Union[int, Callable[[int], int],
+                                     None] = None,
+                          known_static=None,
+                          dyn: Optional[DynDeltaListener] = None):
+        """snapshot_versioned extended with the DYNAMIC-leaf elision
+        protocol: when ``dyn`` (a registered DynDeltaListener) holds a
+        valid base at the resolved pad, the returned feats carry ``None``
+        for the dynamic leaves and the fifth element is a
+        features.DynDelta with exactly the rows mutated since the last
+        collection (the consumer corrects its device-resident copies
+        from it). Otherwise the dynamic leaves are full host copies, the
+        delta is None, and the listener is REBASED to this snapshot
+        (epoch bumped, row set cleared) — the consumer must upload the
+        full leaves it was just handed.
+
+        Returns (feats, names, static_version, row_incarnations,
+        delta_or_None)."""
+        if "snapshot_versioned" in self.__dict__:
+            # Test instrumentation patches snapshot_versioned on the
+            # INSTANCE to inject mid-cycle races (tests/test_ghost_bind)
+            # — the same contract the engine honors for instance-patched
+            # schedule_batch. Route through the patch and answer with
+            # full dynamic leaves (the consumer re-establishes, so the
+            # elision protocol never hides a patched snapshot's view).
+            if dyn is not None:
+                dyn.invalidate()
+            feats, names, sv, incs = self.snapshot_versioned(
+                pad, known_static)
+            return feats, names, sv, incs, None
+        return self._snapshot_impl(pad, known_static, dyn)
+
+    def _snapshot_impl(self, pad, known_static, dyn):
         with self._lock:
             self._refresh_topology_locked()
             sv = self.static_version
@@ -763,8 +864,36 @@ class NodeFeatureCache:
             else:
                 target = pad if pad is not None else bucket_for(n)
             f = self._feats
-            skip = (lambda name: known_static == (sv, target)
-                    and name not in self.DYNAMIC_NF_FIELDS)
+
+            delta = None
+            skip_dyn = False
+            if dyn is not None:
+                if dyn.valid and dyn.pad == target:
+                    rows = np.fromiter(dyn.rows, dtype=np.int32,
+                                       count=len(dyn.rows))
+                    rows.sort()
+                    # Rows are < rows_hw ≤ target by construction; the
+                    # guard keeps a future pad-policy change from
+                    # silently shipping out-of-pad corrections.
+                    rows = rows[rows < target]
+                    dyn.rows.clear()
+                    dyn.epoch += 1
+                    delta = F.DynDelta(epoch=dyn.epoch, rows=rows,
+                                       free=f.free[rows].copy(),
+                                       used_ports=f.used_ports[rows].copy())
+                    skip_dyn = True
+                else:
+                    # Rebase: this snapshot's full dynamic leaves are the
+                    # listener's new base at this pad.
+                    dyn.valid = True
+                    dyn.pad = target
+                    dyn.rows.clear()
+                    dyn.epoch += 1
+
+            skip = (lambda name:
+                    (known_static == (sv, target)
+                     and name not in self.DYNAMIC_NF_FIELDS)
+                    or (skip_dyn and name in self.DYNAMIC_NF_FIELDS))
 
             if target <= n:
                 if target < n and f.valid[target:].any():
@@ -797,7 +926,7 @@ class NodeFeatureCache:
             incs = np.zeros(target, dtype=np.int64)
             m = min(target, n)
             incs[:m] = self._row_inc[:m]
-            return feats, names, sv, incs
+            return feats, names, sv, incs, delta
 
     def snapshot_assigned(self, pad: Union[int, Callable[[int], int],
                                          None] = None,
@@ -1126,6 +1255,7 @@ class NodeFeatureCache:
         self._feats.free[i] = free
         self._feats.used_ports[i] = 0
         self._add_ports(i, ports)
+        self._mark_dyn_locked(i)
 
     def _add_ports(self, i: int, ports: List[int]) -> None:
         row = self._feats.used_ports[i]

@@ -232,6 +232,23 @@ class NodeFeatures(NamedTuple):
     topo_domains: np.ndarray   # (K,N) i32
 
 
+class DynDelta(NamedTuple):
+    """Sparse host-truth correction for the DYNAMIC NodeFeatures leaves
+    (``free`` / ``used_ports`` — NodeFeatureCache.DYNAMIC_NF_FIELDS),
+    produced by the cache's versioned elision protocol
+    (NodeFeatureCache.snapshot_resident) for a consumer that keeps those
+    leaves loop-carried on device: only the rows the cache mutated since
+    the consumer's last collection, with their current authoritative
+    values. ``epoch`` is the cache-side divergence counter — the
+    consumer must hold device state at exactly ``epoch - 1`` to apply
+    the delta; any mismatch means full re-upload (resync)."""
+
+    epoch: int
+    rows: np.ndarray        # (K,) i32 node rows mutated since last collect
+    free: np.ndarray        # (K,R) f32 authoritative free rows
+    used_ports: np.ndarray  # (K,PORT) i32 authoritative port rows
+
+
 class AssignedPodFeatures(NamedTuple):
     """Dense features of pods already bound to nodes — the corpus that
     topology-spread / inter-pod-affinity counts are computed against

@@ -34,6 +34,8 @@ from ..encode.cache import bucket_for, step_bucket
 from ..encode.features import NodeFeatures
 from ..errors import ConflictError, NotFoundError
 from ..ops.pipeline import Decision, build_step
+from ..ops.residency import (I16_SAT, apply_rows, apply_rows_bytes,
+                             pack_decision_slim, unpack_decision_slim)
 from ..plugins.base import PluginSet
 from ..state.events import ActionType, ClusterEvent, EventBroadcaster, GVK
 from ..state.objects import Pod, claim_keys, gang_key
@@ -60,7 +62,7 @@ class _InflightBatch:
                  "packed_dev", "spread_dev", "failures", "n_assigned",
                  "shapes", "seq", "t0", "t_encode", "t_dispatch",
                  "t_fetch_start", "t_step", "t_resolved", "commit_t0",
-                 "commit_t1")
+                 "commit_t1", "res_carried")
 
     def __init__(self):
         self.failures: List[tuple] = []  # (qpi, plugins, message, retryable)
@@ -74,6 +76,10 @@ class _InflightBatch:
         self.decision: Optional[Decision] = None
         self.spread_dev = None
         self.sample_k = None
+        # This batch's free/used_ports input is the device-resident
+        # chain (_DeviceResidency) — its free_after must be carried and
+        # its debits replayed into the host mirror at resolve time.
+        self.res_carried = False
 
 
 @jax.jit
@@ -105,6 +111,164 @@ def _pack_spread(pre, dom, mn, scan_groups):
     return jnp.concatenate(
         [pre, dom.astype(jnp.float32), mn[None, :],
          scan_groups.astype(jnp.float32)[None, :]], axis=0)
+
+
+class _DeviceResidency:
+    """Loop-carried device residency of the DYNAMIC node-feature leaves
+    (``free`` / ``used_ports`` — NodeFeatureCache.DYNAMIC_NF_FIELDS),
+    mirroring the static-leaf protocol of ``_with_device_static``: the
+    jitted step's ``free_after`` stays on device as the next batch's
+    input, and the host uploads only sparse host-truth corrections
+    (ops/residency.apply_rows) for the rows where its authoritative
+    cache diverged from the device's optimistic view — revoked
+    placements, failed binds/unassume, informer churn, node lifecycle,
+    claim/PV mutations all surface through the cache's
+    DynDeltaListener. ``used_ports`` has no device-side optimistic
+    update (the step does not model port insertion), so its residency
+    is correction-only: the resident copy is always the last uploaded
+    host truth, patched row-wise — empty unless host-port pods churn.
+
+    Invariants (the correctness argument, asserted end-to-end by
+    tests/test_device_residency.py):
+
+      I1. the host mirrors equal the device arrays numerically at all
+          times (±0.0 aside): the replay of the greedy scan's debits —
+          ``np.subtract.at`` in pod order over the same f32 values —
+          performs the identical IEEE op sequence the scan's
+          ``free.at[row].add(-req)`` carry performs. This is why
+          residency is gated to the greedy assignment family (lax.scan,
+          pallas kernel, sharded chunked-gather scan — all debit in pod
+          order); the auction's parallel bidding rounds have no such
+          order.
+      I2. after ``attach`` the device arrays equal the cache's truth on
+          every row, so the step consumes exactly what the
+          MINISCHED_DEVICE_RESIDENT=0 upload-every-batch path would
+          feed it — decisions are bit-identical by construction.
+      I3. the correction candidate set is complete: a row diverges only
+          through a host mutation (the cache marks it into the
+          listener) or a device debit (``note_debits`` records it with
+          its pre-replay truth); a row in neither set changed on
+          neither side. The epoch counter carried on both sides turns
+          any protocol break into a full re-upload (counted in
+          ``residency_resyncs``), never a silent desync — and the
+          scheme self-heals across failed cycles: an exception anywhere
+          leaves mirror == device, and the next delta re-converges
+          device to truth.
+    """
+
+    __slots__ = ("listener", "epoch", "pad", "free_dev", "ports_dev",
+                 "mirror_free", "mirror_ports", "pending_rows",
+                 "pending_pre")
+
+    def __init__(self, listener):
+        self.listener = listener
+        self.epoch = -1          # engine-side epoch; -1 = no device state
+        self.pad = -1
+        self.free_dev = None     # device (N,R) f32 — next step input
+        self.ports_dev = None    # device (N,PORT) i32
+        self.mirror_free = None  # host twins of the device arrays
+        self.mirror_ports = None
+        self.pending_rows = None  # rows the last step debited (unique)
+        self.pending_pre = None   # their PRE-replay mirror rows == truth
+        #                           at the last snapshot for rows the
+        #                           host never otherwise touched
+
+    def attach(self, eng, nf, delta):
+        """Bring the device-resident dynamic leaves up to host truth for
+        this batch and splice them into ``nf``. ``delta`` None = full
+        rebase (the snapshot returned real leaves and rebased the
+        listener); else apply the sparse correction. Raises on epoch
+        desync — the caller drops residency and re-snapshots."""
+        if delta is None:
+            free_np, ports_np = nf.free, nf.used_ports
+            self.free_dev = jax.device_put(free_np,
+                                           eng._nf_sharding("free"))
+            self.ports_dev = jax.device_put(ports_np,
+                                            eng._nf_sharding("used_ports"))
+            # The snapshot copies are private — they become the mirrors.
+            self.mirror_free, self.mirror_ports = free_np, ports_np
+            self.pad = int(free_np.shape[0])
+            self.epoch = self.listener.epoch
+            self.pending_rows = self.pending_pre = None
+            eng._res_count(resync=True,
+                           h2d=free_np.nbytes + ports_np.nbytes)
+            return nf._replace(free=self.free_dev,
+                               used_ports=self.ports_dev)
+        if delta.epoch != self.epoch + 1 or self.free_dev is None:
+            raise RuntimeError(
+                f"residency epoch desync: device at {self.epoch}, delta "
+                f"at {delta.epoch}")
+        self.epoch = delta.epoch
+        h2d = 0
+        rows = delta.rows.astype(np.int64)
+        vals = delta.free
+        if self.pending_rows is not None:
+            # Device-debited rows the host never touched: their truth is
+            # the pre-replay mirror value (unchanged since the last
+            # snapshot — had it changed, the cache would have marked the
+            # row into the delta, which wins below by exclusion here).
+            extra = ~np.isin(self.pending_rows, rows)
+            if extra.any():
+                rows = np.concatenate([rows, self.pending_rows[extra]])
+                vals = np.concatenate([vals, self.pending_pre[extra]])
+        self.pending_rows = self.pending_pre = None
+        if rows.size:
+            diff = np.any(vals != self.mirror_free[rows], axis=1)
+            if diff.any():
+                up_r = rows[diff].astype(np.int32)
+                up_v = np.ascontiguousarray(vals[diff])
+                # No donation: free_dev is (usually) Decision.free_after,
+                # still referenced by the in-flight batch until commit.
+                self.free_dev = apply_rows(self.free_dev, up_r, up_v)
+                self.mirror_free[up_r] = up_v
+                h2d += apply_rows_bytes(up_r.shape[0], up_v)
+        prows = delta.rows.astype(np.int64)
+        if prows.size:
+            pdiff = np.any(delta.used_ports != self.mirror_ports[prows],
+                           axis=1)
+            if pdiff.any():
+                up_r = prows[pdiff].astype(np.int32)
+                up_v = np.ascontiguousarray(delta.used_ports[pdiff])
+                # ports_dev is engine-private (establish/apply output
+                # only) — safe to donate so XLA reuses the buffer.
+                self.ports_dev = apply_rows(self.ports_dev, up_r, up_v,
+                                            donate=True)
+                self.mirror_ports[up_r] = up_v
+                h2d += apply_rows_bytes(up_r.shape[0], up_v)
+        eng._res_count(resync=False, h2d=h2d)
+        return nf._replace(free=self.free_dev, used_ports=self.ports_dev)
+
+    def note_debits(self, chosen, assigned, requests, free_after_dev):
+        """Record the step's device-side debits: replay them into the
+        host mirror (exact — see I1) and adopt ``free_after`` as the
+        carried device array. Must run on the PRE-residual-merge
+        chosen/assigned (the carried array is the MAIN step's output;
+        residual/repair placements reach the device as next-batch
+        corrections via the cache listener)."""
+        rows = chosen[assigned].astype(np.int64)
+        if rows.size:
+            reqs = requests[assigned]
+            uniq = np.unique(rows)
+            self.pending_pre = self.mirror_free[uniq].copy()
+            self.pending_rows = uniq
+            # Unbuffered subtract applies per index IN ORDER — the same
+            # f32 op sequence as the scan's sequential carry.
+            np.subtract.at(self.mirror_free, rows, reqs)
+        else:
+            self.pending_rows = self.pending_pre = None
+        self.free_dev = free_after_dev
+
+    def drop(self, reason: str) -> None:
+        """Abandon the device state; the next residency batch does a
+        full re-upload (the listener rebases itself at collection)."""
+        if self.epoch >= 0:
+            log.info("device residency dropped (%s); next batch "
+                     "re-uploads the dynamic leaves", reason)
+        self.epoch = -1
+        self.free_dev = self.ports_dev = None
+        self.mirror_free = self.mirror_ports = None
+        self.pending_rows = self.pending_pre = None
+        self.listener.invalidate()
 
 
 def arbitrate_rwo(batch: List[QueuedPodInfo], assigned, chosen,
@@ -653,6 +817,25 @@ class Scheduler:
         # (cache.static_version, pad) — see _with_device_static. Touched
         # only by the scheduling thread.
         self._nf_static_device = None
+        # Slim decision readback (bit-packed bools + saturating i16
+        # counts in ONE u8 fetch buffer, ops/residency.py) rides the
+        # same knob as residency so MINISCHED_DEVICE_RESIDENT=0 restores
+        # the PR-1 transfer behavior exactly for regression triage. The
+        # first slim fetch is cross-checked against direct leaf fetches
+        # (byte-order/packbits insurance on new backends) and falls back
+        # to the i32 layout on mismatch.
+        self._slim = bool(self.config.device_resident)
+        self._slim_verified = False
+        # Device-resident DYNAMIC leaves (free/used_ports loop-carried
+        # as the next batch's input; see _DeviceResidency). Gated to the
+        # greedy assignment family — the host replay that keeps the
+        # mirror exact depends on the scan's pod-order debit sequence,
+        # which the auction's parallel bidding rounds don't have.
+        # Touched only by the scheduling thread.
+        self._residency = None
+        if self.config.device_resident and self.config.assignment == "greedy":
+            self._residency = _DeviceResidency(
+                self.cache.register_dyn_listener())
         # Armed trace request (see trace_next_batch). The lock covers the
         # arm/consume pair: an unlocked read-then-clear swap on the
         # scheduling thread could clobber a concurrent arm with None.
@@ -687,7 +870,129 @@ class Scheduler:
             "encode_overlap_s": 0.0, "commit_overlap_s": 0.0,
             "last_batch_size": 0, "last_encode_s": 0.0,
             "last_step_s": 0.0, "last_commit_s": 0.0,
+            # Transfer observability (node-feature traffic; the pod-
+            # feature encode upload is identical across modes and not
+            # counted): host→device bytes — static-leaf uploads, full
+            # dynamic-leaf uploads (fallback mode / residency resyncs),
+            # sparse residency corrections — and device→host bytes for
+            # every decision/spread/exact-table/residual fetch; plus the
+            # residency protocol's hit (delta-corrected batch) and
+            # resync (full re-upload) counters.
+            "h2d_bytes_total": 0.0, "fetch_bytes_total": 0.0,
+            "residency_hits": 0, "residency_resyncs": 0,
         }
+
+    def _res_count(self, *, resync: bool, h2d: int) -> None:
+        with self._metrics_lock:
+            self._metrics["h2d_bytes_total"] += h2d
+            if resync:
+                self._metrics["residency_resyncs"] += 1
+            else:
+                self._metrics["residency_hits"] += 1
+
+    def _count_fetch(self, nbytes: int) -> None:
+        with self._metrics_lock:
+            self._metrics["fetch_bytes_total"] += nbytes
+
+    def _count_h2d(self, nbytes: int) -> None:
+        with self._metrics_lock:
+            self._metrics["h2d_bytes_total"] += nbytes
+
+    def _pack_dec(self, decision: Decision):
+        """Dispatch the fused decision pack — slim (u8 bit-planes + i16
+        counts) or the legacy all-i32 layout — WITHOUT fetching. On a
+        MESH the Decision is returned unpacked: jitting the mixed-shape
+        pack concats over the shard_map step's outputs makes GSPMD
+        insert a spurious cross-shard sum on some toolchains (observed
+        on jax 0.4 CPU SPMD: every packed value scaled by the node-axis
+        size), so mesh mode fetches per leaf — multi-chip is
+        in-process, where extra fetches are not tunnel round trips."""
+        if self._mesh is not None:
+            return decision
+        pack = pack_decision_slim if self._slim else _pack_decision
+        return pack(decision.chosen, decision.assigned,
+                    decision.gang_rejected, decision.feasible_counts,
+                    decision.feasible_static, decision.reject_counts)
+
+    def _spread_payload(self, d: Decision):
+        """Stage ``d``'s spread-arbitration table for _fetch_spread:
+        the raw Decision on a mesh (no device-side pack over shard_map
+        outputs — see _pack_dec), the jitted packed buffer otherwise.
+        EVERY spread fetch — main batch, residual merge, repair
+        iterations — must route through this, or a mesh toolchain with
+        the GSPMD concat-sum defect feeds node-axis-scaled counts into
+        host arbitration."""
+        if self._mesh is not None:
+            return d
+        return _pack_spread(d.spread_pre, d.spread_dom, d.spread_min,
+                            d.scan_groups)
+
+    def _fetch_spread(self, payload):
+        """Materialize the (2P+2, G) spread-arbitration table from
+        either form _prepare_batch staged: the device-packed buffer
+        (single fetch, off-mesh) or the raw Decision (mesh: per-leaf
+        fetch + host assembly — see _pack_dec on why the device-side
+        pack cannot run over shard_map outputs)."""
+        if payload is None:
+            return None
+        if isinstance(payload, Decision):
+            d = payload
+            sp = np.concatenate(
+                [np.asarray(d.spread_pre),
+                 np.asarray(d.spread_dom).astype(np.float32),
+                 np.asarray(d.spread_min)[None, :].astype(np.float32),
+                 np.asarray(d.scan_groups).astype(np.float32)[None, :]],
+                axis=0)
+        else:
+            sp = np.array(payload)
+        self._count_fetch(sp.nbytes)
+        return sp
+
+    def _fetch_decision(self, packed_dev, p: int, f: int, decision=None):
+        """Block on the ONE packed decision fetch and unpack it into
+        writable host arrays: (chosen i32, assigned bool, gang_rejected
+        bool, feasible i32, feasible_static i32, rejects (F,P) i32).
+        A raw Decision (mesh mode, _pack_dec) is fetched per leaf.
+        The first slim fetch is verified against direct leaf fetches
+        when ``decision`` is supplied; a mismatch (exotic backend byte
+        order) logs, permanently reverts to the i32 layout, and refetches
+        this batch through it — decisions are never at risk."""
+        if isinstance(packed_dev, Decision):
+            d = packed_dev
+            out = (np.array(d.chosen), np.array(d.assigned),
+                   np.array(d.gang_rejected),
+                   np.array(d.feasible_counts),
+                   np.array(d.feasible_static),
+                   np.array(d.reject_counts))
+            self._count_fetch(sum(a.nbytes for a in out))
+            return out
+        buf = np.array(packed_dev)  # writable: residual merge mutates
+        self._count_fetch(buf.nbytes)
+        if not self._slim:
+            return (buf[0], buf[1].astype(bool), buf[2].astype(bool),
+                    buf[3], buf[4], buf[5:])
+        out = unpack_decision_slim(buf, p, f)
+        if not self._slim_verified and decision is not None:
+            self._slim_verified = True
+            ok = (np.array_equal(out[0], np.asarray(decision.chosen))
+                  and np.array_equal(out[1],
+                                     np.asarray(decision.assigned))
+                  and np.array_equal(
+                      out[3], np.minimum(
+                          np.asarray(decision.feasible_counts), I16_SAT)))
+            if not ok:
+                log.error(
+                    "slim decision readback failed its first-batch "
+                    "cross-check on this backend; reverting to the i32 "
+                    "packed fetch")
+                self._slim = False
+                return self._fetch_decision(
+                    _pack_decision(
+                        decision.chosen, decision.assigned,
+                        decision.gang_rejected, decision.feasible_counts,
+                        decision.feasible_static, decision.reject_counts),
+                    p, f)
+        return out
 
     def wants_pod(self, pod: Pod) -> bool:
         """Does this scheduler's profile handle the pod? (multi-profile
@@ -1098,13 +1403,52 @@ class Scheduler:
         # Versioned snapshot: the static version is observed under the
         # snapshot lock (the snapshot's own topology refresh can bump it),
         # and the cache skips host copies of static leaves we already hold
-        # on device (known_static hit).
+        # on device (known_static hit). With device residency live, the
+        # DYNAMIC leaves are elided too: the cache hands back only the
+        # rows it mutated since the last batch (DynDelta) and the
+        # resident free/used_ports arrays are corrected in place.
         cached = self._nf_static_device
-        nf, names, static_v, row_incs = self.cache.snapshot_versioned(
-            pad=self._node_pad,
-            known_static=cached[0] if cached else None)
+        res = self._residency
+        res_live = res is not None and not self._nominations
+        if res is not None and not res_live:
+            # Nominated-capacity debits modify the step's free input;
+            # the carried chain cannot represent a reservation that
+            # expires without any cache mutation — fall back to the
+            # upload-every-batch path until the reservations drain.
+            res.drop("nominated-capacity reservations outstanding")
+        if res_live:
+            nf, names, static_v, row_incs, dyn_delta = (
+                self.cache.snapshot_resident(
+                    pad=self._node_pad,
+                    known_static=cached[0] if cached else None,
+                    dyn=res.listener))
+        else:
+            nf, names, static_v, row_incs = self.cache.snapshot_versioned(
+                pad=self._node_pad,
+                known_static=cached[0] if cached else None)
+            dyn_delta = None
         af = self.cache.snapshot_assigned(pad=self._af_pad)
-        nf = self._with_device_static(nf, static_v)
+        nf = self._with_device_static(nf, static_v, row_incs.shape[0])
+        carried = False
+        if res_live:
+            try:
+                nf = res.attach(self, nf, dyn_delta)
+                carried = True
+            except Exception:
+                log.exception("device residency attach failed; resyncing "
+                              "through a full snapshot")
+                res.drop("attach error")
+                cached = self._nf_static_device
+                nf, names, static_v, row_incs = (
+                    self.cache.snapshot_versioned(
+                        pad=self._node_pad,
+                        known_static=cached[0] if cached else None))
+                nf = self._with_device_static(nf, static_v,
+                                              row_incs.shape[0])
+        if not carried and isinstance(nf.free, np.ndarray):
+            # Upload-every-batch path: the jitted step transfers the
+            # full dynamic leaves host→device on dispatch.
+            self._count_h2d(nf.free.nbytes + nf.used_ports.nbytes)
         # Nominated-capacity protection (upstream nominatedNodeName
         # semantics): capacity a preemption freed is RESERVED for its
         # preemptor — reservations of pods NOT in this batch are debited
@@ -1115,6 +1459,13 @@ class Scheduler:
                 {q.pod.key for q in batch}, names, nf)
             if reserved is not None:
                 nf = nf._replace(free=nf.free - reserved)
+                if carried:
+                    # Unreachable by thread discipline (nominations are
+                    # granted on this thread, in resolve) — but a debit
+                    # baked into the carried chain would desync it, so
+                    # fail safe.
+                    carried = False
+                    res.drop("nomination debit appeared mid-prepare")
         t_encode = time.perf_counter()
 
         self._step_counter += 1
@@ -1146,15 +1497,14 @@ class Scheduler:
                 nf.free.shape[0], len(batch), has_gang or hard_spread)
             step_fn = step_fn or self._step
         decision: Decision = step_fn(eb, nf, af, key)
-        # Pack every per-pod output into ONE device array per dtype family
-        # before fetching: on a remote-TPU tunnel each np.asarray is a
-        # full round trip, and five separate fetches of tiny arrays cost
-        # ~4 extra latencies per batch (measured ~0.27 s at 10k pods —
-        # comparable to the whole device compute).
-        packed_dev = _pack_decision(
-            decision.chosen, decision.assigned, decision.gang_rejected,
-            decision.feasible_counts, decision.feasible_static,
-            decision.reject_counts)
+        # Pack every per-pod output into ONE device buffer before
+        # fetching: on a remote-TPU tunnel each np.asarray is a full
+        # round trip, and five separate fetches of tiny arrays cost ~4
+        # extra latencies per batch (measured ~0.27 s at 10k pods —
+        # comparable to the whole device compute). The slim layout
+        # (default) additionally bit-packs the bool planes and narrows
+        # the counts to i16, ~2.4× fewer bytes than the i32 stack.
+        packed_dev = self._pack_dec(decision)
         # The spread/anti arbitration inputs are fetched only when the
         # batch actually carries something the host must arbitrate: a
         # hard (DoNotSchedule) spread slot or a required anti-affinity
@@ -1164,9 +1514,8 @@ class Scheduler:
         needs_arb = hard_spread or bool(
             self._spread_enabled and self._anti_enabled
             and (eb.pf.anti_req_group[:L_b] >= 0).any())
-        spread_dev = (_pack_spread(decision.spread_pre, decision.spread_dom,
-                                   decision.spread_min, decision.scan_groups)
-                      if needs_arb else None)
+        spread_dev = (self._spread_payload(decision) if needs_arb
+                      else None)
         # Dispatch returns before the device finishes (jax async); the
         # first np.asarray in _resolve_batch blocks. Splitting the two
         # reveals whether step time is host→device feeding or device
@@ -1175,6 +1524,7 @@ class Scheduler:
         inf.vol_memo, inf.fail_closed = vol_memo, fail_closed
         inf.eb, inf.names, inf.row_incs = eb, names, row_incs
         inf.nf, inf.af, inf.key, inf.sample_k = nf, af, key, sample_k
+        inf.res_carried = carried
         inf.decision = decision
         inf.packed_dev, inf.spread_dev = packed_dev, spread_dev
         inf.t0, inf.t_encode = t0, t_encode
@@ -1214,14 +1564,20 @@ class Scheduler:
         # dispatch and this fetch; stamping the fetch start keeps that
         # host-side gap out of the step metric (it books as gap time).
         inf.t_fetch_start = time.perf_counter()
-        packed = np.array(inf.packed_dev)  # writable: residual merge below
-        chosen = packed[0]
-        assigned = packed[1].astype(bool)
-        gang_rejected = packed[2].astype(bool)
-        feasible = packed[3]
-        feasible_static = packed[4]
-        rejects = packed[5:]
-        sp = (np.array(spread_dev) if spread_dev is not None else None)
+        (chosen, assigned, gang_rejected, feasible, feasible_static,
+         rejects) = self._fetch_decision(
+            inf.packed_dev, eb.pf.valid.shape[0],
+            decision.reject_counts.shape[0], decision)
+        sp = self._fetch_spread(spread_dev)
+        if inf.res_carried:
+            # Replay the MAIN step's device debits into the host mirror
+            # and adopt free_after as the carried next-batch input —
+            # before the residual merge mutates chosen/assigned (the
+            # carried array is the main step's output; residual/repair
+            # placements reach the device as next-batch corrections).
+            self._residency.note_debits(chosen, assigned,
+                                        eb.pf.requests,
+                                        decision.free_after)
 
         if sample_k is not None:
             # Residual pass: a pod with zero feasible nodes IN THE SAMPLE
@@ -1538,7 +1894,7 @@ class Scheduler:
             nf_p, names_p, sv_p, _incs_p = self.cache.snapshot_versioned(
                 pad=self._node_pad,
                 known_static=cached[0] if cached else None)
-            nf_p = self._with_device_static(nf_p, sv_p)
+            nf_p = self._with_device_static(nf_p, sv_p, _incs_p.shape[0])
             won = self._try_preempt(
                 batch, preempt_rows, eb, nf_p,
                 self.cache.snapshot_assigned(pad=self._af_pad), names_p)
@@ -1715,12 +2071,18 @@ class Scheduler:
         batch with hard rows the in-scan caps did not enforce pays the
         transfer."""
         sp_p = decision.spread_pre.shape[0]
+
+        def exact_tables():
+            cd = np.asarray(decision.spread_cdom)
+            de = np.asarray(decision.spread_dexist)
+            self._count_fetch(cd.nbytes + de.nbytes)
+            return cd, de
+
         return arbitrate_spread(
             batch, assigned, eb.pf, eb.gf,
             sp[:sp_p], sp[sp_p:2 * sp_p].astype(np.int32), sp[2 * sp_p],
             dead=dead, anti_enabled=self._anti_enabled,
-            exact_tables=lambda: (np.asarray(decision.spread_cdom),
-                                  np.asarray(decision.spread_dexist)),
+            exact_tables=exact_tables,
             scan_enforced=sp[2 * sp_p + 1].astype(bool))
 
     def _node_pad(self, hw: int) -> int:
@@ -1793,18 +2155,19 @@ class Scheduler:
         (decision.free_after is full-size under sampling)."""
         n_res = len(rows)
         eb2, P2 = self._slice_eb(eb, rows)
-        nf2 = nf._replace(free=np.asarray(decision.free_after))
+        free2 = np.asarray(decision.free_after)
+        self._count_fetch(free2.nbytes)
+        nf2 = nf._replace(free=free2)
         d2: Decision = self._step(eb2, nf2, af,
                                   jax.random.fold_in(key, 0x5e5))
-        p2 = np.asarray(_pack_decision(
-            d2.chosen, d2.assigned, d2.gang_rejected,
-            d2.feasible_counts, d2.feasible_static, d2.reject_counts))
-        chosen[rows] = p2[0][:n_res]
-        assigned[rows] = p2[1][:n_res].astype(bool)
-        gang_rejected[rows] = p2[2][:n_res].astype(bool)
-        feasible[rows] = p2[3][:n_res]
-        feasible_static[rows] = p2[4][:n_res]
-        rejects[:, rows] = p2[5:][:, :n_res]
+        (ch2, as2, gr2, fc2, fs2, rj2) = self._fetch_decision(
+            self._pack_dec(d2), P2, d2.reject_counts.shape[0], d2)
+        chosen[rows] = ch2[:n_res]
+        assigned[rows] = as2[:n_res]
+        gang_rejected[rows] = gr2[:n_res]
+        feasible[rows] = fc2[:n_res]
+        feasible_static[rows] = fs2[:n_res]
+        rejects[:, rows] = rj2[:, :n_res]
         if sp is not None:
             # Only the per-pod pre/dom rows merge; the batch's
             # spread_min/scan_groups rows stay as the MAIN step computed
@@ -1814,9 +2177,7 @@ class Scheduler:
             # are advisory.
             assert not decision.scan_groups.any(), \
                 "residual merge on a hard-spread (scan-enforced) batch"
-            sp2 = np.asarray(_pack_spread(
-                d2.spread_pre, d2.spread_dom, d2.spread_min,
-                d2.scan_groups))
+            sp2 = self._fetch_spread(self._spread_payload(d2))
             sp_p = decision.spread_pre.shape[0]
             if d2.spread_pre.shape[0]:
                 sp[rows] = sp2[:P2][:n_res]
@@ -1854,7 +2215,8 @@ class Scheduler:
                 pad=self._node_pad,
                 known_static=cached[0] if cached else None)
             af = self.cache.snapshot_assigned(pad=self._af_pad)
-            nf = self._with_device_static(nf, static_v)
+            nf = self._with_device_static(nf, static_v,
+                                          row_incs.shape[0])
             if self._nominations:
                 reserved = self._nomination_debits(
                     {batch[i].pod.key for i in rows}, names, nf)
@@ -1870,16 +2232,13 @@ class Scheduler:
             self._step_counter += 1
             d2 = step_fn(eb2, nf, af,
                          jax.random.fold_in(self._key, self._step_counter))
-            p2 = np.asarray(_pack_decision(
-                d2.chosen, d2.assigned, d2.gang_rejected,
-                d2.feasible_counts, d2.feasible_static, d2.reject_counts))
+            (chosen2, assigned2, _gr2, _fc2, _fs2, _rj2) = (
+                self._fetch_decision(self._pack_dec(d2),
+                                     eb2.pf.valid.shape[0],
+                                     d2.reject_counts.shape[0], d2))
             n_r = len(rows)
-            chosen2 = p2[0]
-            assigned2 = p2[1].astype(bool)
             sub = [batch[i] for i in rows]
-            sp2 = np.asarray(_pack_spread(
-                d2.spread_pre, d2.spread_dom, d2.spread_min,
-                d2.scan_groups))
+            sp2 = self._fetch_spread(self._spread_payload(d2))
             rev2 = self._arbitrate_packed(
                 sub, assigned2, eb2, d2, sp2, dead=set())
             items, req_rows, next_rows = [], [], []
@@ -2393,41 +2752,45 @@ class Scheduler:
         f for f in NodeFeatures._fields
         if f not in NodeFeatureCache.DYNAMIC_NF_FIELDS)
 
-    def _with_device_static(self, nf, static_version: int):
+    def _with_device_static(self, nf, static_version: int, pad: int):
         """Swap the static node-feature leaves for device-resident copies
         cached per (static_version, pad). The per-batch host→device
         transfer then carries only free/used_ports (~a few MB) instead of
         the full ~tens-of-MB snapshot — on a remote-TPU tunnel the full
-        upload is a fixed cost of every engine step.
+        upload is a fixed cost of every engine step. (With dynamic
+        residency live — _DeviceResidency — even those leaves stay on
+        device and only sparse corrections move.)
 
-        On a cache hit the snapshot's static leaves are None (the cache
-        elided their host copies — snapshot_versioned(known_static=...));
-        on a miss they are real arrays to upload. The leaves can never be
-        None on a miss: the cache elides only when the caller-supplied key
-        equals the key computed here."""
-        key = (static_version, nf.free.shape[0])
+        ``pad`` is the snapshot's resolved node pad (the incarnation
+        column's length — reliable even when every array leaf was
+        elided). On a cache hit the snapshot's static leaves are None
+        (the cache elided their host copies —
+        snapshot_versioned(known_static=...)); on a miss they are real
+        arrays to upload. The leaves can never be None on a miss: the
+        cache elides only when the caller-supplied key equals the key
+        computed here."""
+        key = (static_version, pad)
         cached = self._nf_static_device
         if cached is None or cached[0] != key:
             leaves = {name: jax.device_put(getattr(nf, name),
-                                           self._static_sharding(name))
+                                           self._nf_sharding(name))
                       for name in self._STATIC_NF_FIELDS}
             self._nf_static_device = cached = (key, leaves)
+            self._count_h2d(sum(getattr(nf, name).nbytes
+                                for name in self._STATIC_NF_FIELDS))
         return nf._replace(**cached[1])
 
-    def _static_sharding(self, name: str):
-        """Placement for a cached static node-feature leaf: the mesh's
-        canonical node-axis sharding in multi-chip mode (so the cached
-        copy already matches the sharded step's in_shardings — no
-        per-batch reshard), None (default device) otherwise."""
+    def _nf_sharding(self, name: str):
+        """Placement for a device-resident node-feature leaf (static or
+        dynamic): the mesh's canonical node-axis sharding in multi-chip
+        mode (so the resident copy already matches the sharded step's
+        in_shardings — no per-batch reshard), None (default device)
+        otherwise."""
         if self._mesh is None:
             return None
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import leaf_sharding
 
-        from ..parallel.mesh import NODE_AXIS
-
-        if name == "topo_domains":  # leading dim is the key registry
-            return NamedSharding(self._mesh, P(None, NODE_AXIS))
-        return NamedSharding(self._mesh, P(NODE_AXIS))
+        return leaf_sharding(self._mesh, name)
 
     def metrics(self) -> Dict[str, float]:
         """Cumulative and last-batch scheduling metrics plus current queue

@@ -1,3 +1,5 @@
 from .gang import GangResult, gang_assign  # noqa: F401
 from .pipeline import Decision, build_step  # noqa: F401
+from .residency import (apply_rows, pack_decision_slim,  # noqa: F401
+                        unpack_decision_slim)
 from .select import greedy_assign  # noqa: F401

@@ -99,6 +99,20 @@ def node_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(NODE_AXIS))
 
 
+def leaf_sharding(mesh: Mesh, name: str) -> NamedSharding:
+    """Canonical placement of ONE NodeFeatures leaf by field name: the
+    node axis shards the leading dim — except ``topo_domains``, whose
+    leading dim is the topology-key registry (node axis is axis 1).
+    Used for every device-RESIDENT leaf the engine caches across
+    batches (static leaves since PR 1; the dynamic ``free``/
+    ``used_ports`` under MINISCHED_DEVICE_RESIDENT) so resident copies
+    land pre-partitioned exactly as the sharded step's in_shardings
+    expect — no per-batch reshard."""
+    if name == "topo_domains":
+        return NamedSharding(mesh, P(None, NODE_AXIS))
+    return NamedSharding(mesh, P(NODE_AXIS))
+
+
 def pod_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(POD_AXIS))
 

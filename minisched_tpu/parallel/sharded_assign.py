@@ -35,7 +35,22 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+# jax moved shard_map out of experimental (and renamed the replication-
+# check kwarg check_rep → check_vma) around 0.6; this shim presents the
+# modern surface on both so the mesh path works on either toolchain —
+# without it, every mesh-engine entry point dies at import on jax 0.4/0.5.
+try:
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_vma)
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
 from jax.sharding import PartitionSpec as P
 
 from ..ops.gang import GangResult, gang_admission

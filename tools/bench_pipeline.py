@@ -51,7 +51,9 @@ def fail_flush_phase(n: int, p: int) -> dict:
         cfg = SchedulerConfig(
             max_batch_size=p, batch_window_s=5.0,
             backoff_initial_s=30.0, backoff_max_s=30.0,
-            pipeline=os.environ.get("MINISCHED_PIPELINE", "1") != "0")
+            pipeline=os.environ.get("MINISCHED_PIPELINE", "1") != "0",
+            device_resident=os.environ.get(
+                "MINISCHED_DEVICE_RESIDENT", "1") != "0")
         sched = svc.start_scheduler(
             Profile(name="bench",
                     plugins=["NodeUnschedulable", "NodeResourcesFit"],

@@ -12,9 +12,18 @@ can be attributed instead of guessed at:
 Phases reported per shape:
   step_s        one warm jitted step, block on chosen (device compute)
   pack_fetch_s  _pack_decision dispatch + (5+F, P) i32 host fetch
+  slim_fetch_s  pack_decision_slim dispatch + (B,) u8 host fetch — the
+                default engine readback (MINISCHED_DEVICE_RESIDENT=1)
   sp_fetch_s    _pack_spread dispatch + (2P+2, G) f32 host fetch
   cdom_fetch_s  the (G,D) exact-table transfer (hard-spread batches that
                 the in-scan caps could not enforce pay this)
+
+Plus a per-batch transfer table (h2d = what each engine batch uploads,
+d2h = what it fetches) for both MINISCHED_DEVICE_RESIDENT modes, so the
+residency/slim-readback byte claim is verifiable on CPU without TPU
+hardware: the resident mode's steady-state h2d is the sparse correction
+delta (0 bytes when nothing diverged), vs the full free/used_ports
+matrices every batch in fallback mode.
 
 Run it whenever the engine's measured step_s diverges from the raw-step
 bench phase — the delta must be explainable by the fetch lines. Uses
@@ -123,9 +132,27 @@ def main() -> None:
             prev = dt
 
     d = timed("step_s", lambda: step(eb, nf, af, key))
-    timed("pack_fetch_s", lambda: np.array(_pack_decision(
+    legacy = timed("pack_fetch_s", lambda: np.array(_pack_decision(
         d.chosen, d.assigned, d.gang_rejected, d.feasible_counts,
         d.feasible_static, d.reject_counts)))
+    from minisched_tpu.ops.residency import pack_decision_slim
+
+    slim = timed("slim_fetch_s", lambda: np.array(pack_decision_slim(
+        d.chosen, d.assigned, d.gang_rejected, d.feasible_counts,
+        d.feasible_static, d.reject_counts)))
+    # Per-batch transfer budget, both residency modes (engine counters
+    # measure the same quantities live; this is the shape-exact model):
+    dyn_h2d = nf.free.nbytes + nf.used_ports.nbytes
+    print("h2d/batch dynamic leaves (RESIDENT=0, every batch) = "
+          f"{dyn_h2d} B ({nf.free.nbytes} free + {nf.used_ports.nbytes} "
+          "used_ports)", flush=True)
+    print("h2d/batch residency steady state (RESIDENT=1) = correction "
+          "deltas only; 0 B when no placement was revoked and no "
+          "informer event landed (engine metric h2d_bytes_total)",
+          flush=True)
+    print(f"d2h/batch decision fetch = {slim.nbytes} B slim vs "
+          f"{legacy.nbytes} B i32 ({legacy.nbytes / max(slim.nbytes, 1):.2f}x)",
+          flush=True)
     if d.spread_pre.shape[0]:
         timed("sp_fetch_s", lambda: np.array(_pack_spread(
             d.spread_pre, d.spread_dom, d.spread_min, d.scan_groups)))
