@@ -12,9 +12,9 @@ CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 # tier1 uses pipefail/PIPESTATUS (bash-isms).
 SHELL := /bin/bash
 
-.PHONY: test tier1 profile-smoke start start-remote start-client-engine \
-        demo docs bench bench_sharded bench-cpu bench-pipeline \
-        bench-residency dryrun dryrun-dcn soak
+.PHONY: test tier1 fault-smoke profile-smoke start start-remote \
+        start-client-engine demo docs bench bench_sharded bench-cpu \
+        bench-pipeline bench-residency dryrun dryrun-dcn soak soak-faults
 
 # Unit + integration suite on a virtual 8-device CPU mesh.
 test:
@@ -31,6 +31,15 @@ tier1:
 	rc=$${PIPESTATUS[0]}; \
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' \
 	  /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# Fast robustness smoke (~20 s): the deterministic fault-schedule suite
+# (faults.py + the engine supervisor) — every gate fired at least once,
+# recovered decisions bit-identical to a fault-free run, zero pods lost
+# or doubly bound. Part of tier-1 (tests/test_faults.py); run it alone
+# before shipping engine changes.
+fault-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py -x -q \
+	  -p no:cacheprovider -p no:randomly
 
 # Pass-ladder attribution smoke at CPU shapes (headline + topology
 # profiles): catches step/pass-cost regressions in the marginal-cost
@@ -119,4 +128,16 @@ SOAK_N ?= 5
 soak:
 	@for i in $$(seq 1 $(SOAK_N)); do \
 	  $(CPU_MESH) $(PY) -m pytest tests/test_chaos.py -x -q || exit 1; \
+	done
+
+# Chaos soak under a low AMBIENT fault rate (the faulted churn variant
+# in tests/test_chaos.py): each iteration reseeds the fault PRNG so
+# successive runs land faults on different race interleavings, while
+# any failing iteration replays exactly from its seed
+# (MINISCHED_FAULT_SEED=<i>).
+soak-faults:
+	@for i in $$(seq 1 $(SOAK_N)); do \
+	  echo "soak-faults iteration $$i (MINISCHED_FAULT_SEED=$$i)"; \
+	  MINISCHED_FAULT_SEED=$$i $(CPU_MESH) $(PY) -m pytest \
+	    tests/test_chaos.py -x -q || exit 1; \
 	done

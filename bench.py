@@ -1070,6 +1070,23 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                 # the skew-convergence diagnostic (how much work the
                 # arbitration threw back)
                 f"{prefix}_failed_attempts": int(m["pods_failed"]),
+                # Robustness provenance (engine supervisor + fault
+                # gates): a clean artifact proves the fast paths ran
+                # undegraded end-to-end — "resident" state, zero fault
+                # fires, zero watchdog trips — so a wedged-probe
+                # fallback is distinguishable from an injected fault.
+                f"{prefix}_degradation_state":
+                    m.get("degradation_state", "resident"),
+                f"{prefix}_fault_fires": int(sum(
+                    v for k, v in m.items()
+                    if k.startswith("fault_fires_"))),
+                f"{prefix}_batch_faults": int(m.get("batch_faults", 0)),
+                f"{prefix}_watchdog_trips":
+                    int(m.get("watchdog_trips", 0)),
+                f"{prefix}_escalations":
+                    int(m.get("supervisor_escalations", 0)),
+                f"{prefix}_quarantined":
+                    int(m.get("quarantined_batches", 0)),
             }
     return out
 

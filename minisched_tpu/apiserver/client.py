@@ -31,9 +31,24 @@ from typing import Any, List, Optional, Tuple
 
 from ..errors import (AlreadyExistsError, ConflictError, NotFoundError,
                       UnauthorizedError, WatchFellBehindError)
+from ..faults import FAULTS, FaultInjected
 from ..state import objects as obj
+from ..utils.retry import jittered_delays
 
 log = logging.getLogger(__name__)
+
+
+class _ServerError(RuntimeError):
+    """A non-2xx the generic handler folds to RuntimeError, carrying the
+    structured status/reason so the transient-retry policy can
+    discriminate (a 503 drain reject is provably-unapplied; a 500 on a
+    mutation is not). Stays a RuntimeError: callers that caught the old
+    generic error keep working."""
+
+    def __init__(self, status: int, msg: str, reason=None):
+        super().__init__(f"apiserver {status}: {msg}")
+        self.status = status
+        self.reason = reason
 
 
 class _TokenBucket:
@@ -69,8 +84,18 @@ class _TokenBucket:
 class RemoteStore:
     def __init__(self, address: str, timeout: float = 10.0,
                  token: Optional[str] = None,
-                 qps: float = 5000.0, burst: int = 5000):
+                 qps: float = 5000.0, burst: int = 5000,
+                 retry_deadline_s: float = 5.0):
+        """``retry_deadline_s``: transient failures (connection refused/
+        reset, 5xx, malformed frames) are retried with jittered
+        exponential backoff until this much wall time has passed, then
+        the last error propagates — so a server restart or a blip on the
+        wire does not fail the first engine call that hits it. 0
+        disables (every failure propagates immediately, the pre-retry
+        behavior). Mutating verbs only retry failures that provably
+        precede application (see _transient)."""
         self.address = address.rstrip("/")
+        self.retry_deadline_s = retry_deadline_s
         u = urllib.parse.urlparse(self.address)
         if u.scheme not in ("http", "https"):
             raise ValueError(f"unsupported scheme in {address!r}; "
@@ -146,8 +171,69 @@ class RemoteStore:
                 raise
         raise AssertionError("unreachable")
 
+    # Wire faults retried as transient when the exchange provably did
+    # not apply (connect refused: nothing was ever sent) or the verb is
+    # idempotent. Everything mid-exchange on a mutation stays the
+    # caller's ambiguity, exactly as _request documents.
+    _SAFE_CONN_ERRORS = (ConnectionRefusedError,)
+    _WIRE_ERRORS = (http.client.HTTPException, OSError)
+
+    def _transient(self, e: Exception, method: str) -> bool:
+        """Is this failure safe to retry for this verb? GETs: any wire
+        fault, malformed frame, or 5xx. Mutations: only failures that
+        provably precede application — connection refused (connect()
+        failed; no bytes sent) and the server's 503 drain/unavailable
+        reject (answered without touching the store). An injected
+        ``http`` gate fault counts as transient for every verb: the gate
+        models the wire eating the request, and absorbing it is the
+        behavior the gate exists to prove."""
+        if isinstance(e, FaultInjected):
+            return True
+        if isinstance(e, self._SAFE_CONN_ERRORS):
+            return True
+        if isinstance(e, _ServerError):
+            if 500 <= e.status < 600:
+                return (method == "GET" or e.status == 503
+                        or e.reason == "ServiceUnavailable")
+            return False
+        if method != "GET":
+            return False
+        if isinstance(e, self._WIRE_ERRORS):
+            return True
+        # the malformed-JSON transport error is a bare RuntimeError
+        return type(e) is RuntimeError
+
     def _call(self, method: str, path: str, body=None,
               timeout: Optional[float] = None, _retries: int = 2):
+        """One logical API call with transient-failure absorption:
+        jittered exponential backoff (utils/retry.py jittered_delays)
+        bounded by ``retry_deadline_s`` wall time — a flaky server fails
+        an engine verb only when it stays broken past the deadline, not
+        on the first blip."""
+        deadline = (time.monotonic() + self.retry_deadline_s
+                    if self.retry_deadline_s > 0 else None)
+        delays = jittered_delays(initial_duration=0.05, factor=2.0,
+                                 max_duration=1.0)
+        while True:
+            try:
+                FAULTS.hit("http")  # fault gate: RemoteStore HTTP
+                return self._call_once(method, path, body=body,
+                                       timeout=timeout, _retries=_retries)
+            except (NotFoundError, UnauthorizedError, AlreadyExistsError,
+                    ConflictError, WatchFellBehindError):
+                raise  # typed API verdicts are answers, not failures
+            except Exception as e:
+                now = time.monotonic()
+                if (deadline is None or now >= deadline
+                        or not self._transient(e, method)):
+                    raise
+                sleep = min(next(delays), max(0.0, deadline - now))
+                log.warning("transient apiserver failure (%s %s: %s); "
+                            "retrying in %.2fs", method, path, e, sleep)
+                time.sleep(sleep)
+
+    def _call_once(self, method: str, path: str, body=None,
+                   timeout: Optional[float] = None, _retries: int = 2):
         if self._limiter is not None:
             self._limiter.take()
         data = (None if body is None
@@ -189,8 +275,8 @@ class RemoteStore:
             except ValueError:
                 delay = 1.0
             time.sleep(delay)
-            return self._call(method, path, body=body, timeout=timeout,
-                              _retries=_retries - 1)
+            return self._call_once(method, path, body=body,
+                                   timeout=timeout, _retries=_retries - 1)
         if status == 409:
             # the server folds AlreadyExists and Conflict into 409
             # and disambiguates with a structured ``reason`` field
@@ -202,7 +288,7 @@ class RemoteStore:
             raise ConflictError(msg) from None
         if status == 410:
             raise WatchFellBehindError(msg) from None
-        raise RuntimeError(f"apiserver {status}: {msg}")
+        raise _ServerError(status, msg, reason)
 
     # ---- store verbs ----------------------------------------------------
 

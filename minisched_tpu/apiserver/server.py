@@ -396,6 +396,17 @@ def _make_handler(store: ClusterStore, token: str | None = None,
             emit("minisched_store_watch_log_depth", st["watch_log_depth"])
             emit("minisched_store_watch_log_capacity",
                  st["watch_log_capacity"])
+            # Process-wide fault-gate fire counts (faults.py): gates
+            # outside any engine (http, checkpoint, informer) would be
+            # invisible to the engine providers' metrics; one scrape
+            # covers the whole failure domain. All-zero = the run was
+            # provably fault-free.
+            from ..faults import FAULTS as _faults
+
+            lines.append("# TYPE minisched_fault_fires_total counter")
+            for gate, n in sorted(_faults.counts().items()):
+                lines.append(
+                    f'minisched_fault_fires_total{{gate="{gate}"}} {n}')
             for provider in (metrics_providers or ()):
                 try:
                     for k, v in provider().items():

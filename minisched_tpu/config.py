@@ -110,6 +110,26 @@ class SchedulerConfig:
     # latency; repair iterations drain the same tranches within one
     # cycle. 0 disables.
     spread_repair_iters: int = 8
+    # Engine supervisor (engine/scheduler.py _Supervisor): per-batch
+    # device-step watchdog deadline in seconds — a batch whose
+    # dispatch→fetch window exceeds it counts a watchdog trip and
+    # degrades the engine one ladder rung (the step completed; nothing
+    # is retried). 0 disables the deadline; fault/NaN/desync detection
+    # and the degradation ladder stay active regardless.
+    watchdog_s: float = 0.0
+    # Probation length for the degradation ladder: after this many
+    # consecutive CLEAN batches at a degraded level, the supervisor
+    # re-escalates one rung back toward the full fast path
+    # (resident → upload-every-batch → synchronous → quarantine).
+    probation_batches: int = 8
+    # Residency carry cross-check (ROADMAP follow-up (b)): every N
+    # device-resident batches, fetch the device-carried free array and
+    # compare it to the host mirror BEFORE the step consumes it; a
+    # mismatch counts a desync, forces a full re-upload, and signals the
+    # supervisor. 0 disables (the versioned delta protocol already makes
+    # host-side desync structurally impossible — this check covers the
+    # DEVICE side of the carry, e.g. a defective scatter/backend).
+    resident_check_every: int = 0
 
 
 def config_from_env() -> SchedulerConfig:
@@ -152,5 +172,9 @@ def config_from_env() -> SchedulerConfig:
             _req("MINISCHED_PCT_NODES_TO_SCORE", "0")),
         pipeline=_req("MINISCHED_PIPELINE", "1") != "0",
         device_resident=_req("MINISCHED_DEVICE_RESIDENT", "1") != "0",
+        watchdog_s=float(_req("MINISCHED_WATCHDOG", "0.0")),
+        probation_batches=int(_req("MINISCHED_PROBATION_BATCHES", "8")),
+        resident_check_every=int(
+            _req("MINISCHED_RESIDENT_CHECK_EVERY", "0")),
         mesh=mesh,
     )

@@ -204,6 +204,22 @@ class SchedulingQueue:
             qpi.last_failure_at = time.monotonic()
             self._push_backoff(qpi)
 
+    def quarantine(self, qpi: QueuedPodInfo) -> None:
+        """Quarantine-and-requeue (the supervisor's bottom ladder rung):
+        park the pod on the backoff heap at the FULL backoff ceiling
+        regardless of its attempt count — a batch that exhausted the
+        degradation ladder gets the cluster a maximal quiet window
+        before it re-forms, while still guaranteeing the pods return
+        (never lost, unlike a terminal unschedulable park which needs a
+        reviving event)."""
+        with self._cond:
+            if not self._may_requeue(qpi):
+                return
+            qpi.attempts += 1
+            qpi.last_failure_at = time.monotonic()
+            self._push_backoff(
+                qpi, ready=qpi.last_failure_at + self._backoff_max)
+
     def requeue_failures(self, retryable: List[QueuedPodInfo],
                          unsched: List[tuple]) -> None:
         """Bulk failure requeue: one lock acquisition for a whole commit
@@ -398,11 +414,15 @@ class SchedulingQueue:
         # genuinely quiescent, not merely between condvar wakeups.
         self._arrival_seq += 1
 
-    def _push_backoff(self, qpi: QueuedPodInfo) -> None:
-        """Push onto the backoff heap and index (caller holds the lock)."""
+    def _push_backoff(self, qpi: QueuedPodInfo,
+                      ready: Optional[float] = None) -> None:
+        """Push onto the backoff heap and index (caller holds the lock).
+        ``ready`` overrides the attempt-derived backoff expiry
+        (quarantine pins it at the ceiling)."""
         qpi.where, qpi.gone = "backoff", False
         self._index[qpi.key] = qpi
-        ready = qpi.last_failure_at + self._backoff_duration(qpi)
+        if ready is None:
+            ready = qpi.last_failure_at + self._backoff_duration(qpi)
         heapq.heappush(self._backoff, (ready, next(self._seq), qpi))
         self._backoff_live += 1
 

@@ -33,6 +33,7 @@ from ..encode import NodeFeatureCache, encode_pods
 from ..encode.cache import bucket_for, step_bucket
 from ..encode.features import NodeFeatures
 from ..errors import ConflictError, NotFoundError
+from ..faults import FAULTS, FaultWorkerDeath
 from ..ops.pipeline import Decision, build_step
 from ..ops.residency import (I16_SAT, apply_rows, apply_rows_bytes,
                              pack_decision_slim, unpack_decision_slim)
@@ -52,6 +53,76 @@ _SPREAD_REVOKE_MSG = (
     "anti-affinity) within this batch; retrying against committed counts")
 
 
+class EngineDesync(RuntimeError):
+    """A supervisor DETECTOR verdict — the engine's view of device state
+    failed a sanity/cross check (decision readback out of range,
+    non-finite capacity after a device-debit replay, resident carry
+    diverged from the host mirror). Contained like any batch fault:
+    rollback, degrade, retry."""
+
+
+#: The supervisor's degradation ladder, fastest first. Level indexes it.
+DEGRADATION_LADDER = ("resident", "upload", "sync", "quarantine")
+
+
+class _Supervisor:
+    """Fault detection + containment state for one engine.
+
+    The engine's fast paths (device-resident carry, two-deep pipeline)
+    are retried down a counted degradation ladder when a batch faults:
+
+        0 resident    full fast path (device residency + pipeline)
+        1 upload      residency dropped; every batch uploads dynamic
+                      leaves (the MINISCHED_DEVICE_RESIDENT=0 shape)
+        2 sync        additionally no pipelining: one batch at a time,
+                      prepare→resolve→commit inline (MINISCHED_PIPELINE=0
+                      shape)
+        3 quarantine  the poisoned batch is requeued at the backoff
+                      ceiling instead of retried; subsequent traffic
+                      keeps running at the sync rung
+
+    ``level`` is written ONLY on the scheduling thread (resolve,
+    supervised retry, commit-await) — the one thread that also reads it
+    for gating — so it needs no lock; counters live in the engine's
+    metrics dict under its lock. After ``probation_batches`` consecutive
+    clean batches at a degraded level the supervisor re-escalates one
+    rung back toward the full fast path."""
+
+    __slots__ = ("_sched", "level", "_clean")
+
+    def __init__(self, sched: "Scheduler"):
+        self._sched = sched
+        self.level = 0
+        self._clean = 0
+
+    def allows_residency(self) -> bool:
+        return self.level == 0
+
+    def sync_only(self) -> bool:
+        return self.level >= 2
+
+    def escalate(self, reason: str) -> None:
+        self._clean = 0
+        if self.level >= len(DEGRADATION_LADDER) - 1:
+            return
+        self.level += 1
+        self._sched._sup_count("supervisor_escalations")
+        log.warning("supervisor: degraded to %r (%s)",
+                    DEGRADATION_LADDER[self.level], reason)
+
+    def note_clean(self) -> None:
+        """One batch resolved with no fault. Probation bookkeeping."""
+        if self.level == 0:
+            return
+        self._clean += 1
+        if self._clean >= max(1, self._sched.config.probation_batches):
+            self._clean = 0
+            self.level -= 1
+            self._sched._sup_count("supervisor_recoveries")
+            log.info("supervisor: probation passed; re-escalated to %r",
+                     DEGRADATION_LADDER[self.level])
+
+
 class _InflightBatch:
     """One batch moving through the prepare → resolve → commit phases of
     the engine cycle (Scheduler._run_pipelined). Slots keep field drift
@@ -62,10 +133,17 @@ class _InflightBatch:
                  "packed_dev", "spread_dev", "failures", "n_assigned",
                  "shapes", "seq", "t0", "t_encode", "t_dispatch",
                  "t_fetch_start", "t_step", "t_resolved", "commit_t0",
-                 "commit_t1", "res_carried")
+                 "commit_t1", "res_carried", "assumed", "detached")
 
     def __init__(self):
         self.failures: List[tuple] = []  # (qpi, plugins, message, retryable)
+        # Supervisor rollback ledger: pod key → qpi for every assume this
+        # batch made that is still the batch's to reverse; keys move to
+        # ``detached`` once handed to an async owner (binder bulk commit,
+        # permit wait) — an aborted batch unassumes ``assumed`` and its
+        # supervised retry excludes ``detached``.
+        self.assumed: Dict[str, QueuedPodInfo] = {}
+        self.detached: Set[str] = set()
         self.seq = 0
         self.n_assigned = 0
         self.shapes = (0, 0, 0)
@@ -254,6 +332,13 @@ class _DeviceResidency:
             # Unbuffered subtract applies per index IN ORDER — the same
             # f32 op sequence as the scan's sequential carry.
             np.subtract.at(self.mirror_free, rows, reqs)
+            if not np.isfinite(self.mirror_free[uniq]).all():
+                # Supervisor NaN detector: a non-finite request/feature
+                # reached the carried chain — abort before the poisoned
+                # mirror is trusted (the batch retries with residency
+                # dropped, which also resets these mirrors).
+                raise EngineDesync(
+                    "non-finite free capacity after device-debit replay")
         else:
             self.pending_rows = self.pending_pre = None
         self.free_dev = free_after_dev
@@ -737,6 +822,7 @@ class Scheduler:
                                  assignment=self.config.assignment))
         self._key = jax.random.PRNGKey(self.config.seed)
         self._step_counter = 0
+        self._prep_step0 = 0  # supervisor replay anchor (see _prepare_batch)
         self._batch_seq = 0  # prepare-order sequence (scheduling thread)
         self.waiting_pods: Dict[str, WaitingPod] = {}
         self._waiting_lock = threading.Lock()
@@ -836,6 +922,28 @@ class Scheduler:
         if self.config.device_resident and self.config.assignment == "greedy":
             self._residency = _DeviceResidency(
                 self.cache.register_dyn_listener())
+        # Engine supervisor: watchdog + fault/NaN/desync detection +
+        # the counted degradation ladder (see _Supervisor). Level state
+        # is scheduling-thread-only; counters ride _metrics.
+        self._sup = _Supervisor(self)
+        # Resolve-phase assume ledger (rollback on abort): the inflight
+        # batch currently in resolve on the scheduling thread, thread-
+        # gated exactly like _fail_sink.
+        self._track: Optional[_InflightBatch] = None
+        # Pods CURRENTLY owned by an async owner (binder bulk commit,
+        # permit wait): added at hand-off, removed when the owner
+        # concludes (bound / requeued / forgotten). A supervised retry
+        # strips these before EVERY attempt — an _InflightBatch.detached
+        # set only covers the attempt that built it, but a pod can be
+        # handed off by any attempt (including the synchronous cycle,
+        # which exposes no inflight to the outer handler) and
+        # re-scheduling it would double-assume and race the owner's
+        # bind. Lock-guarded: owners conclude on binder threads.
+        self._detached_live: Set[str] = set()
+        self._detached_lock = threading.Lock()
+        # Residency carry cross-check cadence counter
+        # (config.resident_check_every; scheduling thread only).
+        self._res_check_tick = 0
         # Armed trace request (see trace_next_batch). The lock covers the
         # arm/consume pair: an unlocked read-then-clear swap on the
         # scheduling thread could clobber a concurrent arm with None.
@@ -880,7 +988,21 @@ class Scheduler:
             # resync (full re-upload) counters.
             "h2d_bytes_total": 0.0, "fetch_bytes_total": 0.0,
             "residency_hits": 0, "residency_resyncs": 0,
+            # Supervisor / robustness observability: detected batch
+            # faults and the inline degraded retries they triggered,
+            # watchdog deadline trips, ladder transitions, batches
+            # requeued at the quarantine rung, simulated/real commit
+            # worker deaths, and the residency carry cross-check's
+            # run/trip counters (MINISCHED_RESIDENT_CHECK_EVERY).
+            "batch_faults": 0, "batch_retries": 0, "watchdog_trips": 0,
+            "supervisor_escalations": 0, "supervisor_recoveries": 0,
+            "quarantined_batches": 0, "worker_deaths": 0,
+            "resident_checks": 0, "residency_desyncs": 0,
         }
+
+    def _sup_count(self, key: str, n: int = 1) -> None:
+        with self._metrics_lock:
+            self._metrics[key] += n
 
     def _res_count(self, *, resync: bool, h2d: int) -> None:
         with self._metrics_lock:
@@ -893,6 +1015,26 @@ class Scheduler:
     def _count_fetch(self, nbytes: int) -> None:
         with self._metrics_lock:
             self._metrics["fetch_bytes_total"] += nbytes
+
+    def _check_resident_carry(self, res: "_DeviceResidency", nf) -> None:
+        """Every ``resident_check_every`` carried batches, fetch the
+        device-carried free array and compare it to the host replay
+        mirror BEFORE the step consumes it (ROADMAP residency follow-up
+        (b): the slim cross-check covered the readback, not the carry).
+        Raises EngineDesync on any divergence — including NaN, which
+        np.array_equal rejects — and the caller resyncs + degrades."""
+        self._res_check_tick += 1
+        if self._res_check_tick % self.config.resident_check_every:
+            return
+        dev = np.asarray(nf.free)
+        self._count_fetch(dev.nbytes)
+        self._sup_count("resident_checks")
+        if res.mirror_free is not None and not np.array_equal(
+                dev, res.mirror_free):
+            bad = int(np.sum(np.any(dev != res.mirror_free, axis=1)))
+            raise EngineDesync(
+                f"device-carried free diverged from the host mirror on "
+                f"{bad} row(s) at epoch {res.epoch}")
 
     def _count_h2d(self, nbytes: int) -> None:
         with self._metrics_lock:
@@ -957,6 +1099,11 @@ class Scheduler:
         when ``decision`` is supplied; a mismatch (exotic backend byte
         order) logs, permanently reverts to the i32 layout, and refetches
         this batch through it — decisions are never at risk."""
+        # Fault gate: slim decision fetch. ``corrupt`` scribbles the
+        # chosen plane with absurd node rows — exercising the sanity
+        # DETECTOR downstream (resolve range check / names indexing),
+        # not just the exception path.
+        act = FAULTS.hit("fetch")
         if isinstance(packed_dev, Decision):
             d = packed_dev
             out = (np.array(d.chosen), np.array(d.assigned),
@@ -965,10 +1112,14 @@ class Scheduler:
                    np.array(d.feasible_static),
                    np.array(d.reject_counts))
             self._count_fetch(sum(a.nbytes for a in out))
+            if act == "corrupt":
+                out[0][:] = 0x7F7F7F7F
             return out
         buf = np.array(packed_dev)  # writable: residual merge mutates
         self._count_fetch(buf.nbytes)
         if not self._slim:
+            if act == "corrupt":
+                buf[0] = 0x7F7F7F7F       # chosen plane → absurd rows
             return (buf[0], buf[1].astype(bool), buf[2].astype(bool),
                     buf[3], buf[4], buf[5:])
         out = unpack_decision_slim(buf, p, f)
@@ -992,6 +1143,12 @@ class Scheduler:
                         decision.gang_rejected, decision.feasible_counts,
                         decision.feasible_static, decision.reject_counts),
                     p, f)
+        if act == "corrupt":
+            # Scribble AFTER the first-batch byte-order cross-check: the
+            # injected corruption must reach the resolve sanity DETECTOR
+            # — on batch 1 it would otherwise be misread as an exotic
+            # backend and silently absorbed by the permanent i32 revert.
+            out[0][:] = 0x7F7F7F7F
         return out
 
     def wants_pod(self, pod: Pod) -> bool:
@@ -1074,9 +1231,8 @@ class Scheduler:
             try:
                 self.schedule_batch(batch)
             except Exception:
-                log.exception("schedule_batch failed; requeueing batch")
-                for qpi in batch:
-                    self.queue.requeue_backoff(qpi)
+                log.exception("schedule_batch failed; engaging supervisor")
+                self._supervised_retry(batch)
             last_done = time.perf_counter()
 
     def _run_pipelined(self) -> None:
@@ -1209,39 +1365,95 @@ class Scheduler:
         (inflight | None, pending)."""
         with self._trace_lock:
             trace_armed = self._trace_dir is not None
-        if trace_armed or "schedule_batch" in self.__dict__:
+        if (trace_armed or "schedule_batch" in self.__dict__
+                or self._sup.sync_only()):
             # A trace request needs the whole cycle inside one profiler
             # scope; an instance-patched schedule_batch (test
             # instrumentation wraps cycles that way) must keep seeing
-            # whole cycles. Both drain the pipeline and run this batch
-            # synchronously.
+            # whole cycles; and at the supervisor's "sync" rung the
+            # engine deliberately runs one batch at a time. All drain
+            # the pipeline and run this batch synchronously.
             pending = self._await_commit(pending)
             try:
                 self.schedule_batch(batch)
             except Exception:
-                log.exception("schedule_batch failed; requeueing batch")
-                for qpi in batch:
-                    self.queue.requeue_backoff(qpi)
+                log.exception("schedule_batch failed; engaging supervisor")
+                self._supervised_retry(batch)
             return None, pending
         try:
             return self._prepare_batch(batch), pending
         except Exception:
-            log.exception("batch prepare failed; requeueing batch")
-            for qpi in batch:
-                self.queue.requeue_backoff(qpi)
+            log.exception("batch prepare failed; engaging supervisor")
+            self._supervised_retry(batch)
             return None, pending
 
     def _resolve_guarded(self, inflight) -> bool:
-        """_resolve_batch with the synchronous loop's failure contract:
-        an exception requeues the whole batch and skips the commit."""
+        """_resolve_batch with the supervisor's failure contract: an
+        exception aborts the batch (assumes already rolled back by
+        _resolve_batch), which then retries down the degradation ladder
+        and skips this pipeline commit."""
         try:
             self._resolve_batch(inflight)
             return True
         except Exception:
-            log.exception("batch resolve failed; requeueing batch")
-            for qpi in inflight.batch:
-                self.queue.requeue_backoff(qpi)
+            log.exception("batch resolve failed; engaging supervisor")
+            self._supervised_retry(inflight.batch, inflight)
             return False
+
+    def _supervised_retry(self, batch: List[QueuedPodInfo],
+                          inf: Optional["_InflightBatch"] = None) -> None:
+        """Contain a batch fault. The aborted attempt's assumes were
+        already rolled back (_resolve_batch) so capacity accounting is
+        exact; pods it handed to async owners (binder bulk commit,
+        permit waits — ``inf.detached``) are excluded, so nothing can
+        double-bind. The remainder retries INLINE down the counted
+        degradation ladder — each escalation drops one fast path — and a
+        batch that still fails at the bottom rung is quarantined:
+        requeued at the backoff ceiling rather than retried, so a poison
+        batch can neither wedge the loop nor lose its pods."""
+        self._sup_count("batch_faults")
+        # The aborted attempt's PRNG anchor (captured before the retry's
+        # own prepare re-anchors it): every replay below rewinds to it,
+        # so the retry draws the SAME randomness the fault-free run
+        # would have — recovered decision streams stay bit-identical.
+        anchor = self._prep_step0
+        retry = list(batch)
+        if inf is not None and inf.detached:
+            retry = [q for q in retry if q.pod.key not in inf.detached]
+        while True:
+            # Strip pods an async owner holds RIGHT NOW — any attempt
+            # (the aborted original, a failed degraded retry, or the
+            # synchronous cycle, whose inflight never reaches this
+            # handler) may have handed pods off before faulting, and
+            # retrying OR quarantining one would double-assume it and
+            # race the owner's bind/requeue. An owner that already
+            # concluded bound or requeued the pod itself — either way
+            # it is not this retry's to replay.
+            with self._detached_lock:
+                live = self._detached_live
+                retry = [q for q in retry if q.pod.key not in live]
+            if not retry:
+                return
+            self._sup.escalate("batch fault")
+            if self._sup.level >= len(DEGRADATION_LADDER) - 1:
+                self._sup_count("quarantined_batches")
+                self._step_counter = anchor  # no decision consumed it
+                for qpi in retry:
+                    self.queue.quarantine(qpi)
+                log.error(
+                    "supervisor: exhausted the degradation ladder; "
+                    "quarantined %d pods (requeued at backoff ceiling)",
+                    len(retry))
+                return
+            self._sup_count("batch_retries")
+            self._step_counter = anchor  # replay, don't advance
+            try:
+                self.schedule_batch(list(retry))
+                return
+            except Exception:
+                log.exception("degraded retry failed at rung %r; "
+                              "escalating further",
+                              DEGRADATION_LADDER[self._sup.level])
 
     def _submit_commit(self, inflight):
         """Hand a resolved batch to the commit worker; inline fallback
@@ -1256,6 +1468,8 @@ class Scheduler:
     def _commit_guarded(self, inflight) -> None:
         try:
             self._commit_batch(inflight)
+        except FaultWorkerDeath:
+            raise  # worker death: _await_commit drains + restarts
         except Exception:
             log.exception("batch commit flush failed")
 
@@ -1270,12 +1484,36 @@ class Scheduler:
             return None
         fut, done = pending
         t0 = time.perf_counter()
-        fut.result()  # _commit_guarded never raises
+        try:
+            fut.result()  # _commit_guarded re-raises only worker death
+        except FaultWorkerDeath:
+            self._restart_commit_worker(done)
+            return None
         waited = time.perf_counter() - t0
         flush = max(0.0, done.commit_t1 - done.commit_t0)
         with self._metrics_lock:
             self._metrics["commit_overlap_s"] += max(0.0, flush - waited)
         return None
+
+    def _restart_commit_worker(self, done: "_InflightBatch") -> None:
+        """Commit worker died mid-flush: replace the executor (worker
+        restart), requeue the dead flush's tranche with backoff (its
+        status writes / events never applied — the pods are popped, so
+        nothing else would ever revive them), and degrade. The pipeline
+        drains through the normal _await_commit bound — the pending slot
+        is cleared here, so the loop continues with a fresh worker."""
+        log.error("commit worker died mid-flush; restarting the worker "
+                  "and requeueing its %d-pod tranche", len(done.failures))
+        self._sup_count("worker_deaths")
+        self._sup.escalate("commit worker death")
+        try:
+            self._committer.shutdown(wait=False)
+        except Exception:
+            pass
+        self._committer = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="commit")
+        for qpi, _plugins, _msg, _retry in done.failures:
+            self.queue.requeue_backoff(qpi)
 
     # ---- one batched scheduling cycle ----------------------------------
 
@@ -1304,7 +1542,19 @@ class Scheduler:
         causality boundaries)."""
         inf = self._prepare_batch(batch)
         self._resolve_batch(inf)
-        self._commit_batch(inf)
+        try:
+            self._commit_batch(inf)
+        except FaultWorkerDeath:
+            # No worker thread to restart in the synchronous cycle —
+            # contain the death like the flush fallback would: requeue
+            # the tranche (retrying the WHOLE batch here would re-schedule
+            # pods the binder already owns) and degrade.
+            log.error("commit flush died in the synchronous cycle; "
+                      "requeueing its %d-pod tranche", len(inf.failures))
+            self._sup_count("worker_deaths")
+            self._sup.escalate("commit flush death")
+            for qpi, _plugins, _msg, _retry in inf.failures:
+                self.queue.requeue_backoff(qpi)
         return inf.decision
 
     def _prepare_batch(self, batch: List[QueuedPodInfo]) -> "_InflightBatch":
@@ -1312,6 +1562,14 @@ class Scheduler:
         Returns with the device executing the batch (JAX async dispatch;
         nothing here blocks on device results), so the pipelined loop can
         overlap the previous batch's commit and the next pop with it."""
+        # Supervisor replay anchor: prepares are strictly sequential on
+        # the scheduling thread (encode-after-arbitration), so at any
+        # batch fault this is the step-counter value the aborted attempt
+        # started from. _supervised_retry rewinds to it, handing the
+        # degraded replay the aborted attempt's PRNG draw — which keeps
+        # the post-recovery decision stream bit-identical to a
+        # fault-free run (tie-breaks fold in the step counter).
+        self._prep_step0 = self._step_counter
         inf = _InflightBatch()
         cfg = self.config
         # Pull queued gang-mates so no batch boundary splits a gang (the
@@ -1409,13 +1667,18 @@ class Scheduler:
         # resident free/used_ports arrays are corrected in place.
         cached = self._nf_static_device
         res = self._residency
-        res_live = res is not None and not self._nominations
+        res_live = (res is not None and not self._nominations
+                    and self._sup.allows_residency())
         if res is not None and not res_live:
             # Nominated-capacity debits modify the step's free input;
             # the carried chain cannot represent a reservation that
             # expires without any cache mutation — fall back to the
             # upload-every-batch path until the reservations drain.
-            res.drop("nominated-capacity reservations outstanding")
+            # Supervisor degradation (level ≥ "upload") drops the carry
+            # the same way; probation re-escalation re-establishes it
+            # through a counted full re-upload.
+            res.drop("nominated-capacity reservations outstanding"
+                     if self._nominations else "supervisor degradation")
         if res_live:
             nf, names, static_v, row_incs, dyn_delta = (
                 self.cache.snapshot_resident(
@@ -1432,11 +1695,39 @@ class Scheduler:
         carried = False
         if res_live:
             try:
+                # Fault gate: residency delta upload/carry. err → the
+                # resync fallback below; corrupt → diverge the HOST
+                # mirror from the device truth so the carry cross-check
+                # (the supervisor's desync detector) has a real defect
+                # to catch.
+                act = FAULTS.hit("residency")
                 nf = res.attach(self, nf, dyn_delta)
                 carried = True
+                if act == "corrupt" and res.mirror_free is not None:
+                    res.mirror_free[0, :] += 1.0
+                if self.config.resident_check_every:
+                    self._check_resident_carry(res, nf)
+            except EngineDesync as e:
+                # ROADMAP residency follow-up (b): the device-carried
+                # free diverged from the host replay mirror — count a
+                # desync, force a full re-upload, and degrade.
+                log.warning("resident carry cross-check failed (%s); "
+                            "forcing a full re-upload", e)
+                self._sup_count("residency_desyncs")
+                self._sup.escalate("resident carry desync")
+                carried = False
+                res.drop("carry cross-check mismatch")
+                cached = self._nf_static_device
+                nf, names, static_v, row_incs = (
+                    self.cache.snapshot_versioned(
+                        pad=self._node_pad,
+                        known_static=cached[0] if cached else None))
+                nf = self._with_device_static(nf, static_v,
+                                              row_incs.shape[0])
             except Exception:
                 log.exception("device residency attach failed; resyncing "
                               "through a full snapshot")
+                carried = False
                 res.drop("attach error")
                 cached = self._nf_static_device
                 nf, names, static_v, row_incs = (
@@ -1496,6 +1787,9 @@ class Scheduler:
             step_fn, sample_k = self._sampled_step(
                 nf.free.shape[0], len(batch), has_gang or hard_spread)
             step_fn = step_fn or self._step
+        # Fault gate: jitted step dispatch (err → supervised retry down
+        # the ladder; stall → lands in the watchdog's step window).
+        FAULTS.hit("step")
         decision: Decision = step_fn(eb, nf, af, key)
         # Pack every per-pod output into ONE device buffer before
         # fetching: on a remote-TPU tunnel each np.asarray is a full
@@ -1547,11 +1841,70 @@ class Scheduler:
         pipelined loop overlaps with the next batch's device step."""
         self._fail_sink = inf.failures
         self._fail_sink_tid = threading.get_ident()
+        self._track = inf
         try:
             self._resolve_batch_impl(inf)
+        except BaseException:
+            # Crash-consistent abort: reverse every assume this batch
+            # made that no async owner took over, so a supervised retry
+            # can never double-debit capacity and an abort never leaks
+            # an assume.
+            self._rollback_assumed(inf)
+            raise
         finally:
             self._fail_sink = None
+            self._track = None
         inf.t_resolved = time.perf_counter()
+        self._watchdog_check(inf)
+        self._sup.note_clean()
+
+    def _rollback_assumed(self, inf: "_InflightBatch") -> None:
+        if not inf.assumed:
+            return
+        n = 0
+        for key in list(inf.assumed):
+            inf.assumed.pop(key, None)
+            try:
+                self.cache.account_unbind(key)
+                n += 1
+            except Exception:  # rollback must reverse the rest regardless
+                log.exception("rollback unassume failed for %s", key)
+        log.warning("rolled back %d assumed placement(s) from an aborted "
+                    "batch", n)
+
+    def _watchdog_check(self, inf: "_InflightBatch") -> None:
+        """Per-batch device-step watchdog: the dispatch→fetch window
+        (minus the pipelined gather gap, same accounting as step_s)
+        exceeding the deadline counts a trip and degrades one rung. The
+        batch itself completed — nothing is retried; the point is that
+        the NEXT batches stop leaning on a path that just took 100× its
+        budget (wedged tunnel, thrashing backend)."""
+        wd = self.config.watchdog_s
+        if not wd:
+            return
+        gather_gap = max(0.0, inf.t_fetch_start - inf.t_dispatch)
+        step_window = (inf.t_step - inf.t_encode) - gather_gap
+        if step_window > wd:
+            self._sup_count("watchdog_trips")
+            self._sup.escalate(
+                f"watchdog: device step took {step_window:.3f}s "
+                f"(deadline {wd}s)")
+
+    def _note_assumed(self, qpi: QueuedPodInfo) -> None:
+        t = self._track
+        if t is not None and threading.get_ident() == self._fail_sink_tid:
+            t.assumed[qpi.pod.key] = qpi
+
+    def _note_detached(self, key: str) -> None:
+        """An async owner (binder bulk commit, permit wait) now owns the
+        pod's placement: it leaves the rollback ledger and is excluded
+        from any supervised retry of this batch."""
+        t = self._track
+        if t is not None and threading.get_ident() == self._fail_sink_tid:
+            t.assumed.pop(key, None)
+            t.detached.add(key)
+            with self._detached_lock:
+                self._detached_live.add(key)
 
     def _resolve_batch_impl(self, inf: "_InflightBatch") -> None:
         batch, pods, eb, names = inf.batch, inf.pods, inf.eb, inf.names
@@ -1568,6 +1921,17 @@ class Scheduler:
          rejects) = self._fetch_decision(
             inf.packed_dev, eb.pf.valid.shape[0],
             decision.reject_counts.shape[0], decision)
+        # Supervisor fetch-sanity detector — BEFORE the residency replay
+        # trusts ``chosen``: a corrupted readback (defective transport,
+        # injected fetch:corrupt) must abort the batch, not poison the
+        # carried mirror or index past the name table.
+        L0 = len(batch)
+        if assigned[:L0].any():
+            ch = chosen[:L0][assigned[:L0]]
+            if int(ch.min()) < 0 or int(ch.max()) >= len(names):
+                raise EngineDesync(
+                    "decision readback failed its sanity check: chosen "
+                    f"node row outside [0, {len(names)})")
         sp = self._fetch_spread(spread_dev)
         if inf.res_carried:
             # Replay the MAIN step's device debits into the host mirror
@@ -1807,6 +2171,10 @@ class Scheduler:
                 lost_rows.extend(assume_rows[m] for m in missed)
                 to_bind = [(q, n) for q, n in to_bind
                            if q.pod.key not in dead_keys]
+            missed_set = set(missed) if missed else ()
+            for j in range(len(assume_items)):
+                if j not in missed_set:
+                    self._note_assumed(batch[assume_rows[j]])
 
         if lost_rows:
             # Post-assume staleness: the scan (and the host replay)
@@ -1914,6 +2282,8 @@ class Scheduler:
             # round-trips the batch design exists to avoid). Still async so
             # the scheduling loop proceeds, like the reference's per-pod
             # binding goroutine (minisched.go:96-112).
+            for q, _n in to_bind:
+                self._note_detached(q.pod.key)
             self._binder.submit(self._bind_many, to_bind)
 
         inf.t_step = t_step
@@ -1937,6 +2307,11 @@ class Scheduler:
         if inf.failures:
             try:
                 self._flush_failures(inf.failures)
+            except FaultWorkerDeath:
+                # Simulated worker death (faults.py commit:die): escapes
+                # every guard so the supervisor's drain/restart path —
+                # not the tranche-requeue fallback — handles it.
+                raise
             except Exception:
                 # A flush error (transient wire failure on a RemoteStore,
                 # store teardown race) must not strand the tranche: the
@@ -2006,6 +2381,7 @@ class Scheduler:
         the bulk verb — RemoteStore), one queue lock hold for the
         requeues. Pods deleted mid-flight are forgotten, exactly like
         the per-pod NotFound path."""
+        FAULTS.hit("commit")  # fault gate: commit-worker failure flush
         self.broadcaster.failed_scheduling_many(
             [(qpi.pod.key, qpi.pod.metadata.namespace, msg)
              for qpi, _plugins, msg, _retry in items])
@@ -2300,6 +2676,10 @@ class Scheduler:
                     ghost_js.extend(req_rows[m] for m in missed)
                     iter_bind = [p for m, p in enumerate(iter_bind)
                                  if m not in dead]
+                missed_set = set(missed) if missed else ()
+                for m in range(len(items)):
+                    if m not in missed_set:
+                        self._note_assumed(batch[iter_rows[m]])
             if ghost_js:
                 # Same assume-miss staleness as the main cycle: this
                 # iteration's walk counted the ghosts' admissions, so a
@@ -2803,6 +3183,16 @@ class Scheduler:
                 out["batch_sizes"] = list(out["batch_sizes"])
         out.update({f"queue_{k}": v for k, v in self.queue.stats().items()})
         out["waiting_pods"] = len(self.waiting_pods)
+        # Supervisor state: the ladder rung as a gauge (0 = full fast
+        # path; exposed on /metrics via the service provider) plus its
+        # name for humans/tests (non-numeric — dropped from exposition).
+        out["degradation_level"] = self._sup.level
+        out["degradation_state"] = DEGRADATION_LADDER[self._sup.level]
+        # Per-gate fault-injection fire counts (PROCESS-wide registry —
+        # shared across co-located engines; with MINISCHED_FAULTS unset
+        # all zeros, proving a run was fault-free).
+        for gate, n in FAULTS.counts().items():
+            out[f"fault_fires_{gate}"] = n
         return out
 
     ZONE_KEY = "topology.kubernetes.io/zone"
@@ -2939,6 +3329,7 @@ class Scheduler:
                 f"chosen node {node_name} was deleted during the "
                 "scheduling cycle", retryable=True)
             return None, True, False
+        self._note_assumed(qpi)
 
         waits = []
         for plugin in self.plugin_set.permit_plugins:
@@ -2964,12 +3355,23 @@ class Scheduler:
             with self._waiting_lock:
                 self.waiting_pods[pod.key] = wp
             max_timeout = max(t for _, _, t in waits)
+            self._note_detached(pod.key)  # the wait owns the placement now
             self._binder.submit(self._wait_and_bind, qpi, wp, max_timeout)
             return None, False, False
         return (qpi, node_name), False, False
 
     def _wait_and_bind(self, qpi: QueuedPodInfo, wp: WaitingPod,
                        max_timeout: float) -> None:
+        try:
+            self._wait_and_bind_impl(qpi, wp, max_timeout)
+        finally:
+            # The wait no longer owns the placement (bound, requeued, or
+            # parked): release the supervised-retry exclusion.
+            with self._detached_lock:
+                self._detached_live.discard(qpi.pod.key)
+
+    def _wait_and_bind_impl(self, qpi: QueuedPodInfo, wp: WaitingPod,
+                            max_timeout: float) -> None:
         sig = wp.get_signal(timeout=max_timeout + 1.0)
         with self._waiting_lock:
             self.waiting_pods.pop(qpi.pod.key, None)
@@ -3014,6 +3416,57 @@ class Scheduler:
         log.info("bound %s to %s", pod.key, node_name)
 
     def _bind_many(self, items: List[tuple]) -> None:
+        """Bulk binding commit with failure containment: the task runs on
+        the binder pool, where an unhandled exception would silently
+        swallow the whole tranche — pods popped, assumed, never bound,
+        never requeued (lost) with their capacity pinned forever. Any
+        failure (wire fault on a RemoteStore, injected ``bind`` gate)
+        reconciles per pod against store truth instead."""
+        try:
+            FAULTS.hit("bind")  # fault gate: bulk binding task
+            self._bind_many_impl(items)
+        except Exception:
+            log.exception("bulk bind task failed; reconciling %d "
+                          "placement(s) against store truth", len(items))
+            self._reconcile_bind_failure(items)
+        finally:
+            # The bulk commit concluded for every pod (bound, requeued,
+            # or forgotten): release the supervised-retry exclusions.
+            with self._detached_lock:
+                self._detached_live.difference_update(
+                    q.pod.key for q, _n in items)
+
+    def _reconcile_bind_failure(self, items: List[tuple]) -> None:
+        """Per-pod recovery for an aborted bulk bind: the store is the
+        truth — a pod the half-applied transaction DID bind keeps its
+        assume (that assume IS the bound accounting) and is forgotten;
+        an unbound pod is unassumed and requeued with backoff; a deleted
+        pod releases everything. No pod is lost, none doubly bound."""
+        for qpi, node_name in items:
+            key = qpi.pod.key
+            try:
+                fresh = self.store.get("Pod", key)
+            except NotFoundError:
+                self._unassume(qpi)
+                self.queue.forget(key)
+                continue
+            except Exception:
+                # Store unreachable: keep the assume (the capacity may
+                # genuinely be taken — unassuming a bound pod would let
+                # the node over-commit) and requeue; the retry's bind
+                # conflict machinery reconciles once the store answers.
+                log.exception("bind reconcile: store unreachable for %s; "
+                              "requeueing with the assume held", key)
+                self.queue.requeue_backoff(qpi)
+                continue
+            if fresh.spec.node_name:
+                self.queue.forget(key)
+                with self._metrics_lock:
+                    self._metrics["pods_bound"] += 1
+            else:
+                self._bind_failed(qpi, node_name, "bulk bind task aborted")
+
+    def _bind_many_impl(self, items: List[tuple]) -> None:
         """Bulk binding commit for permit-free pods: one store.bind_pods
         transaction (state/store.py) for the whole batch, then per-pod
         bookkeeping. Pods the store skipped (deleted mid-flight, bound by
@@ -3091,6 +3544,9 @@ class Scheduler:
 
     def _unassume(self, qpi: QueuedPodInfo) -> None:
         self.cache.account_unbind(qpi.pod.key)
+        t = self._track
+        if t is not None and threading.get_ident() == self._fail_sink_tid:
+            t.assumed.pop(qpi.pod.key, None)
 
     # ---- failure path (reference ErrorFunc minisched.go:283-298) --------
 

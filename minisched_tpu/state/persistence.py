@@ -35,6 +35,7 @@ import os
 import threading
 from typing import Optional
 
+from ..faults import FAULTS
 from .store import ClusterStore
 
 log = logging.getLogger(__name__)
@@ -93,6 +94,11 @@ class Checkpointer:
             rv = self.store.resource_version()
             if rv == self._saved_rv:
                 return False
+            # Fault gate: checkpoint write. Fires BEFORE any disk touch,
+            # so an injected failure proves the crash-consistency story:
+            # the previous complete snapshot survives untouched (the
+            # atomic temp-write + rename below is never half-entered).
+            FAULTS.hit("checkpoint")
             snap = self.store.snapshot()  # locked inside; serialize outside
             tmp = f"{self.path}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:

@@ -49,3 +49,24 @@ def backoff_durations(
         out.append(d)
         d *= factor
     return out
+
+
+def jittered_delays(
+    initial_duration: float = 0.05,
+    factor: float = 2.0,
+    max_duration: float = 1.0,
+    rng: Callable[[], float] = random.random,
+):
+    """Infinite jittered exponential delay schedule (generator).
+
+    Deadline-driven retry loops (RemoteStore transient absorption) want
+    "back off until the clock runs out", not a fixed step count: each
+    ``next()`` yields the current base delay with up to +100% jitter
+    (full-jitter upper half — decorrelates a thundering herd of engines
+    retrying the same blipped apiserver), then doubles the base up to
+    ``max_duration``. The caller owns the deadline.
+    """
+    d = initial_duration
+    while True:
+        yield d * (1.0 + rng())
+        d = min(d * factor, max_duration)

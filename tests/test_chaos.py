@@ -8,11 +8,14 @@ batched cycle, the async binder, and mutating scenario threads all run
 against one store, and the outcome must still satisfy the scheduler's
 contract.
 """
+import os
 import threading
 import time
 
 import numpy as np
+import pytest
 
+from minisched_tpu import faults
 from minisched_tpu.config import SchedulerConfig
 from minisched_tpu.errors import AlreadyExistsError, NotFoundError
 from minisched_tpu.state import objects as obj
@@ -22,6 +25,16 @@ from minisched_tpu.service.defaultconfig import Profile
 N_PODS = 120
 N_NODES = 14
 CHURN_S = 4.0
+
+#: Ambient fault schedule for the faulted churn variant (`make
+#: soak-faults`): low per-call rates at every engine seam the churn
+#: exercises, plus one deterministic step fault so a run can never
+#: vacuously pass with zero fires. Rates stay low — the point is faults
+#: LANDING ON concurrency races, not a fault storm that serializes the
+#: engine into its slow path for the whole test.
+AMBIENT_FAULTS = ("step:err@2,step:err@0.03,fetch:corrupt@0.02,"
+                  "residency:corrupt@0.02,commit:err@0.05,bind:err@0.03,"
+                  "informer:stall@10msx0.05")
 
 
 def _guarded(errors):
@@ -37,7 +50,15 @@ def _guarded(errors):
     return deco
 
 
-def test_chaos_churn_preserves_invariants():
+@pytest.mark.parametrize("ambient", [False, True], ids=["clean", "faulted"])
+def test_chaos_churn_preserves_invariants(ambient):
+    """The threaded churn soak, clean and with a low ambient fault rate
+    layered on top (the `make soak-faults` shape — each iteration varies
+    `MINISCHED_FAULT_SEED`, so successive soaks explore different
+    fault×race interleavings while any single run replays from its
+    seed). The faulted variant arms the residency carry cross-check so
+    an injected mirror corruption is DETECTED, and disarms at churn end:
+    the quiescence invariants below are the recovery contract."""
     c = Cluster()
     try:
         c.start(profile=Profile(plugins=["NodeUnschedulable",
@@ -45,8 +66,14 @@ def test_chaos_churn_preserves_invariants():
                                          "NodeResourcesLeastAllocated"]),
                 config=SchedulerConfig(backoff_initial_s=0.05,
                                        backoff_max_s=0.2,
-                                       max_batch_size=64),
+                                       max_batch_size=64,
+                                       resident_check_every=(
+                                           1 if ambient else 0)),
                 with_pv_controller=False)
+        if ambient:
+            faults.configure(AMBIENT_FAULTS,
+                             int(os.environ.get("MINISCHED_FAULT_SEED",
+                                                "0")))
         # numpy Generators are not thread-safe: one per thread.
         rng_create, rng_delete = (np.random.default_rng(s) for s in (0, 1))
         stop = threading.Event()
@@ -98,6 +125,13 @@ def test_chaos_churn_preserves_invariants():
         for t in threads:
             t.join(timeout=10)
         assert not errors, errors
+        if ambient:
+            # Faults stop WITH the churn; quiescence below is recovery.
+            # The deterministic step:err@2 rule guarantees ≥1 fire, so a
+            # soak iteration can never pass without injecting anything.
+            fired = sum(faults.FAULTS.counts().values())
+            faults.configure("")
+            assert fired >= 1, "ambient schedule never fired"
 
         # Quiesce: every surviving pod must settle (bound, or pending with
         # recorded attribution / awaiting retry).
@@ -163,6 +197,7 @@ def test_chaos_churn_preserves_invariants():
         assert rvs[:2] == sorted(rvs[:2]) and len(set(rvs[:2])) == 2, (
             f"live events out of rv order: {rvs[:2]}")
     finally:
+        faults.configure("")
         c.shutdown()
 
 

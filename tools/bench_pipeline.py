@@ -112,10 +112,18 @@ def main() -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     n = int(os.environ.get("MINISCHED_BENCH_NODES", "2000"))
     p = int(os.environ.get("MINISCHED_BENCH_PODS", "1000"))
+    from minisched_tpu.faults import FAULTS
+
     doc = {"nodes": n, "pods": p, "platform": "cpu",
            "methodology": "time keys are min-of-2 full phase runs per "
                           "mode (sub-second phases on a 1-core host are "
                           "dominated by scheduler/GC jitter otherwise)",
+           # Robustness provenance: the armed fault spec (empty = gates
+           # compiled out) and, below per mode, the per-phase
+           # degradation_state/fault_fires keys engine_bench exports —
+           # an artifact claiming fast-path numbers must show
+           # "resident"/zero here.
+           "faults_spec": os.environ.get("MINISCHED_FAULTS", ""),
            "modes": {}}
     for label, knob in (("sync", "0"), ("pipelined", "1")):
         os.environ["MINISCHED_PIPELINE"] = knob
