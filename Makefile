@@ -12,18 +12,29 @@ CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 # tier1 uses pipefail/PIPESTATUS (bash-isms).
 SHELL := /bin/bash
 
-.PHONY: test tier1 fault-smoke profile-smoke start start-remote \
-        start-client-engine demo docs bench bench_sharded bench-cpu \
-        bench-pipeline bench-residency dryrun dryrun-dcn soak soak-faults
+.PHONY: test tier1 fault-smoke shortlist-smoke profile-smoke start \
+        start-remote start-client-engine demo docs bench bench_sharded \
+        bench-cpu bench-pipeline bench-residency bench-shortlist dryrun \
+        dryrun-dcn soak soak-faults
 
 # Unit + integration suite on a virtual 8-device CPU mesh.
 test:
 	$(CPU_MESH) $(PY) -m pytest tests/ -x -q
 
+# Fast deterministic shortlist equality suite (~45 s): bit-identity of
+# the shortlist-compressed scan vs the full-width scan at the op, step,
+# and engine level (sync/pipelined/resident/mesh), adversarial
+# contention repairs, degenerate K widths. A tier-1 prerequisite: the
+# hottest kernel's exactness contract gates everything else.
+shortlist-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_shortlist.py -x -q \
+	  -p no:cacheprovider -p no:randomly
+
 # The EXACT ROADMAP tier-1 verify command (dots count + exit code
 # preserved) — what the driver runs after every PR; run it locally
-# before shipping.
-tier1:
+# before shipping. shortlist-smoke runs first: the arbitration
+# exactness contract gates the rest of the suite.
+tier1: shortlist-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -109,6 +120,14 @@ bench-pipeline:
 # bytes + engine throughput, MINISCHED_DEVICE_RESIDENT=0 vs 1.
 bench-residency:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_residency.py
+
+# Shortlist-compressed arbitration before/after at CPU shapes,
+# interleaved off/on rounds (the committed BENCH_SHORTLIST.json):
+# decision-equality ledger, repair rate, and the sequential-scan-width
+# reduction, MINISCHED_SHORTLIST=0 vs 1. The scan-width win is the TPU
+# prize; the CPU artifact proves the equality + repair claims.
+bench-shortlist:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_shortlist.py
 
 # Compile-check the flagship single-chip step and the multi-chip sharded
 # step on an 8-device virtual mesh.

@@ -912,7 +912,13 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                               pipeline=os.environ.get(
                                   "MINISCHED_PIPELINE", "1") != "0",
                               device_resident=os.environ.get(
-                                  "MINISCHED_DEVICE_RESIDENT", "1") != "0")
+                                  "MINISCHED_DEVICE_RESIDENT", "1") != "0",
+                              # shortlist knobs likewise
+                              # (tools/bench_shortlist.py toggles them)
+                              shortlist=os.environ.get(
+                                  "MINISCHED_SHORTLIST", "1") != "0",
+                              shortlist_k=int(os.environ.get(
+                                  "MINISCHED_SHORTLIST_K", "128")))
         if backoff_s is not None:
             # Skew-style convergence workloads retry revoked pods across
             # cycles; the reference's 1 s initial backoff would dominate
@@ -1065,6 +1071,32 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                 f"{prefix}_residency_hits": int(m.get("residency_hits", 0)),
                 f"{prefix}_residency_resyncs":
                     int(m.get("residency_resyncs", 0)),
+                # Per-batch series (ROADMAP ask for the next TPU
+                # capture): device window, uploaded/fetched bytes, and
+                # shortlist repairs PER BATCH — totals hide exactly the
+                # first-batch-vs-steady-state split the residency and
+                # shortlist claims are about.
+                f"{prefix}_batch_device_s":
+                    m.get("batch_series", {}).get("device_s", []),
+                f"{prefix}_batch_h2d_bytes":
+                    m.get("batch_series", {}).get("h2d_bytes", []),
+                f"{prefix}_batch_fetch_bytes":
+                    m.get("batch_series", {}).get("fetch_bytes", []),
+                f"{prefix}_batch_shortlist_repairs":
+                    m.get("batch_series", {}).get("shortlist_repairs", []),
+                # Shortlist-compressed arbitration ledger: active top-K
+                # width (0 = full scan), counted repair rescans, and the
+                # certified fraction — the decision-equality bench
+                # (tools/bench_shortlist.py) turns these into the
+                # scan-width-reduction claim.
+                f"{prefix}_shortlist_width":
+                    int(m.get("shortlist_width", 0)),
+                f"{prefix}_shortlist_repairs":
+                    int(m.get("shortlist_repairs", 0)),
+                f"{prefix}_shortlist_certified":
+                    int(m.get("shortlist_certified", 0)),
+                f"{prefix}_shortlist_desyncs":
+                    int(m.get("shortlist_desyncs", 0)),
                 f"{prefix}_bind_conflicts": int(m["bind_conflicts"]),
                 # revocations + terminal failures summed over cycles —
                 # the skew-convergence diagnostic (how much work the

@@ -122,6 +122,32 @@ class SchedulerConfig:
     # re-escalates one rung back toward the full fast path
     # (resident → upload-every-batch → synchronous → quarantine).
     probation_batches: int = 8
+    # Shortlist-compressed arbitration (ops/select.py
+    # greedy_assign_shortlist, wired through ops/pipeline.build_step):
+    # the greedy scan's sequential per-pod argmax runs over per-pod
+    # top-K candidate shortlists computed in one parallel pass, with an
+    # exactness certificate per step and a counted full-row repair
+    # rescan where it fails — decisions are bit-identical to the full
+    # scan (tests/test_shortlist.py). False (MINISCHED_SHORTLIST=0)
+    # restores the PR-2 full-width scan — the regression-triage
+    # fallback. Greedy-only; auction, mesh, and enforced-domain-caps
+    # batches keep full rows regardless.
+    shortlist: bool = True
+    # Shortlist width K (MINISCHED_SHORTLIST_K): per-step sequential
+    # argmax width, clamped to the node pad. 128 cuts the 50k-node
+    # step's scan width ~390×; widen it if shortlist_repairs climbs
+    # (contention exhausting K candidates forces full-row rescans).
+    shortlist_k: int = 128
+    # Shortlist certification cross-check (MINISCHED_SHORTLIST_CHECK
+    # _EVERY): every N batches re-run the SAME inputs through the
+    # full-width scan and compare decisions — a divergence counts a
+    # shortlist_desync, permanently reverts the engine to the full
+    # scan, and aborts the batch into the supervised retry. 0 disables
+    # (the certificate already proves equality per step; this check
+    # covers defects OUTSIDE the proof — a scribbled readback, a broken
+    # backend gather — and is what the shortlist_repair:corrupt fault
+    # gate exercises).
+    shortlist_check_every: int = 0
     # Residency carry cross-check (ROADMAP follow-up (b)): every N
     # device-resident batches, fetch the device-carried free array and
     # compare it to the host mirror BEFORE the step consumes it; a
@@ -172,6 +198,10 @@ def config_from_env() -> SchedulerConfig:
             _req("MINISCHED_PCT_NODES_TO_SCORE", "0")),
         pipeline=_req("MINISCHED_PIPELINE", "1") != "0",
         device_resident=_req("MINISCHED_DEVICE_RESIDENT", "1") != "0",
+        shortlist=_req("MINISCHED_SHORTLIST", "1") != "0",
+        shortlist_k=int(_req("MINISCHED_SHORTLIST_K", "128")),
+        shortlist_check_every=int(
+            _req("MINISCHED_SHORTLIST_CHECK_EVERY", "0")),
         watchdog_s=float(_req("MINISCHED_WATCHDOG", "0.0")),
         probation_batches=int(_req("MINISCHED_PROBATION_BATCHES", "8")),
         resident_check_every=int(

@@ -133,7 +133,8 @@ class _InflightBatch:
                  "packed_dev", "spread_dev", "failures", "n_assigned",
                  "shapes", "seq", "t0", "t_encode", "t_dispatch",
                  "t_fetch_start", "t_step", "t_resolved", "commit_t0",
-                 "commit_t1", "res_carried", "assumed", "detached")
+                 "commit_t1", "res_carried", "assumed", "detached",
+                 "h2d0", "fetch0", "h2d1", "fetch1", "sl_repairs")
 
     def __init__(self):
         self.failures: List[tuple] = []  # (qpi, plugins, message, retryable)
@@ -154,6 +155,14 @@ class _InflightBatch:
         self.decision: Optional[Decision] = None
         self.spread_dev = None
         self.sample_k = None
+        # Per-batch transfer/repair attribution (the series the bench
+        # exports): byte-counter snapshots at prepare start / resolve
+        # end — prepare..resolve of one batch is contiguous on the
+        # scheduling thread even in pipelined mode, so the deltas are
+        # exactly this batch's traffic — and the shortlist repair count.
+        self.h2d0 = self.fetch0 = 0.0
+        self.h2d1 = self.fetch1 = 0.0
+        self.sl_repairs = 0
         # This batch's free/used_ports input is the device-resident
         # chain (_DeviceResidency) — its free_after must be carried and
         # its debits replayed into the host mirror at resolve time.
@@ -162,8 +171,8 @@ class _InflightBatch:
 
 @jax.jit
 def _pack_decision(chosen, assigned, gang_rejected, feasible,
-                   feasible_static, rejects):
-    """Fuse the per-pod step outputs into one (5+F, P) i32 array so the
+                   feasible_static, rejects, repaired):
+    """Fuse the per-pod step outputs into one (6+F, P) i32 array so the
     host fetches ONE buffer per batch. On a remote-TPU tunnel every
     separate np.asarray is a device round trip; six fetches of small
     arrays cost ~5 extra latencies — measured ~0.27 s/batch at 10k pods,
@@ -174,7 +183,8 @@ def _pack_decision(chosen, assigned, gang_rejected, feasible,
                       assigned.astype(jnp.int32),
                       gang_rejected.astype(jnp.int32),
                       feasible.astype(jnp.int32),
-                      feasible_static.astype(jnp.int32)])
+                      feasible_static.astype(jnp.int32),
+                      repaired.astype(jnp.int32)])
     return jnp.concatenate([head, rejects.astype(jnp.int32)], axis=0)
 
 
@@ -201,10 +211,14 @@ class _DeviceResidency:
     cache diverged from the device's optimistic view — revoked
     placements, failed binds/unassume, informer churn, node lifecycle,
     claim/PV mutations all surface through the cache's
-    DynDeltaListener. ``used_ports`` has no device-side optimistic
-    update (the step does not model port insertion), so its residency
-    is correction-only: the resident copy is always the last uploaded
-    host truth, patched row-wise — empty unless host-port pods churn.
+    DynDeltaListener. ``used_ports`` carries its own optimistic update
+    (ROADMAP residency follow-up (d)): the engine models the batch's
+    host-port insertions on the resident copy with the cache's exact
+    first-zero-slot rule (ops/residency.insert_ports) and replays them
+    into the host mirror in the same integer op order (note_ports), so
+    a port-heavy workload's steady state stays zero-upload — the bind's
+    cache-side port write then matches the mirror and the delta check
+    elides the row, exactly like the free carry.
 
     Invariants (the correctness argument, asserted end-to-end by
     tests/test_device_residency.py):
@@ -236,7 +250,7 @@ class _DeviceResidency:
 
     __slots__ = ("listener", "epoch", "pad", "free_dev", "ports_dev",
                  "mirror_free", "mirror_ports", "pending_rows",
-                 "pending_pre")
+                 "pending_pre", "pending_prows", "pending_ppre")
 
     def __init__(self, listener):
         self.listener = listener
@@ -250,6 +264,10 @@ class _DeviceResidency:
         self.pending_pre = None   # their PRE-replay mirror rows == truth
         #                           at the last snapshot for rows the
         #                           host never otherwise touched
+        self.pending_prows = None  # used_ports twin of pending_rows:
+        self.pending_ppre = None   # rows the last batch's device-side
+        #                            port insertion touched + their
+        #                            pre-insert mirror values
 
     def attach(self, eng, nf, delta):
         """Bring the device-resident dynamic leaves up to host truth for
@@ -268,6 +286,7 @@ class _DeviceResidency:
             self.pad = int(free_np.shape[0])
             self.epoch = self.listener.epoch
             self.pending_rows = self.pending_pre = None
+            self.pending_prows = self.pending_ppre = None
             eng._res_count(resync=True,
                            h2d=free_np.nbytes + ports_np.nbytes)
             return nf._replace(free=self.free_dev,
@@ -301,12 +320,22 @@ class _DeviceResidency:
                 self.mirror_free[up_r] = up_v
                 h2d += apply_rows_bytes(up_r.shape[0], up_v)
         prows = delta.rows.astype(np.int64)
+        pvals = delta.used_ports
+        if self.pending_prows is not None:
+            # Rows the device-side port insertion touched that the host
+            # never otherwise mutated: their truth is the pre-insert
+            # mirror value — the same exclusion rule as the free carry
+            # (a cache-mutated row lands in the delta and wins here).
+            extra = ~np.isin(self.pending_prows, prows)
+            if extra.any():
+                prows = np.concatenate([prows, self.pending_prows[extra]])
+                pvals = np.concatenate([pvals, self.pending_ppre[extra]])
+        self.pending_prows = self.pending_ppre = None
         if prows.size:
-            pdiff = np.any(delta.used_ports != self.mirror_ports[prows],
-                           axis=1)
+            pdiff = np.any(pvals != self.mirror_ports[prows], axis=1)
             if pdiff.any():
                 up_r = prows[pdiff].astype(np.int32)
-                up_v = np.ascontiguousarray(delta.used_ports[pdiff])
+                up_v = np.ascontiguousarray(pvals[pdiff])
                 # ports_dev is engine-private (establish/apply output
                 # only) — safe to donate so XLA reuses the buffer.
                 self.ports_dev = apply_rows(self.ports_dev, up_r, up_v,
@@ -343,6 +372,25 @@ class _DeviceResidency:
             self.pending_rows = self.pending_pre = None
         self.free_dev = free_after_dev
 
+    def note_ports(self, rows: np.ndarray, ports: np.ndarray) -> int:
+        """Model the batch's host-port insertions on the resident
+        used_ports (ROADMAP residency follow-up (d)): run the device
+        insertion op and the bit-exact host replay
+        (ops/residency.insert_ports / replay_ports_host — integer
+        first-zero-slot writes in pod order, the cache's _add_ports
+        rule), tracking touched rows like the free carry's pending set.
+        ``rows`` is (P,) chosen with -1 for pods that insert nothing.
+        Returns the host→device bytes the insertion uploaded."""
+        from ..ops.residency import (insert_ports, insert_ports_bytes,
+                                     replay_ports_host)
+
+        uniq = np.unique(rows[rows >= 0])
+        self.pending_prows = uniq
+        self.pending_ppre = self.mirror_ports[uniq].copy()
+        replay_ports_host(self.mirror_ports, rows, ports)
+        self.ports_dev = insert_ports(self.ports_dev, rows, ports)
+        return insert_ports_bytes(rows.shape[0], ports.shape[1])
+
     def drop(self, reason: str) -> None:
         """Abandon the device state; the next residency batch does a
         full re-upload (the listener rebases itself at collection)."""
@@ -353,6 +401,7 @@ class _DeviceResidency:
         self.free_dev = self.ports_dev = None
         self.mirror_free = self.mirror_ports = None
         self.pending_rows = self.pending_pre = None
+        self.pending_prows = self.pending_ppre = None
         self.listener.invalidate()
 
 
@@ -817,9 +866,23 @@ class Scheduler:
                     f"{self.config.assignment!r}; expected 'greedy' or "
                     "'auction'")
         self._sharded_step = None
+        # Shortlist-compressed arbitration (ops/select.py): greedy-only
+        # and single-device-only — the auction's bidding rounds and the
+        # mesh's static shardings keep full (P,N) rows (documented gates;
+        # decisions are knob-independent there by construction). None =
+        # off. Mutated only on the scheduling thread: the certification
+        # cross-check (_check_shortlist) permanently reverts a desynced
+        # engine to the full-width scan.
+        self._shortlist_k = (self.config.shortlist_k
+                             if (self.config.shortlist
+                                 and self.config.assignment == "greedy"
+                                 and self._mesh is None)
+                             else None)
+        self._sl_check_tick = 0
         self._step = (None if self._mesh is not None else
                       build_step(plugin_set, explain=self.config.explain,
-                                 assignment=self.config.assignment))
+                                 assignment=self.config.assignment,
+                                 shortlist=self._shortlist_k))
         self._key = jax.random.PRNGKey(self.config.seed)
         self._step_counter = 0
         self._prep_step0 = 0  # supervisor replay anchor (see _prepare_batch)
@@ -998,6 +1061,17 @@ class Scheduler:
             "supervisor_escalations": 0, "supervisor_recoveries": 0,
             "quarantined_batches": 0, "worker_deaths": 0,
             "resident_checks": 0, "residency_desyncs": 0,
+            # Shortlist-compressed arbitration observability.
+            # shortlist_repairs counts full-row repair RESCAN EVENTS —
+            # the main step, the residual pass, and every spread-repair
+            # iteration each count their own rescans, so a pod re-run
+            # across passes can contribute more than once (it genuinely
+            # paid more than one (N,)-wide scan); shortlist_certified
+            # is the per-batch complement clamped at 0. The cross-check
+            # run/trip counters ride MINISCHED_SHORTLIST_CHECK_EVERY.
+            "shortlist_repairs": 0, "shortlist_certified": 0,
+            "shortlist_checks": 0, "shortlist_desyncs": 0,
+            "last_shortlist_repairs": 0,
         }
 
     def _sup_count(self, key: str, n: int = 1) -> None:
@@ -1036,6 +1110,59 @@ class Scheduler:
                 f"device-carried free diverged from the host mirror on "
                 f"{bad} row(s) at epoch {res.epoch}")
 
+    def _check_shortlist(self, inf: "_InflightBatch", chosen,
+                         assigned) -> None:
+        """Every ``shortlist_check_every`` batches, re-run THIS batch's
+        exact inputs through the full-width scan and compare decisions —
+        the certification invariant made executable. The certificate
+        already proves bit-equality inside the jitted step; this check
+        covers defects OUTSIDE the proof (scribbled readback between
+        device and host — the shortlist_repair:corrupt gate — or a
+        backend whose gather/top_k lowering is broken). A divergence
+        counts a shortlist_desync, permanently reverts the engine to the
+        full scan, and aborts the batch into the supervised retry, which
+        replays it bit-identically on the reverted path."""
+        if not self.config.shortlist_check_every:
+            return
+        self._sl_check_tick += 1
+        if self._sl_check_tick % self.config.shortlist_check_every:
+            return
+        self._sup_count("shortlist_checks")
+        sample = inf.sample_k
+        check_step = build_step(
+            self.plugin_set, explain=self.config.explain,
+            assignment=self.config.assignment, sample_nodes=sample,
+            shortlist=None)
+        d = check_step(inf.eb, inf.nf, inf.af, inf.key)
+        ref_chosen = np.asarray(d.chosen)
+        ref_assigned = np.asarray(d.assigned)
+        self._count_fetch(ref_chosen.nbytes + ref_assigned.nbytes)
+        L = len(inf.batch)
+        if (np.array_equal(chosen[:L], ref_chosen[:L])
+                and np.array_equal(assigned[:L], ref_assigned[:L])):
+            return
+        bad = int(np.sum((chosen[:L] != ref_chosen[:L])
+                         | (assigned[:L] != ref_assigned[:L])))
+        self._sup_count("shortlist_desyncs")
+        self._disable_shortlist(
+            f"decisions diverged from the full scan on {bad} pod(s)")
+        raise EngineDesync(
+            "shortlist certification cross-check failed: decisions "
+            f"diverged from the full-width scan on {bad} pod(s)")
+
+    def _disable_shortlist(self, reason: str) -> None:
+        """Permanently revert to the full-width scan (the slim-fetch
+        revert idiom): rebuild the main step without the shortlist
+        stage; sampled steps consult ``_shortlist_k`` per batch."""
+        log.error("disabling shortlist-compressed arbitration (%s); "
+                  "reverting to the full-width scan", reason)
+        self._shortlist_k = None
+        if self._mesh is None:
+            self._step = build_step(self.plugin_set,
+                                    explain=self.config.explain,
+                                    assignment=self.config.assignment,
+                                    shortlist=None)
+
     def _count_h2d(self, nbytes: int) -> None:
         with self._metrics_lock:
             self._metrics["h2d_bytes_total"] += nbytes
@@ -1054,7 +1181,8 @@ class Scheduler:
         pack = pack_decision_slim if self._slim else _pack_decision
         return pack(decision.chosen, decision.assigned,
                     decision.gang_rejected, decision.feasible_counts,
-                    decision.feasible_static, decision.reject_counts)
+                    decision.feasible_static, decision.reject_counts,
+                    decision.shortlist_repaired)
 
     def _spread_payload(self, d: Decision):
         """Stage ``d``'s spread-arbitration table for _fetch_spread:
@@ -1093,7 +1221,8 @@ class Scheduler:
     def _fetch_decision(self, packed_dev, p: int, f: int, decision=None):
         """Block on the ONE packed decision fetch and unpack it into
         writable host arrays: (chosen i32, assigned bool, gang_rejected
-        bool, feasible i32, feasible_static i32, rejects (F,P) i32).
+        bool, feasible i32, feasible_static i32, rejects (F,P) i32,
+        repaired bool — the shortlist repair ledger).
         A raw Decision (mesh mode, _pack_dec) is fetched per leaf.
         The first slim fetch is verified against direct leaf fetches
         when ``decision`` is supplied; a mismatch (exotic backend byte
@@ -1110,7 +1239,8 @@ class Scheduler:
                    np.array(d.gang_rejected),
                    np.array(d.feasible_counts),
                    np.array(d.feasible_static),
-                   np.array(d.reject_counts))
+                   np.array(d.reject_counts),
+                   np.array(d.shortlist_repaired))
             self._count_fetch(sum(a.nbytes for a in out))
             if act == "corrupt":
                 out[0][:] = 0x7F7F7F7F
@@ -1121,7 +1251,7 @@ class Scheduler:
             if act == "corrupt":
                 buf[0] = 0x7F7F7F7F       # chosen plane → absurd rows
             return (buf[0], buf[1].astype(bool), buf[2].astype(bool),
-                    buf[3], buf[4], buf[5:])
+                    buf[3], buf[4], buf[6:], buf[5].astype(bool))
         out = unpack_decision_slim(buf, p, f)
         if not self._slim_verified and decision is not None:
             self._slim_verified = True
@@ -1141,7 +1271,8 @@ class Scheduler:
                     _pack_decision(
                         decision.chosen, decision.assigned,
                         decision.gang_rejected, decision.feasible_counts,
-                        decision.feasible_static, decision.reject_counts),
+                        decision.feasible_static, decision.reject_counts,
+                        decision.shortlist_repaired),
                     p, f)
         if act == "corrupt":
             # Scribble AFTER the first-batch byte-order cross-check: the
@@ -1572,6 +1703,9 @@ class Scheduler:
         self._prep_step0 = self._step_counter
         inf = _InflightBatch()
         cfg = self.config
+        with self._metrics_lock:
+            inf.h2d0 = self._metrics["h2d_bytes_total"]
+            inf.fetch0 = self._metrics["fetch_bytes_total"]
         # Pull queued gang-mates so no batch boundary splits a gang (the
         # step would reject the partial group for missing quorum). This may
         # push the batch past max_batch_size — a split gang can never meet
@@ -1855,6 +1989,9 @@ class Scheduler:
             self._fail_sink = None
             self._track = None
         inf.t_resolved = time.perf_counter()
+        with self._metrics_lock:
+            inf.h2d1 = self._metrics["h2d_bytes_total"]
+            inf.fetch1 = self._metrics["fetch_bytes_total"]
         self._watchdog_check(inf)
         self._sup.note_clean()
 
@@ -1918,7 +2055,7 @@ class Scheduler:
         # host-side gap out of the step metric (it books as gap time).
         inf.t_fetch_start = time.perf_counter()
         (chosen, assigned, gang_rejected, feasible, feasible_static,
-         rejects) = self._fetch_decision(
+         rejects, sl_repaired) = self._fetch_decision(
             inf.packed_dev, eb.pf.valid.shape[0],
             decision.reject_counts.shape[0], decision)
         # Supervisor fetch-sanity detector — BEFORE the residency replay
@@ -1932,6 +2069,20 @@ class Scheduler:
                 raise EngineDesync(
                     "decision readback failed its sanity check: chosen "
                     f"node row outside [0, {len(names)})")
+        if self._shortlist_k is not None:
+            # Fault gate: shortlist decision accounting. ``corrupt``
+            # re-points one assigned pod at a DIFFERENT valid node row —
+            # the signature of a shortlist mispick the certificate
+            # should have repaired (scribbled candidate gather, broken
+            # backend top_k). It passes the range sanity check above by
+            # construction; only the full-scan certification
+            # cross-check below can catch it.
+            if (FAULTS.hit("shortlist_repair") == "corrupt"
+                    and assigned[:L0].any()):
+                j = int(np.argmax(assigned[:L0]))
+                chosen[j] = (int(chosen[j]) + 1) % len(names)
+            self._check_shortlist(inf, chosen, assigned)
+            inf.sl_repairs += int(sl_repaired[:L0].sum())
         sp = self._fetch_spread(spread_dev)
         if inf.res_carried:
             # Replay the MAIN step's device debits into the host mirror
@@ -1939,9 +2090,32 @@ class Scheduler:
             # before the residual merge mutates chosen/assigned (the
             # carried array is the main step's output; residual/repair
             # placements reach the device as next-batch corrections).
-            self._residency.note_debits(chosen, assigned,
-                                        eb.pf.requests,
-                                        decision.free_after)
+            res = self._residency
+            res.note_debits(chosen, assigned, eb.pf.requests,
+                            decision.free_after)
+            # ROADMAP residency follow-up (d): model the batch's
+            # host-port insertions on the device-resident used_ports
+            # (and its mirror, identical integer op order) so a
+            # port-heavy steady state uploads nothing — previously every
+            # bind's cache-side port write forced a row correction the
+            # next batch. Same PRE-residual-merge discipline as the free
+            # debits; revoked/failed placements re-converge through the
+            # cache listener delta exactly like free rows do.
+            ports = np.asarray(eb.pf.ports)
+            live = assigned & (ports != 0).any(axis=1)
+            if live.any():
+                # Gather to the port-carrying pods only (pow2 bucket,
+                # -1 pad rows are skipped by the insert): the upload is
+                # proportional to port pods, and a no-port batch — the
+                # common case — never reaches this line at all.
+                idx = np.nonzero(live)[0]
+                k = bucket_for(idx.size, 16)
+                rows_pad = np.full((k,), -1, dtype=np.int32)
+                rows_pad[:idx.size] = chosen[idx]
+                ports_pad = np.zeros((k, ports.shape[1]),
+                                     dtype=ports.dtype)
+                ports_pad[:idx.size] = ports[idx]
+                self._count_h2d(res.note_ports(rows_pad, ports_pad))
 
         if sample_k is not None:
             # Residual pass: a pod with zero feasible nodes IN THE SAMPLE
@@ -2348,6 +2522,23 @@ class Scheduler:
             m["step_dispatch_s_total"] += inf.t_dispatch - inf.t_encode
             m["gap_s_total"] += gather_gap
             m["commit_s_total"] += commit_s
+            m["shortlist_repairs"] += inf.sl_repairs
+            m["shortlist_certified"] += max(0,
+                                            len(batch) - inf.sl_repairs)
+            # Per-batch series for the next TPU capture (ROADMAP ask):
+            # device window, uploaded/fetched bytes, and shortlist
+            # repairs PER BATCH, not just totals — bounded like the
+            # batch_sizes trail. The byte deltas are exact: one batch's
+            # prepare→resolve is contiguous on the scheduling thread
+            # even in pipelined mode.
+            ser = m.setdefault("batch_series", {
+                "device_s": [], "h2d_bytes": [], "fetch_bytes": [],
+                "shortlist_repairs": []})
+            if len(ser["device_s"]) < 64:
+                ser["device_s"].append(round(step_s, 6))
+                ser["h2d_bytes"].append(int(inf.h2d1 - inf.h2d0))
+                ser["fetch_bytes"].append(int(inf.fetch1 - inf.fetch0))
+                ser["shortlist_repairs"].append(int(inf.sl_repairs))
             if inf.failures:
                 # Encode-vs-flush overlap, booked HERE where the flush
                 # window is known: the NEXT batch's prepare may take
@@ -2372,6 +2563,7 @@ class Scheduler:
                 m["last_step_s"] = step_s
                 m["last_commit_s"] = commit_s
                 m["last_shapes"] = inf.shapes
+                m["last_shortlist_repairs"] = int(inf.sl_repairs)
 
     def _flush_failures(self, items: List[tuple]) -> None:
         """Apply a cycle's deferred failure verdicts in bulk — the
@@ -2517,7 +2709,8 @@ class Scheduler:
             # sampling exists for small batches against huge clusters.
             return None, None
         return build_step(self.plugin_set, explain=False,
-                          assignment=cfg.assignment, sample_nodes=k), k
+                          assignment=cfg.assignment, sample_nodes=k,
+                          shortlist=self._shortlist_k), k
 
     def _run_residual(self, eb, nf, af, key, rows, decision,
                       chosen, assigned, gang_rejected, feasible,
@@ -2536,8 +2729,10 @@ class Scheduler:
         nf2 = nf._replace(free=free2)
         d2: Decision = self._step(eb2, nf2, af,
                                   jax.random.fold_in(key, 0x5e5))
-        (ch2, as2, gr2, fc2, fs2, rj2) = self._fetch_decision(
+        (ch2, as2, gr2, fc2, fs2, rj2, rep2) = self._fetch_decision(
             self._pack_dec(d2), P2, d2.reject_counts.shape[0], d2)
+        if self._track is not None:
+            self._track.sl_repairs += int(rep2[:n_res].sum())
         chosen[rows] = ch2[:n_res]
         assigned[rows] = as2[:n_res]
         gang_rejected[rows] = gr2[:n_res]
@@ -2608,10 +2803,12 @@ class Scheduler:
             self._step_counter += 1
             d2 = step_fn(eb2, nf, af,
                          jax.random.fold_in(self._key, self._step_counter))
-            (chosen2, assigned2, _gr2, _fc2, _fs2, _rj2) = (
+            (chosen2, assigned2, _gr2, _fc2, _fs2, _rj2, rep2) = (
                 self._fetch_decision(self._pack_dec(d2),
                                      eb2.pf.valid.shape[0],
                                      d2.reject_counts.shape[0], d2))
+            if self._track is not None:
+                self._track.sl_repairs += int(rep2[:len(rows)].sum())
             n_r = len(rows)
             sub = [batch[i] for i in rows]
             sp2 = self._fetch_spread(self._spread_payload(d2))
@@ -3181,8 +3378,15 @@ class Scheduler:
             if "batch_sizes" in out:
                 # dict() is shallow; the live list must not escape the lock
                 out["batch_sizes"] = list(out["batch_sizes"])
+            if "batch_series" in out:
+                out["batch_series"] = {k: list(v) for k, v
+                                       in out["batch_series"].items()}
         out.update({f"queue_{k}": v for k, v in self.queue.stats().items()})
         out["waiting_pods"] = len(self.waiting_pods)
+        # Shortlist-compressed arbitration gauge: the active top-K width
+        # (0 = off — knob, auction/mesh gate, or a certification desync
+        # reverted the engine to the full-width scan).
+        out["shortlist_width"] = int(self._shortlist_k or 0)
         # Supervisor state: the ladder rung as a gauge (0 = full fast
         # path; exposed on /metrics via the service provider) plus its
         # name for humans/tests (non-numeric — dropped from exposition).

@@ -10,6 +10,15 @@ production scheduler's failure domain spans:
     step        jitted step dispatch         (engine/scheduler.py)
     fetch       slim decision fetch          (engine/scheduler.py)
     residency   dynamic-leaf delta/carry     (engine/scheduler.py)
+    shortlist_repair
+                shortlist decision accounting (engine/scheduler.py) —
+                ``corrupt`` re-points an assigned pod's fetched chosen
+                row at a DIFFERENT valid node, modeling a shortlist
+                mispick the certificate should have repaired (a
+                scribbled shortlist gather / broken backend top_k);
+                only the full-scan cross-check
+                (MINISCHED_SHORTLIST_CHECK_EVERY) can catch it — the
+                row passes the range sanity check by construction.
     commit      commit-worker failure flush  (engine/scheduler.py)
     bind        bulk binding task            (engine/scheduler.py)
     informer    informer dispatch loop       (state/informer.py)
@@ -72,8 +81,8 @@ log = logging.getLogger(__name__)
 
 #: The gate catalog; hit() rejects unknown names so a typo in a rule or a
 #: call site cannot silently never fire.
-GATES = ("step", "fetch", "residency", "commit", "bind", "informer",
-         "http", "checkpoint")
+GATES = ("step", "fetch", "residency", "shortlist_repair", "commit",
+         "bind", "informer", "http", "checkpoint")
 
 _ACTIONS = ("err", "die", "corrupt", "stall")
 

@@ -48,6 +48,26 @@ per-pod argmax with random tie-break, minisched/minisched.go:304-325);
 this mode exists for the gang/coscheduling scale target (BASELINE.md
 config 5).
 
+SHORTLIST GATE (explicit): the auction keeps full (P,N) rows and does
+NOT compose with the shortlist-compressed arbitration
+(ops/select.greedy_assign_shortlist) — prices are global per-node state
+that every bidder reads and every round mutates, so a per-pod top-K
+gather would change which node wins a contended bid (no certificate can
+patch that after the fact the way the greedy scan's repair rescan can).
+``build_step(assignment="auction", shortlist=K)`` therefore raises
+rather than silently ignoring the knob, and the engine's
+``shortlist_width`` gauge reads 0 in auction mode. The rounds are
+already parallel — the shortlist exists to shrink the greedy scan's
+SEQUENTIAL critical path, which the auction does not have.
+
+Tie-break contract: every random-looking quantity below comes from
+ops/select.tie_noise_from_cols — the single definition of the
+(seed, pod row, node column) noise lattice shared by the greedy scan,
+the pallas kernel, the sharded chunked scan, and the shortlist
+selection (see its docstring for the bitwise-identity contract). The
+auction folds that noise under its eps slack rather than tie-breaking
+with it, so it stays eps-optimal while remaining seed-reproducible.
+
 Measured on one v5e core at P=10240, N=50176, R=9 (inside jit, as the
 pipeline always runs it): 91 ms to full assignment (4 rounds) — on par
 with the pallas greedy kernel (87 ms) while remaining GSPMD-partitionable.
@@ -114,15 +134,16 @@ def auction_assign(scores: jnp.ndarray, requests: jnp.ndarray,
     seed = seed_from_key(key)
     rows = jnp.arange(P, dtype=jnp.int32)
 
-    # Fold per-(pod,node) tie-break noise < eps into the scores ONCE.
-    # Normalized plugin scores plateau hard (max-normalize gives every
-    # pod a 100.0 at its best nodes, and a deployment's replicas are
-    # identical), and on a plateau plain Bertsekas collapses: every pod
-    # bids the same argmax node, one winner per round, and the losers'
-    # margin — hence the price rise — is only eps. Sub-eps noise spreads
-    # equal-value bids uniformly across the plateau (collision depth
-    # drops from O(P) to O(P/plateau width)) while staying inside the
-    # auction's eps-optimality slack.
+    # Fold per-(pod,node) tie-break noise < eps into the scores ONCE
+    # (the shared select.tie_noise_from_cols lattice — see the module
+    # docstring's tie-break contract). Normalized plugin scores plateau
+    # hard (max-normalize gives every pod a 100.0 at its best nodes, and
+    # a deployment's replicas are identical), and on a plateau plain
+    # Bertsekas collapses: every pod bids the same argmax node, one
+    # winner per round, and the losers' margin — hence the price rise —
+    # is only eps. Sub-eps noise spreads equal-value bids uniformly
+    # across the plateau (collision depth drops from O(P) to
+    # O(P/plateau width)) while staying inside the eps-optimality slack.
     pn_noise = tie_noise_from_cols(
         seed, rows[:, None],
         jax.lax.broadcasted_iota(jnp.uint32, (1, N), 1))       # (P,N)

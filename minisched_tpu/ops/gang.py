@@ -48,6 +48,9 @@ class GangResult(NamedTuple):
     free_after: jnp.ndarray     # (N,R) f32 remaining free resources
     gang_rejected: jnp.ndarray  # (P,) bool — pod's group missed quorum
     group_ok: jnp.ndarray       # (G,) bool — group met min_count
+    repaired: jnp.ndarray       # (P,) bool — shortlist repair ledger
+    #   (ops/select.greedy_assign_shortlist); all-False for assignments
+    #   without a shortlist stage (full scan, pallas, auction, sharded)
 
 
 def gang_assign(scores: jnp.ndarray, requests: jnp.ndarray,
@@ -145,6 +148,12 @@ def gang_admission(attempt_fn, group_ids: jnp.ndarray,
     ok, res = jax.lax.cond(jnp.any(~ok), readmit, lambda c: c, (ok, res))
 
     gang_rejected = grouped & ~ok[gidx]
+    # Shortlist repair ledger: present only when the inner assignment is
+    # the shortlist-compressed scan (trace-time structural choice).
+    repaired = getattr(res, "repaired", None)
+    if repaired is None:
+        repaired = jnp.zeros_like(res.assigned)
     return GangResult(chosen=res.chosen, assigned=res.assigned,
                       free_after=res.free_after,
-                      gang_rejected=gang_rejected, group_ok=ok)
+                      gang_rejected=gang_rejected, group_ok=ok,
+                      repaired=repaired)

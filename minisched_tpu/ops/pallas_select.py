@@ -32,6 +32,20 @@ lax.scan path — 11.3x, bitwise-identical outputs. CPU tests run it under
 interpret=True for exact equivalence checks against the scan
 (tests/test_pallas_select.py); bench.py asserts the same equality on real
 TPU hardware.
+
+SHORTLIST GATE: with shortlist-compressed arbitration on (the default,
+MINISCHED_SHORTLIST=1), build_step does NOT auto-select this kernel —
+the K-wide certified scan (ops/select.greedy_assign_shortlist) replaces
+it as the sequential stage, since both attack the same critical path and
+the shortlist's per-step argmax is ~N/K narrower than this kernel's
+full-width one. The gate is counted, not silent: the engine's
+``shortlist_width`` gauge > 0 says the scan ran compressed, 0 says this
+kernel (or the full scan) handled the batch. Mirroring the shortlist
+INSIDE the kernel needs a dynamic-lane gather per step (free[cand_ids]),
+which Mosaic does not lower on this toolchain (same class as the
+dynamic LANE slicing noted above) — re-evaluate when it does. An
+explicit ``pallas=True`` (bench.py's kernel-vs-scan comparison) still
+selects the kernel unconditionally.
 """
 from __future__ import annotations
 

@@ -80,6 +80,13 @@ class Decision(NamedTuple):
     # exact-table fetch — for these groups: the scan already judged every
     # admission against running counts in batch order.
     scan_groups: jnp.ndarray
+    # (P,) bool — the shortlist-compressed scan's repair ledger
+    # (ops/select.greedy_assign_shortlist): True where the step's
+    # exactness certificate could not prove the true argmax was inside
+    # the pod's top-K shortlist and a full-row rescan ran instead.
+    # All-False when the shortlist stage is off (full scan, pallas,
+    # auction, sharded/mesh, enforced domain caps).
+    shortlist_repaired: jnp.ndarray
     # explain mode only (else zero-size placeholders):
     filter_masks: jnp.ndarray     # (F,P,N) bool per-plugin pass mask
     raw_scores: jnp.ndarray       # (S,P,N) f32 pre-normalize
@@ -134,7 +141,8 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
                pallas: Optional[bool] = None,
                assignment: str = "greedy",
                assign_fn=None, assign_key=None,
-               sample_nodes: Optional[int] = None):
+               sample_nodes: Optional[int] = None,
+               shortlist: Optional[int] = None):
     """Compile the scheduling step for a plugin profile.
 
     Returns jitted ``step(eb, nf, af, key) -> Decision`` where eb is an
@@ -174,6 +182,20 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
     full axis before being declared unschedulable (the engine's residual
     pass). Not supported with explain mode (per-node annotation columns
     would misalign) or a custom assign_fn.
+
+    ``shortlist``: run the greedy assignment as the SHORTLIST-COMPRESSED
+    scan (ops/select.greedy_assign_shortlist) with this top-K width —
+    the sequential P-step scan consults per-pod top-K candidate columns
+    instead of the full node axis, with an exactness certificate per
+    step and a counted full-row repair rescan where it fails; decisions
+    are bit-identical to the full scan. Greedy-only, composes with node
+    sampling (the shortlist then compresses the sampled axis), and
+    yields to the full caps-scan at run time when enforced domain caps
+    are present (lax.cond on ``caps.any_enforced``, like the pallas
+    gate). An EXPLICIT ``pallas=True`` wins over the shortlist (the
+    bench's kernel-vs-scan comparison depends on it); the auto-selected
+    pallas kernel is gated off — the shortlist scan is the narrower
+    sequential path the kernel existed to accelerate.
     """
     if assignment not in ("greedy", "auction"):
         raise ValueError(
@@ -182,6 +204,16 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
     if sample_nodes is not None and (explain or assign_fn is not None):
         raise ValueError(
             "sample_nodes is incompatible with explain mode / assign_fn")
+    if shortlist is not None and shortlist < 1:
+        shortlist = None
+    if shortlist is not None and (assignment != "greedy"
+                                  or assign_fn is not None):
+        # The auction's parallel bidding rounds and the sharded
+        # chunked-gather scan keep full (P,N) rows — a silently ignored
+        # knob would let a config claim shortlist numbers it never ran.
+        raise ValueError(
+            "shortlist compression applies to the greedy scan only "
+            "(auction bidding and custom assign_fn keep full rows)")
     if assign_fn is not None and assign_key is None:
         # Without an explicit identity the cache would collide with the
         # default-assignment step and silently drop the custom stage.
@@ -191,6 +223,7 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
         tuple((p.trace_key(), plugin_set.weight_of(p))
               for p in plugin_set.score_plugins),
         explain, cfg, pallas, assignment, assign_key, sample_nodes,
+        shortlist,
     )
     cached = _STEP_CACHE.get(cache_key)
     if cached is not None:
@@ -385,7 +418,48 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
                     from .pallas_select import pallas_supported
 
                     use_pallas = pallas_supported(N)
-                if use_pallas:
+                if shortlist is not None and pallas is not True:
+                    # Shortlist-compressed arbitration: the parallel
+                    # top-K selection + K-wide certified scan
+                    # (ops/select.greedy_assign_shortlist). It REPLACES
+                    # the auto-selected pallas kernel — both attack the
+                    # same sequential critical path, and the shortlist
+                    # scan's per-step work is ~N/K smaller than the
+                    # kernel's full-width argmax; an explicit
+                    # pallas=True keeps the kernel (bench comparison).
+                    # The counted trade is visible: the engine exposes
+                    # shortlist_width/shortlist_repairs in metrics().
+                    import functools
+
+                    from .select import greedy_assign_shortlist
+
+                    k_eff = min(shortlist, N)
+                    sl_fn = functools.partial(greedy_assign_shortlist,
+                                              k=k_eff)
+                    if caps is not None:
+                        # Enforced domain caps need the N-wide running
+                        # cap mask every step — decided at RUN time
+                        # (lax.cond), so a topology profile pays the
+                        # full caps-scan only when a hard constraint is
+                        # really present; everything else keeps the
+                        # compressed scan.
+                        from .select import (ShortlistAssignResult,
+                                             greedy_assign as _ga)
+
+                        def greedy_fn(sc, rq, fr, kk, _caps=caps,
+                                      _sl=sl_fn):
+                            def full(a):
+                                r = _ga(*a, caps=_caps)
+                                return ShortlistAssignResult(
+                                    r.chosen, r.assigned, r.free_after,
+                                    jnp.zeros_like(r.assigned))
+
+                            return jax.lax.cond(
+                                _caps.any_enforced, full,
+                                lambda a: _sl(*a), (sc, rq, fr, kk))
+                    else:
+                        greedy_fn = sl_fn
+                elif use_pallas:
                     from .pallas_select import greedy_assign_pallas
 
                     if caps is not None:
@@ -458,6 +532,12 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
 
         chosen = assign.chosen
         free_after = assign.free_after
+        # Repair ledger (a GangResult field since the shortlist stage;
+        # getattr keeps external assign_fn suppliers returning the old
+        # 5-field shape working — they have no shortlist to account).
+        sl_repaired = getattr(assign, "repaired", None)
+        if sl_repaired is None:
+            sl_repaired = jnp.zeros((P,), dtype=bool)
         if sample_idx is not None:
             # Remap subset rows back to GLOBAL node rows; free_after is
             # scattered into the full-size table so downstream consumers
@@ -485,6 +565,7 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             spread_cdom=spread_cdom,
             spread_dexist=spread_dexist,
             scan_groups=scan_groups,
+            shortlist_repaired=sl_repaired,
             filter_masks=filter_stack,
             raw_scores=raw_stack,
             norm_scores=norm_stack,
@@ -564,7 +645,8 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             # future guard extension can't silently switch strategies.
             state["fn"] = build_step(plugin_set, explain=explain, cfg=cfg,
                                      pallas=False, assignment=assignment,
-                                     sample_nodes=sample_nodes)
+                                     sample_nodes=sample_nodes,
+                                     shortlist=shortlist)
             state["fell_back"] = True
             return state["fn"](eb, nf, af, key)
 

@@ -86,18 +86,20 @@ def apply_rows_bytes(n: int, values: np.ndarray) -> int:
 
 @jax.jit
 def pack_decision_slim(chosen, assigned, gang_rejected, feasible,
-                       feasible_static, rejects) -> jnp.ndarray:
+                       feasible_static, rejects, repaired) -> jnp.ndarray:
     """Fuse the per-pod step outputs into ONE (B,) uint8 buffer so the
     host fetches a single, minimal transfer per batch:
 
         [chosen i32 × P] [assigned bits P/8] [gang_rejected bits P/8]
-        [feasible i16 × P] [feasible_static i16 × P] [rejects i16 × F·P]
+        [repaired bits P/8] [feasible i16 × P] [feasible_static i16 × P]
+        [rejects i16 × F·P]
 
     ``chosen`` keeps i32 (node rows exceed i16 at 50k-node pads); the
     count planes saturate at I16_SAT (positivity is all the engine
-    reads); the bool planes pack 8 pods per byte via the bit-plane
-    idiom of explain/resultstore.py, ceil(P/8) bytes each — the default
-    pod buckets (pow2 ≥ 16 or 256-multiples) divide by 8, but a small
+    reads); the bool planes — including the shortlist scan's repair
+    ledger — pack 8 pods per byte via the bit-plane idiom of
+    explain/resultstore.py, ceil(P/8) bytes each — the default pod
+    buckets (pow2 ≥ 16 or 256-multiples) divide by 8, but a small
     ``pod_bucket_min`` or a tiny residual-pass pad need not, and the
     unpack must agree byte-for-byte either way.
     """
@@ -111,6 +113,7 @@ def pack_decision_slim(chosen, assigned, gang_rejected, feasible,
         bytes_of(chosen.astype(jnp.int32)),
         jnp.packbits(assigned.astype(jnp.uint8)),
         jnp.packbits(gang_rejected.astype(jnp.uint8)),
+        jnp.packbits(repaired.astype(jnp.uint8)),
         bytes_of(i16(feasible)),
         bytes_of(i16(feasible_static)),
         bytes_of(i16(rejects)),
@@ -119,7 +122,7 @@ def pack_decision_slim(chosen, assigned, gang_rejected, feasible,
 
 def slim_buffer_bytes(p: int, f: int) -> int:
     """Host-side size model of pack_decision_slim's buffer (bytes)."""
-    return 4 * p + 2 * ((p + 7) // 8) + 2 * p + 2 * p + 2 * f * p
+    return 4 * p + 3 * ((p + 7) // 8) + 2 * p + 2 * p + 2 * f * p
 
 
 def unpack_decision_slim(buf: np.ndarray, p: int, f: int) -> Tuple:
@@ -127,7 +130,7 @@ def unpack_decision_slim(buf: np.ndarray, p: int, f: int) -> Tuple:
     (a WRITABLE np.uint8 copy). Counts widen back to i32 so downstream
     numpy code keeps its historical dtypes. Returns
     (chosen, assigned, gang_rejected, feasible, feasible_static,
-    rejects)."""
+    rejects, repaired)."""
     nb = (p + 7) // 8  # packbits emits ceil(P/8) bytes per bool plane
     o = 0
     chosen = buf[o:o + 4 * p].view(np.int32)
@@ -136,6 +139,8 @@ def unpack_decision_slim(buf: np.ndarray, p: int, f: int) -> Tuple:
     o += nb
     gang_rejected = np.unpackbits(buf[o:o + nb])[:p].astype(bool)
     o += nb
+    repaired = np.unpackbits(buf[o:o + nb])[:p].astype(bool)
+    o += nb
     feasible = buf[o:o + 2 * p].view(np.int16).astype(np.int32)
     o += 2 * p
     feasible_static = buf[o:o + 2 * p].view(np.int16).astype(np.int32)
@@ -143,4 +148,82 @@ def unpack_decision_slim(buf: np.ndarray, p: int, f: int) -> Tuple:
     rejects = (buf[o:o + 2 * f * p].view(np.int16)
                .reshape(f, p).astype(np.int32))
     return (chosen, assigned, gang_rejected, feasible, feasible_static,
-            rejects)
+            rejects, repaired)
+
+
+def _insert_ports(state, rows, ports):
+    """Device twin of NodeFeatureCache._add_ports, applied for the
+    batch's assigned pods IN POD ORDER: each nonzero port value lands in
+    the FIRST zero slot of its node's row (no slot free = dropped, the
+    host's overflow semantics). Pure i32 slot writes — no float ops —
+    so the host replay (replay_ports_host) is trivially bit-exact.
+
+    state (N,PORT) i32; rows (P,) i32 node row per pod, -1 = skip
+    (unassigned / padding); ports (P,PP) i32 requested host ports,
+    0 = empty slot."""
+    slot = jnp.arange(state.shape[1], dtype=jnp.int32)
+
+    def body(st, inp):
+        r, pp = inp
+        valid = r >= 0
+        safe = jnp.where(valid, r, 0)
+        row = st[safe]
+
+        def one(t, row):
+            p = pp[t]
+            empty = row == 0
+            has = empty.any() & (p != 0) & valid
+            j = jnp.argmax(empty)
+            return jnp.where(has & (slot == j), p, row)
+
+        row = jax.lax.fori_loop(0, ports.shape[1], one, row)
+        return st.at[safe].set(row), None
+
+    state, _ = jax.lax.scan(body, state, (rows, ports))
+    return state
+
+
+# NO donation here, unlike the attach-time apply_rows correction: by
+# insert time the resident buffer has been spliced into the batch's
+# NodeFeatures (attach returns nf._replace(used_ports=ports_dev)), and
+# the resolve-phase residual/repair/cross-check re-dispatches consume
+# that same nf — donating would hand them a deleted array on backends
+# that honor donation (CPU ignores it, so only TPU would crash).
+_insert_ports_jit = jax.jit(_insert_ports)
+
+
+def insert_ports(state, rows: np.ndarray, ports: np.ndarray):
+    """Model the batch's host-port insertions on the device-resident
+    ``used_ports`` (ROADMAP residency follow-up (d)): the engine applies
+    the step's assignments to the resident copy itself, so a port-heavy
+    workload's steady state stays ZERO-upload — without this every
+    bind's cache-side _add_ports marked its row into the delta and the
+    resident copy was re-corrected (uploaded) every single batch.
+    ``rows``/``ports`` are host arrays (chosen rows with -1 for
+    unassigned pods, and the encoder's (P,PP) port matrix); the upload
+    they cost is P·(1+PP)·4 bytes — count it via insert_ports_bytes."""
+    return _insert_ports_jit(state, jnp.asarray(rows, dtype=jnp.int32),
+                             jnp.asarray(ports, dtype=jnp.int32))
+
+
+def insert_ports_bytes(p: int, pp: int) -> int:
+    """Host→device bytes one insert_ports call uploads (rows + ports)."""
+    return p * 4 + p * pp * 4
+
+
+def replay_ports_host(mirror: np.ndarray, rows: np.ndarray,
+                      ports: np.ndarray) -> None:
+    """Host replay of _insert_ports into the residency mirror, in the
+    identical order (pod row ascending, port slots left to right) with
+    the identical first-zero-slot rule — integer writes, so mirror and
+    device agree bitwise. Mutates ``mirror`` in place."""
+    for r, pp in zip(rows.tolist(), ports.tolist()):
+        if r < 0:
+            continue
+        row = mirror[r]
+        for p in pp:
+            if not p:
+                continue
+            z = np.flatnonzero(row == 0)
+            if z.size:
+                row[z[0]] = p

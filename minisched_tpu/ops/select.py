@@ -49,11 +49,15 @@ def fmix32(x: jnp.ndarray) -> jnp.ndarray:
 def tie_noise_from_cols(seed: jnp.ndarray, i: jnp.ndarray,
                         cols: jnp.ndarray) -> jnp.ndarray:
     """Counter-based uniform noise in [0,1): fmix32 of (seed + i*golden)
-    + column index. Deterministic in (seed, i, column) — the single
-    definition both the lax.scan path and the pallas kernel use, so the
-    two paths break ties identically. ``cols`` is the u32 column-index
-    array (any shape; the kernel passes a 2D broadcasted_iota since TPU
-    has no 1D iota)."""
+    + column index. Deterministic in (seed, i, column) — the SINGLE
+    definition of the tie-break contract. Every assignment path consumes
+    this one helper (the lax.scan, the pallas kernel, the sharded
+    chunked-gather scan, the auction's sub-eps plateau spreading, and
+    the shortlist-compressed scan's candidate selection), which is what
+    makes their decisions bitwise-comparable: any two paths fed the same
+    (seed, pod row, node column) lattice break ties identically.
+    ``cols`` is the u32 column-index array (any shape; the kernel passes
+    a 2D broadcasted_iota since TPU has no 1D iota)."""
     x = fmix32(cols * jnp.uint32(_COL_MULT) + seed
                + i.astype(jnp.uint32) * jnp.uint32(GOLDEN))
     # x>>8 < 2^24, so the detour through int32 is lossless — and required:
@@ -120,3 +124,153 @@ def greedy_assign(scores: jnp.ndarray, requests: jnp.ndarray,
         body, (free0, counts0),
         (jnp.arange(P, dtype=jnp.int32), requests, scores))
     return AssignResult(chosen, assigned, free_after)
+
+
+class ShortlistAssignResult(NamedTuple):
+    """AssignResult plus the repair ledger of the shortlist scan."""
+
+    chosen: jnp.ndarray      # (P,) i32 node row, -1 if unassigned
+    assigned: jnp.ndarray    # (P,) bool
+    free_after: jnp.ndarray  # (N,R) f32 remaining free resources
+    repaired: jnp.ndarray    # (P,) bool — step fell back to a full-row
+    #                          rescan (certificate could not prove the
+    #                          true argmax was inside the shortlist)
+
+
+def shortlist_select(scores: jnp.ndarray, seed: jnp.ndarray,
+                     k: int) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Per-pod top-K candidate shortlists, ordered LEXICOGRAPHICALLY by
+    (score, tie-noise) — the exact order the greedy scan consults when it
+    picks a node — plus the certification bound.
+
+    Returns ``(cand (P,K) i32 global node columns, kth (P,) f32,
+    kth_noise (P,) f32)`` where ``(kth, kth_noise)`` is the K-th-best
+    (score, noise) pair: every node OUTSIDE the shortlist is
+    lexicographically ≤ it, which is the bound the sequential scan's
+    certificate tests against (greedy_assign_shortlist).
+
+    Two jax.lax.top_k passes instead of a full 2-key sort:
+
+      1. ``kth`` = the K-th largest raw score. At most K-1 nodes score
+         strictly above it, so every such node MUST be in the shortlist.
+      2. a composite key — 2.0 for score > kth (noise < 1, so these
+         always win), the node's tie-noise for score == kth, -1
+         otherwise — whose top-K fills the remaining slots with the
+         BOUNDARY nodes carrying the largest noise. Max-normalized
+         plugin scores plateau hard (every replica of a deployment sees
+         the same 100.0 at its best nodes); selecting boundary nodes by
+         the same noise the scan tie-breaks with is what keeps a
+         plateau wider than K certified: the scan's winner is the
+         max-noise fitting plateau member, and every plateau member
+         outside the shortlist has strictly smaller noise than every
+         selected one (modulo 2^-24 collisions, which the certificate's
+         strict inequality sends to repair).
+    """
+    P = scores.shape[0]
+    rows = jnp.arange(P, dtype=jnp.int32)[:, None]
+    cols = jax.lax.broadcasted_iota(jnp.uint32, scores.shape, 1)
+    noise = tie_noise_from_cols(seed, rows, cols)            # (P,N)
+    kth = jax.lax.top_k(scores, k)[0][:, -1]                 # (P,)
+    key2 = jnp.where(scores > kth[:, None], jnp.float32(2.0),
+                     jnp.where(scores == kth[:, None], noise,
+                               jnp.float32(-1.0)))
+    key2_top, cand = jax.lax.top_k(key2, k)
+    # The K-th composite key is always a boundary node's noise (at most
+    # K-1 nodes sit strictly above kth), i.e. the smallest noise any
+    # SELECTED boundary node carries — the minor half of the bound.
+    return cand.astype(jnp.int32), kth, key2_top[:, -1]
+
+
+def greedy_assign_shortlist(scores: jnp.ndarray, requests: jnp.ndarray,
+                            free0: jnp.ndarray, key: jax.Array,
+                            k: int = 128) -> ShortlistAssignResult:
+    """``greedy_assign`` with the sequential scan compressed to per-pod
+    top-K shortlists — bit-identical decisions, certified per step.
+
+    The (P,N) work splits into a fully PARALLEL selection pass
+    (shortlist_select: two top_k calls + the noise lattice) and a
+    sequential scan whose step is K-wide instead of N-wide (~390× less
+    sequential work at 50k nodes, K=128). Exactness is certified, not
+    hoped for — each step proves the true argmax is inside the
+    shortlist, or repairs:
+
+      certificate (m = best fitting shortlist score, wn = winner's
+      tie-noise, (kth, kth_noise) = the K-th-best (score, noise) bound):
+
+        m >  kth                      every global tie candidate scores
+                                      above the bound, hence is in the
+                                      shortlist (≤ K-1 nodes do);
+        m == kth ∧ wn > kth_noise     boundary tie: outside candidates
+                                      at score kth all carry noise
+                                      < kth_noise < wn — the winner
+                                      beats them under the scan's exact
+                                      tie-break;
+        kth ≤ NEG                     fewer than K statically feasible
+                                      nodes exist; outside nodes are all
+                                      masked — the shortlist IS the row.
+
+      Anything else — capacity debits exhausted the shortlist, or a
+      2^-24 noise collision at the boundary — takes a counted full-row
+      rescan (lax.cond, so certified steps never touch the (N,) row),
+      which IS the original scan body: decisions are bit-identical to
+      ``greedy_assign`` in every case, certified or repaired.
+
+    The free-capacity carry stays full-size (N,R) and is debited with
+    the identical ``free.at[row].add(-req)`` op sequence, so
+    ``free_after`` is bitwise-equal too (the device-residency replay
+    mirror, engine/scheduler._DeviceResidency, holds unchanged).
+
+    Domain caps (ops/spreadcap.py) are NOT supported here — the running
+    per-domain counts would reintroduce an N-wide mask per step; callers
+    with enforced caps take the full scan (ops/pipeline.py conds on
+    ``caps.any_enforced``, mirroring the pallas kernel's gate).
+    """
+    P, N = scores.shape
+    k = min(max(int(k), 1), N)
+    seed = seed_from_key(key)
+    cand, kth, kth_noise = shortlist_select(scores, seed, k)
+    cand_scores = jnp.take_along_axis(scores, cand, axis=1)  # (P,K)
+
+    def body(free, inp):
+        i, req, cids, cs, kth_i, kthn_i = inp
+        fits = jnp.all(free[cids] >= req[None, :], axis=1)   # (K,)
+        s = jnp.where(fits, cs, NEG)
+        m = jnp.max(s)
+        noise = tie_noise_from_cols(seed, i, cids.astype(jnp.uint32))
+        tie = (s >= m) & fits
+        wn = jnp.max(jnp.where(tie, noise, -1.0))
+        # Winner = smallest global column among max-noise tie members —
+        # the full argmax's first-occurrence rule, stated in a form
+        # independent of the shortlist's internal ordering.
+        win = jnp.min(jnp.where(tie & (noise == wn), cids,
+                                N)).astype(jnp.int32)
+        certified = ((m > kth_i) | ((m == kth_i) & (wn > kthn_i))
+                     | (kth_i <= NEG))
+
+        def short_case(_):
+            return win, m > NEG, jnp.zeros((), dtype=bool)
+
+        def repair_case(_):
+            # The ORIGINAL scan body over the full row — repairs are
+            # exact by construction, not approximately patched.
+            srow = jax.lax.dynamic_index_in_dim(scores, i, 0,
+                                                keepdims=False)
+            fits_f = jnp.all(free >= req[None, :], axis=1)
+            sf = jnp.where(fits_f, srow, NEG)
+            mf = jnp.max(sf)
+            nf_ = tie_noise(seed, i, N)
+            tie_f = (sf >= mf) & fits_f
+            idx = jnp.argmax(jnp.where(tie_f, nf_, -1.0)).astype(jnp.int32)
+            return idx, mf > NEG, jnp.ones((), dtype=bool)
+
+        idx, ok, rep = jax.lax.cond(certified, short_case, repair_case,
+                                    None)
+        safe = jnp.where(ok, idx, 0)
+        free = free.at[safe].add(jnp.where(ok, -req, 0.0))
+        return free, (jnp.where(ok, idx, -1), ok, rep)
+
+    free_after, (chosen, assigned, repaired) = jax.lax.scan(
+        body, free0,
+        (jnp.arange(P, dtype=jnp.int32), requests, cand, cand_scores,
+         kth, kth_noise))
+    return ShortlistAssignResult(chosen, assigned, free_after, repaired)

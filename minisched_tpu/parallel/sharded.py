@@ -80,6 +80,12 @@ def build_sharded_step(plugin_set: PluginSet, mesh, eb_template, nf_template,
         spread_cdom=NamedSharding(mesh, P()),
         spread_dexist=NamedSharding(mesh, P()),
         scan_groups=NamedSharding(mesh, P()),
+        # Mesh steps keep full (P,N) rows — the shortlist's data-
+        # dependent per-pod gather would defeat the static shardings the
+        # mesh exists for (same reasoning as node sampling; the engine
+        # never passes ``shortlist`` to this builder, and the equality
+        # contract holds trivially: both knob states run the same scan).
+        shortlist_repaired=pod_only,
         filter_masks=stack_both, raw_scores=stack_both, norm_scores=stack_both)
 
     return jax.jit(stepfn, in_shardings=(eb_sh, nf_sh, af_sh, key_sh),

@@ -130,7 +130,8 @@ def make_sharded_assign(mesh):
             local, mesh=mesh,
             in_specs=(P(POD_AXIS, NODE_AXIS), P(), P(), P(), P(), P()),
             out_specs=GangResult(chosen=P(), assigned=P(), free_after=P(),
-                                 gang_rejected=P(), group_ok=P()),
+                                 gang_rejected=P(), group_ok=P(),
+                                 repaired=P()),
             check_vma=False,
         )(scores, requests, free0, group_ids, group_min, seed)
 

@@ -378,22 +378,120 @@ def test_slim_pack_roundtrip_matches_legacy(P):
     feasible = rng.integers(0, 70_000, P).astype(np.int32)
     static = rng.integers(0, 70_000, P).astype(np.int32)
     rejects = rng.integers(0, 70_000, (F, P)).astype(np.int32)
+    repaired = rng.random(P) > 0.9
     buf = np.array(pack_decision_slim(
         jnp.array(chosen), jnp.array(assigned), jnp.array(gang),
-        jnp.array(feasible), jnp.array(static), jnp.array(rejects)))
+        jnp.array(feasible), jnp.array(static), jnp.array(rejects),
+        jnp.array(repaired)))
     assert buf.dtype == np.uint8
     assert buf.nbytes == slim_buffer_bytes(P, F)
-    ch, a, g, fc, fs, rj = unpack_decision_slim(buf, P, F)
+    ch, a, g, fc, fs, rj, rep = unpack_decision_slim(buf, P, F)
     np.testing.assert_array_equal(ch, chosen)
     np.testing.assert_array_equal(a, assigned)
     np.testing.assert_array_equal(g, gang)
+    np.testing.assert_array_equal(rep, repaired)
     # counts saturate at I16_SAT — positivity (all the engine reads)
     # survives exactly
     np.testing.assert_array_equal(fc, np.minimum(feasible, I16_SAT))
     np.testing.assert_array_equal(fs, np.minimum(static, I16_SAT))
     np.testing.assert_array_equal(rj, np.minimum(rejects, I16_SAT))
-    # ~2.4× slimmer than the (5+F, P) i32 stack it replaces
-    assert buf.nbytes < (5 + F) * P * 4 / 2
+    # ~2.4× slimmer than the (6+F, P) i32 stack it replaces
+    assert buf.nbytes < (6 + F) * P * 4 / 2
+
+
+def test_insert_ports_matches_host_replay_and_cache_rule():
+    """ROADMAP residency follow-up (d): the device port-insertion op,
+    the numpy replay, and the cache's _add_ports rule agree bitwise —
+    first zero slot per nonzero port, pod order, duplicates written
+    twice, overflow dropped."""
+    import jax.numpy as jnp
+
+    from minisched_tpu.ops.residency import insert_ports, replay_ports_host
+
+    N, PORT, PP = 6, 4, 3
+    state = np.zeros((N, PORT), dtype=np.int32)
+    state[2] = [80, 0, 443, 0]          # partially occupied row
+    state[5] = [1, 2, 3, 4]             # full row: inserts must drop
+    rows = np.array([2, 2, 5, -1, 0], dtype=np.int32)
+    ports = np.array([[8080, 0, 0],
+                      [8080, 9090, 0],   # duplicate port value
+                      [7070, 0, 0],      # overflow: row 5 is full
+                      [1234, 0, 0],      # -1 row: skipped entirely
+                      [0, 0, 0]],        # no ports: no-op
+                     dtype=np.int32)
+    mirror = state.copy()
+    replay_ports_host(mirror, rows, ports)
+    dev = np.asarray(insert_ports(jnp.array(state), rows, ports))
+    np.testing.assert_array_equal(dev, mirror)
+    # the rule itself: row 2 filled in slot order, row 5 unchanged
+    np.testing.assert_array_equal(mirror[2], [80, 8080, 443, 8080])
+    np.testing.assert_array_equal(mirror[5], [1, 2, 3, 4])
+    assert 9090 not in mirror[2] or (mirror[2] == 9090).sum() <= 1
+    np.testing.assert_array_equal(mirror[0], 0)
+
+
+def test_port_heavy_steady_state_keeps_residency():
+    """Port-heavy workloads keep the zero-correction steady state
+    (follow-up (d)): with insertion modeled on device + mirror, a burst
+    of host-port pods establishes ONCE and every later batch is a
+    delta-corrected hit whose used_ports correction is empty (mirror ==
+    cache truth at bind time) — and placements equal the fallback's."""
+    def run(resident: bool):
+        c = Cluster()
+        try:
+            c.start(profile=Profile(
+                        name="ports",
+                        plugins=["NodeUnschedulable", "NodeResourcesFit",
+                                 "NodePorts"],
+                        plugin_args={"NodeResourcesFit":
+                                     {"score_strategy": None}}),
+                    config=_config(resident), with_pv_controller=False)
+            for i in range(4):
+                c.create_node(f"pn{i}", cpu=64000)
+            pods, pri = [], 200
+            for i in range(24):
+                pods.append(obj.Pod(
+                    metadata=obj.ObjectMeta(name=f"pp-{i}",
+                                            namespace="default"),
+                    spec=obj.PodSpec(
+                        requests={"cpu": 100 + i}, priority=pri,
+                        ports=[obj.ContainerPort(host_port=20000 + i),
+                               obj.ContainerPort(host_port=30000 + i)])))
+                pri -= 1
+            c.create_objects(pods)
+            deadline = time.monotonic() + 90
+            placements = {}
+            while time.monotonic() < deadline:
+                placements = {p.metadata.name: p.spec.node_name
+                              for p in c.list_pods() if p.spec.node_name}
+                if len(placements) == 24:
+                    break
+                time.sleep(0.05)
+            assert len(placements) == 24, placements
+            sched = c.service.scheduler
+            m = sched.metrics()
+            res = sched._residency
+            if resident and res is not None and res.epoch >= 0:
+                # white-box convergence: device == mirror bitwise after
+                # the burst (the I1 invariant, extended to ports)
+                np.testing.assert_array_equal(
+                    np.asarray(res.ports_dev), res.mirror_ports)
+                # 48 ports over 4 nodes overflow the 8-slot rows; the
+                # tracked prefix (both sides drop overflow identically)
+                # still occupies most of every row
+                assert (res.mirror_ports != 0).sum() >= 24
+            return placements, m
+        finally:
+            c.shutdown()
+
+    fb, _m_fb = run(resident=False)
+    rs, m_rs = run(resident=True)
+    assert rs == fb
+    assert m_rs["batches"] >= 3
+    # steady state held: one establish, every later batch a hit — the
+    # port churn never forced a resync or a correction-path divergence
+    assert m_rs["residency_resyncs"] == 1, m_rs
+    assert m_rs["residency_hits"] == m_rs["batches"] - 1, m_rs
 
 
 def test_apply_rows_scatter_and_bucketing():

@@ -343,6 +343,32 @@ def test_residency_corrupt_trips_desync_detector_and_resyncs():
     assert placed == ref_placed
 
 
+def test_shortlist_corrupt_caught_by_certification_cross_check():
+    """Shortlist tentpole detector: ``shortlist_repair:corrupt``
+    re-points an assigned pod's fetched chosen row at a DIFFERENT valid
+    node — a shortlist mispick the in-step certificate should have
+    repaired, deliberately invisible to the range sanity check. With the
+    certification cross-check armed (shortlist_check_every=1) the
+    full-scan comparison must catch it, count a shortlist_desync,
+    permanently revert the engine to the full-width scan
+    (shortlist_width gauge -> 0), and the supervised replay must land
+    every pod on the fault-free run's node."""
+    cfg = _config(pipeline=False, shortlist_check_every=1)
+    ref_placed, ref_m = _run_burst("", cfg)
+    assert ref_m["shortlist_checks"] >= 2   # the detector genuinely ran
+    assert ref_m["shortlist_desyncs"] == 0
+    assert ref_m["shortlist_width"] > 0
+
+    placed, m = _run_burst("shortlist_repair:corrupt@2", cfg)
+    assert m["fault_fires_shortlist_repair"] == 1
+    assert m["shortlist_desyncs"] == 1
+    assert m["shortlist_width"] == 0        # reverted to the full scan
+    assert m["batch_faults"] >= 1
+    assert m["supervisor_escalations"] >= 1
+    assert m["degradation_state"] == "resident"
+    assert placed == ref_placed
+
+
 def test_bind_gate_reconciles_without_losing_or_double_binding():
     """An aborted bulk bind task reconciles per pod against store truth:
     unbound pods are unassumed + requeued (never lost), already-bound

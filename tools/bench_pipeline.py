@@ -53,7 +53,8 @@ def fail_flush_phase(n: int, p: int) -> dict:
             backoff_initial_s=30.0, backoff_max_s=30.0,
             pipeline=os.environ.get("MINISCHED_PIPELINE", "1") != "0",
             device_resident=os.environ.get(
-                "MINISCHED_DEVICE_RESIDENT", "1") != "0")
+                "MINISCHED_DEVICE_RESIDENT", "1") != "0",
+            shortlist=os.environ.get("MINISCHED_SHORTLIST", "1") != "0")
         sched = svc.start_scheduler(
             Profile(name="bench",
                     plugins=["NodeUnschedulable", "NodeResourcesFit"],

@@ -91,9 +91,17 @@ def main() -> None:
     nf, names = cache.snapshot(pad=n_pad)
     af = cache.snapshot_assigned(pad=16)
     key = jax.random.PRNGKey(0)
-    step = build_step(pset, explain=False)
+    from minisched_tpu.config import config_from_env
+
+    cfg_env = config_from_env()
+    sl_k = cfg_env.shortlist_k if cfg_env.shortlist else None
+    step = build_step(pset, explain=False, shortlist=sl_k)
     print(f"shapes: P={p_pad} N={n_pad} A={af.valid.shape[0]} "
           f"G={eb.gf.valid.shape[0]}", flush=True)
+    print(f"shortlist: width={min(sl_k, n_pad) if sl_k else 0} "
+          f"(sequential scan width {n_pad} -> "
+          f"{min(sl_k, n_pad) if sl_k else n_pad} per step; "
+          "MINISCHED_SHORTLIST / MINISCHED_SHORTLIST_K)", flush=True)
 
     def timed(label, fn):
         out = fn()
@@ -119,7 +127,7 @@ def main() -> None:
                               plugin_args={"NodeResourcesFit":
                                            {"score_strategy": None}}
                               ).build()
-                substep = build_step(sub, explain=False)
+                substep = build_step(sub, explain=False, shortlist=sl_k)
             out = substep(eb, nf, af, key)
             jax.block_until_ready(out)
             t0 = time.perf_counter()
@@ -132,14 +140,19 @@ def main() -> None:
             prev = dt
 
     d = timed("step_s", lambda: step(eb, nf, af, key))
+    n_rep = int(np.asarray(d.shortlist_repaired).sum())
+    live = len(pods)
+    print(f"shortlist_repairs = {n_rep}/{live} pods "
+          f"(certified-step fraction {1.0 - n_rep / max(live, 1):.4f})",
+          flush=True)
     legacy = timed("pack_fetch_s", lambda: np.array(_pack_decision(
         d.chosen, d.assigned, d.gang_rejected, d.feasible_counts,
-        d.feasible_static, d.reject_counts)))
+        d.feasible_static, d.reject_counts, d.shortlist_repaired)))
     from minisched_tpu.ops.residency import pack_decision_slim
 
     slim = timed("slim_fetch_s", lambda: np.array(pack_decision_slim(
         d.chosen, d.assigned, d.gang_rejected, d.feasible_counts,
-        d.feasible_static, d.reject_counts)))
+        d.feasible_static, d.reject_counts, d.shortlist_repaired)))
     # Per-batch transfer budget, both residency modes (engine counters
     # measure the same quantities live; this is the shape-exact model):
     dyn_h2d = nf.free.nbytes + nf.used_ports.nbytes
