@@ -12,10 +12,10 @@ CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 # tier1 uses pipefail/PIPESTATUS (bash-isms).
 SHELL := /bin/bash
 
-.PHONY: test tier1 fault-smoke shortlist-smoke profile-smoke start \
-        start-remote start-client-engine demo docs bench bench_sharded \
-        bench-cpu bench-pipeline bench-residency bench-shortlist dryrun \
-        dryrun-dcn soak soak-faults
+.PHONY: test tier1 fault-smoke shortlist-smoke trace-smoke profile-smoke \
+        start start-remote start-client-engine demo docs bench \
+        bench_sharded bench-cpu bench-pipeline bench-residency \
+        bench-shortlist bench-trace dryrun dryrun-dcn soak soak-faults
 
 # Unit + integration suite on a virtual 8-device CPU mesh.
 test:
@@ -30,11 +30,23 @@ shortlist-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_shortlist.py -x -q \
 	  -p no:cacheprovider -p no:randomly
 
+# Fast deterministic flight-recorder suite (~40 s): off-mode is a
+# bit-identical no-op across pipelined/resident/shortlist modes, span
+# nesting holds under the two-deep pipeline, fault fires + ladder
+# escalations surface as instants, histogram counts equal bound
+# decisions, exported traces validate against the Chrome trace-event
+# schema. A tier-1 prerequisite: the measurement layer every later perf
+# PR reports against must not perturb decisions.
+trace-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs.py -x -q \
+	  -p no:cacheprovider -p no:randomly
+
 # The EXACT ROADMAP tier-1 verify command (dots count + exit code
 # preserved) — what the driver runs after every PR; run it locally
 # before shipping. shortlist-smoke runs first: the arbitration
-# exactness contract gates the rest of the suite.
-tier1: shortlist-smoke
+# exactness contract gates the rest of the suite; trace-smoke next: the
+# measurement layer must not perturb decisions.
+tier1: shortlist-smoke trace-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -128,6 +140,15 @@ bench-residency:
 # prize; the CPU artifact proves the equality + repair claims.
 bench-shortlist:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_shortlist.py
+
+# Flight-recorder contract bench at CPU shapes, interleaved off/on
+# rounds (the committed BENCH_TRACE.json): recorder overhead ≤5% on the
+# create→bound window, the engine_gap_s decomposition summing to the
+# gap within 2%, the exported Chrome trace schema-valid with ≥95%
+# scheduling-loop span coverage, and histogram counts covering every
+# bound decision.
+bench-trace:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_trace.py
 
 # Compile-check the flagship single-chip step and the multi-chip sharded
 # step on an 8-device virtual mesh.

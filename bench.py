@@ -829,6 +829,24 @@ def roofline(seconds: float, p: int, n: int, n_filters: int,
     }
 
 
+def _hist_latency_keys(m: dict, prefix: str) -> dict:
+    """p50/p95/p99 create→bound from the engine's fixed-bucket lifecycle
+    histogram (Scheduler.metrics()["histograms"]) — interpolated from
+    bucket counts (obs.hist_quantile), covering EVERY bound pod of the
+    run rather than the sampled windows."""
+    from minisched_tpu.obs import hist_quantile
+
+    snap = (m.get("histograms") or {}).get("pod_create_to_bound_s")
+    if not snap or not snap.get("count"):
+        return {}
+    return {
+        f"{prefix}_hist_p50_s": round(hist_quantile(snap, 0.50), 4),
+        f"{prefix}_hist_p95_s": round(hist_quantile(snap, 0.95), 4),
+        f"{prefix}_hist_p99_s": round(hist_quantile(snap, 0.99), 4),
+        f"{prefix}_hist_bound_count": int(snap["count"]),
+    }
+
+
 def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                  batch_size=None, prefix="engine", window_s=15.0,
                  explain=False, backoff_s=None, wire=False,
@@ -1060,6 +1078,35 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                 f"{prefix}_commit_overlap_s":
                     round(m.get("commit_overlap_s", 0.0), 4),
                 f"{prefix}_gap_s": round(m.get("gap_s_total", 0.0), 4),
+                # engine_gap_s decomposition (flight-recorder layer): the
+                # four components PARTITION gap_s — every booking is
+                # tagged gather (queue-pop waits) / encode (batch-
+                # formation glue) / fetch (dispatch→fetch turnaround) /
+                # commit (blocking flush wait) — so their sum equals
+                # gap_s by construction (BENCH_TRACE.json proves it
+                # within rounding).
+                f"{prefix}_gap_gather_s":
+                    round(m.get("gap_gather_s_total", 0.0), 4),
+                f"{prefix}_gap_encode_s":
+                    round(m.get("gap_encode_s_total", 0.0), 4),
+                f"{prefix}_gap_fetch_s":
+                    round(m.get("gap_fetch_s_total", 0.0), 4),
+                f"{prefix}_gap_commit_s":
+                    round(m.get("gap_commit_s_total", 0.0), 4),
+                f"{prefix}_batch_gap_gather_s":
+                    m.get("batch_series", {}).get("gap_gather_s", []),
+                f"{prefix}_batch_gap_encode_s":
+                    m.get("batch_series", {}).get("gap_encode_s", []),
+                f"{prefix}_batch_gap_fetch_s":
+                    m.get("batch_series", {}).get("gap_fetch_s", []),
+                f"{prefix}_batch_gap_commit_s":
+                    m.get("batch_series", {}).get("gap_commit_s", []),
+                # create→bound percentiles from the engine's fixed-bucket
+                # lifecycle HISTOGRAM (obs.Histogram) — derived from
+                # bucket counts over every bound pod, not from the
+                # lat_samples sampled windows above (which stay for
+                # cross-round comparability).
+                **_hist_latency_keys(m, prefix),
                 # Transfer observability (engine/scheduler.py counters):
                 # host→device node-feature bytes (static uploads, full
                 # dynamic uploads, residency correction deltas) and

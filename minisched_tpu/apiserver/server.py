@@ -107,6 +107,14 @@ class APIServer:
         # so one scrape covers the whole simulator (emitted with the
         # minisched_engine_ prefix). Providers must be thread-safe.
         self.metrics_providers: list = []
+        # Histogram extension point: callables returning {name:
+        # obs.Histogram snapshot dict (bounds/counts/sum/count)} —
+        # emitted as native Prometheus histograms (`_bucket` with
+        # cumulative le labels, `_sum`, `_count`) under the same
+        # minisched_engine_ prefix. A co-located SchedulerService
+        # appends metrics_histograms here (the per-pod latency
+        # histograms the engine feeds from lifecycle stamps).
+        self.histogram_providers: list = []
         # server-side request counters for /metrics (lock-guarded)
         self._counters: dict = {}
         self._counters_lock = threading.Lock()
@@ -126,7 +134,7 @@ class APIServer:
                                 self.metrics_providers, self._counters,
                                 self._counters_lock, self.checkpointer,
                                 self._mutating_cv, self._track_mutation,
-                                self._draining)
+                                self._draining, self.histogram_providers)
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
@@ -180,7 +188,8 @@ def _make_handler(store: ClusterStore, token: str | None = None,
                   counters: dict | None = None,
                   counters_lock: threading.Lock | None = None,
                   checkpointer=None, mutating_cv=None,
-                  track_mutation=None, draining=None):
+                  track_mutation=None, draining=None,
+                  histogram_providers: list | None = None):
     if counters is None:
         counters = {}
     if counters_lock is None:
@@ -363,11 +372,17 @@ def _make_handler(store: ClusterStore, token: str | None = None,
             self._guard(run)
 
         def _metrics(self):
-            """Prometheus text exposition (version 0.0.4): server
-            counters, store gauges, and registered provider gauges. Keys
-            are sanitized to metric-name characters; non-numeric provider
-            values are skipped (providers may carry diagnostic fields
-            like batch_sizes lists)."""
+            """TYPED Prometheus text exposition (version 0.0.4): every
+            series carries its `# HELP` and `# TYPE` lines, and latency
+            histograms from ``histogram_providers`` (obs.Histogram
+            snapshots) are emitted in the NATIVE histogram form —
+            `_bucket` samples with cumulative ``le`` labels, `_sum`,
+            `_count` — so Prometheus' histogram_quantile works on the
+            scrape directly. Existing flat counter/gauge NAMES are
+            unchanged (scrape-compatible with pre-flight-recorder
+            dashboards). Keys are sanitized to metric-name characters;
+            non-numeric provider values are skipped (providers may
+            carry diagnostic fields like batch_sizes lists)."""
             import re as _re
 
             def clean(name: str) -> str:
@@ -375,27 +390,58 @@ def _make_handler(store: ClusterStore, token: str | None = None,
 
             lines = []
 
-            def emit(name, value, mtype="gauge", labels=""):
+            def emit(name, value, mtype="gauge", labels="",
+                     help_text=None):
+                lines.append(f"# HELP {name} "
+                             f"{help_text or 'minisched ' + mtype}")
                 lines.append(f"# TYPE {name} {mtype}")
                 lines.append(f"{name}{labels} {value}")
+
+            def emit_histogram(name, snap, help_text=None):
+                """Native histogram exposition from an obs.Histogram
+                snapshot (finite bucket bounds + one +Inf bucket;
+                ``le`` labels are CUMULATIVE per the format)."""
+                bounds = snap.get("bounds") or []
+                cnts = snap.get("counts") or []
+                if len(cnts) != len(bounds) + 1:
+                    return  # not a histogram snapshot; skip quietly
+                lines.append(
+                    f"# HELP {name} "
+                    f"{help_text or 'minisched latency histogram (s)'}")
+                lines.append(f"# TYPE {name} histogram")
+                cum = 0
+                for b, c in zip(bounds, cnts):
+                    cum += c
+                    lines.append(f'{name}_bucket{{le="{format(b, "g")}"}}'
+                                 f' {cum}')
+                cum += cnts[-1]
+                lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+                lines.append(f'{name}_sum {snap.get("sum", 0.0)}')
+                lines.append(f'{name}_count {snap.get("count", cum)}')
 
             with counters_lock:
                 snap = dict(counters)
             for k in sorted(snap):
                 emit(f"minisched_apiserver_{clean(k)}_total", snap[k],
-                     "counter")
+                     "counter",
+                     help_text="apiserver request/rejection counter")
             st = store.stats()
-            # one TYPE line for the metric, then all its samples — the
-            # 0.0.4 exposition format rejects repeated TYPE lines
+            # one HELP/TYPE pair for the metric, then all its samples —
+            # the 0.0.4 exposition format rejects repeated TYPE lines
+            lines.append("# HELP minisched_store_objects live objects "
+                         "per kind")
             lines.append("# TYPE minisched_store_objects gauge")
             for kind, n in sorted(st["objects"].items()):
                 lines.append(
                     f'minisched_store_objects{{kind="{kind}"}} {n}')
             emit("minisched_store_resource_version",
-                 st["resource_version"], "counter")
-            emit("minisched_store_watch_log_depth", st["watch_log_depth"])
+                 st["resource_version"], "counter",
+                 help_text="store resource version (monotonic)")
+            emit("minisched_store_watch_log_depth", st["watch_log_depth"],
+                 help_text="retained watch-log events")
             emit("minisched_store_watch_log_capacity",
-                 st["watch_log_capacity"])
+                 st["watch_log_capacity"],
+                 help_text="watch-log ring capacity")
             # Process-wide fault-gate fire counts (faults.py): gates
             # outside any engine (http, checkpoint, informer) would be
             # invisible to the engine providers' metrics; one scrape
@@ -403,6 +449,8 @@ def _make_handler(store: ClusterStore, token: str | None = None,
             # provably fault-free.
             from ..faults import FAULTS as _faults
 
+            lines.append("# HELP minisched_fault_fires_total injected "
+                         "fault-gate fires per gate (faults.py)")
             lines.append("# TYPE minisched_fault_fires_total counter")
             for gate, n in sorted(_faults.counts().items()):
                 lines.append(
@@ -412,9 +460,27 @@ def _make_handler(store: ClusterStore, token: str | None = None,
                     for k, v in provider().items():
                         if (isinstance(v, (int, float))
                                 and not isinstance(v, bool)):
-                            emit(f"minisched_engine_{clean(k)}", v)
+                            emit(f"minisched_engine_{clean(k)}", v,
+                                 "counter" if k.endswith(
+                                     ("_total", "_bound", "_seen"))
+                                 else "gauge",
+                                 help_text=f"engine metric {k} "
+                                           "(Scheduler.metrics)")
+                        elif isinstance(v, dict) and "bounds" in v:
+                            # a provider may inline histogram snapshots
+                            emit_histogram(f"minisched_engine_{clean(k)}",
+                                           v)
                 except Exception:  # a broken provider must not 500 scrapes
                     log.exception("metrics provider failed")
+            for provider in (histogram_providers or ()):
+                try:
+                    for k, v in provider().items():
+                        emit_histogram(
+                            f"minisched_engine_{clean(k)}", v,
+                            help_text=f"engine lifecycle latency {k} "
+                                      "(obs.Histogram, seconds)")
+                except Exception:
+                    log.exception("histogram provider failed")
             body = ("\n".join(lines) + "\n").encode()
             self.send_response(200)
             self.send_header("Content-Type",

@@ -14,6 +14,7 @@ from typing import Callable, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from ..obs import traced
 from ..state import objects as obj_mod
 from ..state.objects import (RESOURCE_INDEX, Node, Pod, claim_keys,
                              gang_key, pod_requests)
@@ -790,6 +791,7 @@ class NodeFeatureCache:
         feats, names, _sv, _incs = self.snapshot_versioned(pad)
         return feats, names
 
+    @traced("cache.snapshot")
     def snapshot_versioned(self,
                            pad: Union[int, Callable[[int], int],
                                       None] = None,
@@ -822,6 +824,7 @@ class NodeFeatureCache:
             pad, known_static, None)
         return feats, names, sv, incs
 
+    @traced("cache.snapshot_resident")
     def snapshot_resident(self,
                           pad: Union[int, Callable[[int], int],
                                      None] = None,
@@ -928,6 +931,7 @@ class NodeFeatureCache:
             incs[:m] = self._row_inc[:m]
             return feats, names, sv, incs, delta
 
+    @traced("cache.snapshot_assigned")
     def snapshot_assigned(self, pad: Union[int, Callable[[int], int],
                                          None] = None,
                           ) -> AssignedPodFeatures:

@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
+from ..obs import span
 from ..state.events import ClusterEvent
 from ..state.objects import Pod, gang_key
 
@@ -57,6 +58,13 @@ class QueuedPodInfo:
     # move-request cycle observed when this pod was popped; see
     # SchedulingQueue._move_cycle.
     popped_at_cycle: int = 0
+    # Lifecycle stamps (monotonic) feeding the engine's latency
+    # histograms (obs.Histogram): queued = added_at above (first entry),
+    # gathered = last pop into a scheduling attempt, decided = that
+    # attempt's arbitration verdict. A retried pod's stage windows
+    # describe its SUCCESSFUL attempt; create→bound spans everything.
+    gathered_at: float = 0.0
+    decided_at: float = 0.0
     # Which sub-queue holds the pod ("active" | "backoff" | "unsched" |
     # "popped") — lets update/delete be O(1) dict lookups instead of the
     # linear scans the round-1 design used (quadratic churn at 10k+ pods).
@@ -282,6 +290,19 @@ class SchedulingQueue:
     def pop_batch(self, max_n: int, timeout: Optional[float] = None,
                   gather_window: float = 0.0,
                   gather_idle: float = 0.0) -> List[QueuedPodInfo]:
+        """Flight-recorded wrapper around :meth:`_pop_batch` — the
+        ``queue.pop`` span covers the blocking wait plus the batch-
+        formation window (on the gather worker's own lane in pipelined
+        mode), with the popped size attached."""
+        with span("queue.pop") as sp:
+            batch = self._pop_batch(max_n, timeout, gather_window,
+                                    gather_idle)
+            sp.set(pods=len(batch))
+            return batch
+
+    def _pop_batch(self, max_n: int, timeout: Optional[float] = None,
+                   gather_window: float = 0.0,
+                   gather_idle: float = 0.0) -> List[QueuedPodInfo]:
         """Block until activeQ is non-empty (condvar — fixes the busy-wait at
         reference queue.go:84-92), then pop up to max_n pods ordered by
         descending priority (stable FIFO within a priority).
@@ -432,6 +453,7 @@ class SchedulingQueue:
         touch it (it re-enters via add_unschedulable/requeue_backoff)."""
         qpi.popped_at_cycle = self._move_cycle
         qpi.where = "popped"
+        qpi.gathered_at = time.monotonic()
         self._index.pop(qpi.key, None)
 
     def _backoff_duration(self, qpi: QueuedPodInfo) -> float:

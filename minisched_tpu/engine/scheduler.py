@@ -34,6 +34,7 @@ from ..encode.cache import bucket_for, step_bucket
 from ..encode.features import NodeFeatures
 from ..errors import ConflictError, NotFoundError
 from ..faults import FAULTS, FaultWorkerDeath
+from ..obs import Histogram, instant, span
 from ..ops.pipeline import Decision, build_step
 from ..ops.residency import (I16_SAT, apply_rows, apply_rows_bytes,
                              pack_decision_slim, unpack_decision_slim)
@@ -107,6 +108,8 @@ class _Supervisor:
             return
         self.level += 1
         self._sched._sup_count("supervisor_escalations")
+        instant("supervisor.escalate", to=DEGRADATION_LADDER[self.level],
+                level=self.level, reason=reason)
         log.warning("supervisor: degraded to %r (%s)",
                     DEGRADATION_LADDER[self.level], reason)
 
@@ -119,6 +122,8 @@ class _Supervisor:
             self._clean = 0
             self.level -= 1
             self._sched._sup_count("supervisor_recoveries")
+            instant("supervisor.recover",
+                    to=DEGRADATION_LADDER[self.level], level=self.level)
             log.info("supervisor: probation passed; re-escalated to %r",
                      DEGRADATION_LADDER[self.level])
 
@@ -134,7 +139,7 @@ class _InflightBatch:
                  "shapes", "seq", "t0", "t_encode", "t_dispatch",
                  "t_fetch_start", "t_step", "t_resolved", "commit_t0",
                  "commit_t1", "res_carried", "assumed", "detached",
-                 "h2d0", "fetch0", "h2d1", "fetch1", "sl_repairs")
+                 "h2d0", "fetch0", "h2d1", "fetch1", "sl_repairs", "gap")
 
     def __init__(self):
         self.failures: List[tuple] = []  # (qpi, plugins, message, retryable)
@@ -163,6 +168,10 @@ class _InflightBatch:
         self.h2d0 = self.fetch0 = 0.0
         self.h2d1 = self.fetch1 = 0.0
         self.sl_repairs = 0
+        # Inter-batch gap glue attributed to THIS batch (component →
+        # seconds; Scheduler._book_gap accumulates between prepares and
+        # _prepare_batch adopts the pending dict here).
+        self.gap: Dict[str, float] = {}
         # This batch's free/used_ports input is the device-resident
         # chain (_DeviceResidency) — its free_after must be carried and
         # its debits replayed into the host mirror at resolve time.
@@ -1025,12 +1034,39 @@ class Scheduler:
         # the NEXT batch takes.
         self._last_committed_seq = -1
         self._prep_window: tuple = (0.0, 0.0)
+        # Pending inter-batch gap components (scheduling thread only);
+        # adopted into each _InflightBatch at prepare — see _book_gap.
+        self._gap_pending: Dict[str, float] = {}
+        # Per-pod lifecycle latency histograms (obs.Histogram), fed from
+        # the QueuedPodInfo stamps (queued=added_at, gathered_at,
+        # decided_at) and observed exactly where pods_bound increments,
+        # so create_to_bound's count always equals the bound decisions.
+        # Always on: the cost is a bisect per bound pod, off the device
+        # path — the MINISCHED_TRACE knob gates only the span stream.
+        self._hists: Dict[str, Histogram] = {
+            "pod_queue_wait_s": Histogram(),
+            "pod_decide_s": Histogram(),
+            "pod_bind_s": Histogram(),
+            "pod_create_to_bound_s": Histogram(),
+        }
         self._metrics: Dict[str, float] = {
             "batches": 0, "pods_seen": 0, "pods_assigned": 0,
             "pods_failed": 0, "pods_bound": 0, "bind_conflicts": 0,
             "encode_s_total": 0.0, "step_s_total": 0.0,
             "step_dispatch_s_total": 0.0, "commit_s_total": 0.0,
             "gap_s_total": 0.0,
+            # engine_gap_s decomposition: every gap_s_total booking
+            # routes through _book_gap tagged with the glue component it
+            # measured, so these four PARTITION gap_s_total exactly —
+            # gather = blocking queue-pop waits; encode = batch-formation
+            # glue (gang pull + priority sort) before the metered encode
+            # window; fetch = the dispatch→fetch turnaround (pipeline
+            # hand-off before the decision readback blocks); commit =
+            # the scheduling thread's blocking wait on the previous
+            # batch's commit flush. The per-batch series twin lives in
+            # batch_series (gap_*_s).
+            "gap_gather_s_total": 0.0, "gap_encode_s_total": 0.0,
+            "gap_fetch_s_total": 0.0, "gap_commit_s_total": 0.0,
             # Pipelined-cycle overlap accounting (_run_pipelined): host
             # work that ran CONCURRENTLY with other pipeline stages —
             # commit_overlap_s = commit-flush time hidden behind the next
@@ -1077,6 +1113,20 @@ class Scheduler:
     def _sup_count(self, key: str, n: int = 1) -> None:
         with self._metrics_lock:
             self._metrics[key] += n
+
+    def _book_gap(self, component: str, dt: float) -> None:
+        """Book inter-batch glue into gap_s_total, tagged with its
+        component (gather/encode/fetch/commit — see the metric-dict
+        comment). Scheduling-thread only: the pending dict is adopted by
+        the next _prepare_batch so the per-batch series line up with the
+        batch each wait preceded."""
+        if dt <= 0.0:
+            return
+        with self._metrics_lock:
+            self._metrics["gap_s_total"] += dt
+            self._metrics[f"gap_{component}_s_total"] += dt
+        self._gap_pending[component] = (
+            self._gap_pending.get(component, 0.0) + dt)
 
     def _res_count(self, *, resync: bool, h2d: int) -> None:
         with self._metrics_lock:
@@ -1144,6 +1194,7 @@ class Scheduler:
         bad = int(np.sum((chosen[:L] != ref_chosen[:L])
                          | (assigned[:L] != ref_assigned[:L])))
         self._sup_count("shortlist_desyncs")
+        instant("shortlist.desync", pods=bad)
         self._disable_shortlist(
             f"decisions diverged from the full scan on {bad} pod(s)")
         raise EngineDesync(
@@ -1198,6 +1249,14 @@ class Scheduler:
                             d.scan_groups)
 
     def _fetch_spread(self, payload):
+        """Flight-recorded wrapper: ``fetch.spread`` covers the blocking
+        spread-table readback (None payload records nothing)."""
+        if payload is None:
+            return None
+        with span("fetch.spread"):
+            return self._fetch_spread_impl(payload)
+
+    def _fetch_spread_impl(self, payload):
         """Materialize the (2P+2, G) spread-arbitration table from
         either form _prepare_batch staged: the device-packed buffer
         (single fetch, off-mesh) or the raw Decision (mesh: per-leaf
@@ -1219,6 +1278,14 @@ class Scheduler:
         return sp
 
     def _fetch_decision(self, packed_dev, p: int, f: int, decision=None):
+        """Flight-recorded wrapper: ``fetch.decision`` covers the
+        blocking device readback + slim/i32 decode for every call site
+        (main batch, residual pass, repair iterations, cross-checks)."""
+        with span("fetch.decision"):
+            return self._fetch_decision_impl(packed_dev, p, f, decision)
+
+    def _fetch_decision_impl(self, packed_dev, p: int, f: int,
+                             decision=None):
         """Block on the ONE packed decision fetch and unpack it into
         writable host arrays: (chosen i32, assigned bool, gang_rejected
         bool, feasible i32, feasible_static i32, rejects (F,P) i32,
@@ -1354,11 +1421,10 @@ class Scheduler:
                 continue
             # Batch-to-batch dead time (queue pop + informer lag): the
             # sustained-throughput diagnostic the per-phase timers
-            # inside schedule_batch can't see.
+            # inside schedule_batch can't see. The whole window is spent
+            # inside pop_batch — gather glue.
             if last_done is not None:
-                with self._metrics_lock:
-                    self._metrics["gap_s_total"] += (
-                        time.perf_counter() - last_done)
+                self._book_gap("gather", time.perf_counter() - last_done)
             try:
                 self.schedule_batch(batch)
             except Exception:
@@ -1404,8 +1470,14 @@ class Scheduler:
                     if gather_fut is not None:
                         # plain result(): the last_done gap booking below
                         # already covers this wait (using _take_gather
-                        # here would double-count it)
-                        batch, gather_fut = gather_fut.result(), None
+                        # here would double-count it). Span it though —
+                        # this is where the scheduling thread sits for
+                        # the whole inter-burst idle, and an unspanned
+                        # idle would read as unattributed time in the
+                        # flight recorder's coverage.
+                        with span("gather.wait"):
+                            batch = gather_fut.result()
+                        gather_fut = None
                     else:
                         batch = pop()
                     if not batch:
@@ -1413,9 +1485,8 @@ class Scheduler:
                         pending = self._await_commit(pending)
                         continue
                     if last_done is not None:
-                        with self._metrics_lock:
-                            self._metrics["gap_s_total"] += (
-                                time.perf_counter() - last_done)
+                        self._book_gap("gather",
+                                       time.perf_counter() - last_done)
                     inflight, pending = self._prepare_or_trace(batch,
                                                                pending)
                     continue
@@ -1482,11 +1553,11 @@ class Scheduler:
         comparable across modes. An empty result is genuine idle (sync
         resets its gap clock for those) and books nothing."""
         t0 = time.perf_counter()
-        batch = gather_fut.result()
+        with span("gather.wait"):
+            batch = gather_fut.result()
         waited = time.perf_counter() - t0
         if batch and waited > 0.0:
-            with self._metrics_lock:
-                self._metrics["gap_s_total"] += waited
+            self._book_gap("gather", waited)
         return batch, None
 
     def _prepare_or_trace(self, batch, pending):
@@ -1616,11 +1687,17 @@ class Scheduler:
         fut, done = pending
         t0 = time.perf_counter()
         try:
-            fut.result()  # _commit_guarded re-raises only worker death
+            with span("commit.wait"):
+                fut.result()  # _commit_guarded re-raises only worker death
         except FaultWorkerDeath:
             self._restart_commit_worker(done)
             return None
         waited = time.perf_counter() - t0
+        # The EXPOSED flush wait is inter-batch glue the per-stage meters
+        # miss (commit_s books the flush itself on the worker; overlap
+        # books the hidden part) — the commit slot of the gap
+        # decomposition.
+        self._book_gap("commit", waited)
         flush = max(0.0, done.commit_t1 - done.commit_t0)
         with self._metrics_lock:
             self._metrics["commit_overlap_s"] += max(0.0, flush - waited)
@@ -1657,6 +1734,16 @@ class Scheduler:
         with self._trace_lock:
             self._trace_dir = trace_dir
 
+    def dump_trace(self, path: str) -> str:
+        """Export the process-wide flight recorder (obs.TRACE ring
+        buffers — spans at every engine seam, fault/ladder instants) as
+        Chrome trace-event JSON, Perfetto-loadable. Arm the recorder
+        with MINISCHED_TRACE=1 (or obs.configure) first; an unarmed dump
+        writes a valid but empty trace. Returns ``path``."""
+        from ..obs import TRACE
+
+        return TRACE.export_chrome(path)
+
     def schedule_batch(self, batch: List[QueuedPodInfo]) -> Decision:
         with self._trace_lock:
             trace_dir, self._trace_dir = self._trace_dir, None
@@ -1689,10 +1776,20 @@ class Scheduler:
         return inf.decision
 
     def _prepare_batch(self, batch: List[QueuedPodInfo]) -> "_InflightBatch":
+        """Flight-recorded wrapper: the ``prepare`` span covers gang
+        pull → encode → snapshot → dispatch on the scheduling thread."""
+        with span("prepare") as sp:
+            inf = self._prepare_batch_impl(batch)
+            sp.set(pods=len(inf.batch), seq=inf.seq)
+            return inf
+
+    def _prepare_batch_impl(self,
+                            batch: List[QueuedPodInfo]) -> "_InflightBatch":
         """PREPARE: gang pull → encode → snapshot → async step dispatch.
         Returns with the device executing the batch (JAX async dispatch;
         nothing here blocks on device results), so the pipelined loop can
         overlap the previous batch's commit and the next pop with it."""
+        t_in = time.perf_counter()
         # Supervisor replay anchor: prepares are strictly sequential on
         # the scheduling thread (encode-after-arbitration), so at any
         # batch fault this is the step-counter value the aborted attempt
@@ -1736,6 +1833,13 @@ class Scheduler:
             return st
 
         t0 = time.perf_counter()
+        # Batch-formation glue (gang pull + priority sort + per-batch
+        # setup) between the pop and the metered encode window — the
+        # encode slot of the gap decomposition — then adopt every gap
+        # component booked since the previous prepare, so the per-batch
+        # series attribute each wait to the batch it preceded.
+        self._book_gap("encode", t0 - t_in)
+        inf.gap, self._gap_pending = self._gap_pending, {}
         with self._metrics_lock:
             # prepare STARTED; end published when dispatch returns (None
             # end = still encoding — the commit worker's encode-overlap
@@ -1772,16 +1876,18 @@ class Scheduler:
                 return pairs
 
         encode_hard: Dict[int, tuple] = {}
-        eb = encode_pods(pods, step_bucket(len(pods), cfg.pod_bucket_min),
-                         cfg=self.cache.cfg,
-                         registry=self.cache.registry,
-                         overflow=self.cache.overflow,
-                         volumes_ready_fn=lambda p: vol_state(p)[0],
-                         gang_bound_fn=self.cache.gang_bound_count,
-                         volume_info_fn=lambda p: vol_state(p)[1:],
-                         anti_forbidden_fn=anti_fn,
-                         hard_failed=encode_hard,
-                         selector_spread=self._selspread_enabled)
+        with span("encode.pods", pods=len(pods)):
+            eb = encode_pods(pods,
+                             step_bucket(len(pods), cfg.pod_bucket_min),
+                             cfg=self.cache.cfg,
+                             registry=self.cache.registry,
+                             overflow=self.cache.overflow,
+                             volumes_ready_fn=lambda p: vol_state(p)[0],
+                             gang_bound_fn=self.cache.gang_bound_count,
+                             volume_info_fn=lambda p: vol_state(p)[1:],
+                             anti_forbidden_fn=anti_fn,
+                             hard_failed=encode_hard,
+                             selector_spread=self._selspread_enabled)
         # Only fail closed for constraints this profile's plugin set
         # actually ENFORCES: a profile without InterPodAffinity ignores
         # affinity terms entirely (encode always records them; only the
@@ -1835,7 +1941,8 @@ class Scheduler:
                 # (the supervisor's desync detector) has a real defect
                 # to catch.
                 act = FAULTS.hit("residency")
-                nf = res.attach(self, nf, dyn_delta)
+                with span("h2d.dyn"):
+                    nf = res.attach(self, nf, dyn_delta)
                 carried = True
                 if act == "corrupt" and res.mirror_free is not None:
                     res.mirror_free[0, :] += 1.0
@@ -1848,6 +1955,7 @@ class Scheduler:
                 log.warning("resident carry cross-check failed (%s); "
                             "forcing a full re-upload", e)
                 self._sup_count("residency_desyncs")
+                instant("residency.desync", reason=str(e))
                 self._sup.escalate("resident carry desync")
                 carried = False
                 res.drop("carry cross-check mismatch")
@@ -1924,7 +2032,8 @@ class Scheduler:
         # Fault gate: jitted step dispatch (err → supervised retry down
         # the ladder; stall → lands in the watchdog's step window).
         FAULTS.hit("step")
-        decision: Decision = step_fn(eb, nf, af, key)
+        with span("step.dispatch"):
+            decision = step_fn(eb, nf, af, key)
         # Pack every per-pod output into ONE device buffer before
         # fetching: on a remote-TPU tunnel each np.asarray is a full
         # round trip, and five separate fetches of tiny arrays cost ~4
@@ -1977,7 +2086,8 @@ class Scheduler:
         self._fail_sink_tid = threading.get_ident()
         self._track = inf
         try:
-            self._resolve_batch_impl(inf)
+            with span("resolve", pods=len(inf.batch), seq=inf.seq):
+                self._resolve_batch_impl(inf)
         except BaseException:
             # Crash-consistent abort: reverse every assume this batch
             # made that no async owner took over, so a supervised retry
@@ -2023,6 +2133,8 @@ class Scheduler:
         step_window = (inf.t_step - inf.t_encode) - gather_gap
         if step_window > wd:
             self._sup_count("watchdog_trips")
+            instant("watchdog.trip", window_s=round(step_window, 6),
+                    deadline_s=wd)
             self._sup.escalate(
                 f"watchdog: device step took {step_window:.3f}s "
                 f"(deadline {wd}s)")
@@ -2132,6 +2244,12 @@ class Scheduler:
                     chosen, assigned, gang_rejected, feasible,
                     feasible_static, rejects, sp)
         t_step = time.perf_counter()
+        # Lifecycle stamp: the device's verdict for this batch exists
+        # from here on — decided_at feeds the pod_decide/pod_bind
+        # histograms when the pod later binds.
+        now_mono = time.monotonic()
+        for qpi in batch:
+            qpi.decided_at = now_mono
 
         if self.recorder is not None:
             self.recorder.record_batch(pods, names, decision, self.plugin_set)
@@ -2471,6 +2589,12 @@ class Scheduler:
                       int(af.valid.shape[0]))
 
     def _commit_batch(self, inf: "_InflightBatch") -> None:
+        """Flight-recorded wrapper: ``commit`` covers the flush + metric
+        fold (on the commit worker's own trace lane in pipelined mode)."""
+        with span("commit", seq=inf.seq, failures=len(inf.failures)):
+            self._commit_batch_impl(inf)
+
+    def _commit_batch_impl(self, inf: "_InflightBatch") -> None:
         """COMMIT: flush the deferred failure verdicts through the bulk
         machinery (one store transaction, one queue lock hold, one event
         payload for the whole tranche) and fold the cycle's metrics. Runs
@@ -2480,7 +2604,8 @@ class Scheduler:
         inf.commit_t0 = time.perf_counter()
         if inf.failures:
             try:
-                self._flush_failures(inf.failures)
+                with span("commit.flush", pods=len(inf.failures)):
+                    self._flush_failures(inf.failures)
             except FaultWorkerDeath:
                 # Simulated worker death (faults.py commit:die): escapes
                 # every guard so the supervisor's drain/restart path —
@@ -2511,6 +2636,7 @@ class Scheduler:
         # sync-vs-pipelined per-stage comparison).
         gather_gap = max(0.0, inf.t_fetch_start - inf.t_dispatch)
         step_s = (t_step - inf.t_encode) - gather_gap
+        gap = inf.gap
         with self._metrics_lock:
             m = self._metrics
             m["batches"] += 1
@@ -2520,7 +2646,12 @@ class Scheduler:
             m["encode_s_total"] += inf.t_encode - inf.t0
             m["step_s_total"] += step_s
             m["step_dispatch_s_total"] += inf.t_dispatch - inf.t_encode
+            # dispatch→fetch turnaround: the fetch slot of the gap
+            # decomposition (booked here, where the window is known —
+            # it cannot route through _book_gap's scheduling-thread
+            # pending dict because commits may run on the worker).
             m["gap_s_total"] += gather_gap
+            m["gap_fetch_s_total"] += gather_gap
             m["commit_s_total"] += commit_s
             m["shortlist_repairs"] += inf.sl_repairs
             m["shortlist_certified"] += max(0,
@@ -2533,12 +2664,21 @@ class Scheduler:
             # even in pipelined mode.
             ser = m.setdefault("batch_series", {
                 "device_s": [], "h2d_bytes": [], "fetch_bytes": [],
-                "shortlist_repairs": []})
+                "shortlist_repairs": [], "gap_gather_s": [],
+                "gap_encode_s": [], "gap_fetch_s": [], "gap_commit_s": []})
             if len(ser["device_s"]) < 64:
                 ser["device_s"].append(round(step_s, 6))
                 ser["h2d_bytes"].append(int(inf.h2d1 - inf.h2d0))
                 ser["fetch_bytes"].append(int(inf.fetch1 - inf.fetch0))
                 ser["shortlist_repairs"].append(int(inf.sl_repairs))
+                # engine_gap_s decomposition per batch: the components
+                # _book_gap attributed to this batch, plus this batch's
+                # dispatch→fetch window in the fetch slot.
+                ser["gap_gather_s"].append(round(gap.get("gather", 0.0), 6))
+                ser["gap_encode_s"].append(round(gap.get("encode", 0.0), 6))
+                ser["gap_fetch_s"].append(
+                    round(gap.get("fetch", 0.0) + gather_gap, 6))
+                ser["gap_commit_s"].append(round(gap.get("commit", 0.0), 6))
             if inf.failures:
                 # Encode-vs-flush overlap, booked HERE where the flush
                 # window is known: the NEXT batch's prepare may take
@@ -3349,9 +3489,11 @@ class Scheduler:
         key = (static_version, pad)
         cached = self._nf_static_device
         if cached is None or cached[0] != key:
-            leaves = {name: jax.device_put(getattr(nf, name),
-                                           self._nf_sharding(name))
-                      for name in self._STATIC_NF_FIELDS}
+            with span("h2d.static", static_version=static_version,
+                      pad=pad):
+                leaves = {name: jax.device_put(getattr(nf, name),
+                                               self._nf_sharding(name))
+                          for name in self._STATIC_NF_FIELDS}
             self._nf_static_device = cached = (key, leaves)
             self._count_h2d(sum(getattr(nf, name).nbytes
                                 for name in self._STATIC_NF_FIELDS))
@@ -3383,6 +3525,14 @@ class Scheduler:
                                        in out["batch_series"].items()}
         out.update({f"queue_{k}": v for k, v in self.queue.stats().items()})
         out["waiting_pods"] = len(self.waiting_pods)
+        # Per-pod lifecycle latency histograms (obs.Histogram snapshots:
+        # bounds/counts/sum/count). Non-numeric by design — the service
+        # layer surfaces them through metrics_histograms() for the
+        # apiserver's native Prometheus histogram exposition, and bench
+        # derives p50/p95/p99 from the counts (obs.hist_quantile), not
+        # from sampled windows.
+        out["histograms"] = {name: h.snapshot()
+                             for name, h in self._hists.items()}
         # Shortlist-compressed arbitration gauge: the active top-K width
         # (0 = off — knob, auction/mesh gate, or a certification desync
         # reverted the engine to the full-width scan).
@@ -3606,16 +3756,49 @@ class Scheduler:
             return
         self._bind(qpi, wp.node_name)
 
+    def _observe_bound(self, qpis) -> None:
+        """Feed the per-pod lifecycle histograms for pods that just
+        BOUND. Called at every site that increments ``pods_bound`` (and
+        only there), so ``pod_create_to_bound_s.count`` equals the bound
+        decisions by construction. Stage windows come from the
+        QueuedPodInfo stamps (queued=added_at → gathered_at →
+        decided_at → now); create→bound pairs the store's wall-clock
+        creation stamp with wall-clock now, the same definition the
+        bench's sampled windows use."""
+        now_m = time.monotonic()
+        now_w = time.time()
+        qw, dec, bnd, c2b = [], [], [], []
+        for qpi in qpis:
+            if qpi.gathered_at:
+                qw.append(max(0.0, qpi.gathered_at - qpi.added_at))
+                if qpi.decided_at:
+                    dec.append(max(0.0, qpi.decided_at - qpi.gathered_at))
+            if qpi.decided_at:
+                bnd.append(max(0.0, now_m - qpi.decided_at))
+            created = getattr(qpi.pod.metadata, "creation_timestamp",
+                              0.0) or now_w
+            c2b.append(max(0.0, now_w - created))
+        h = self._hists
+        if qw:
+            h["pod_queue_wait_s"].observe_many(qw)
+        if dec:
+            h["pod_decide_s"].observe_many(dec)
+        if bnd:
+            h["pod_bind_s"].observe_many(bnd)
+        h["pod_create_to_bound_s"].observe_many(c2b)
+
     def _bind(self, qpi: QueuedPodInfo, node_name: str) -> None:
         pod = qpi.pod
         try:
-            bound = self.store.bind_pod(pod.key, node_name)
+            with span("bind.pod"):
+                bound = self.store.bind_pod(pod.key, node_name)
         except (ConflictError, NotFoundError) as e:
             self._bind_failed(qpi, node_name, e)
             return
         self.queue.forget(pod.key)
         with self._metrics_lock:
             self._metrics["pods_bound"] += 1
+        self._observe_bound((qpi,))
         self.broadcaster.scheduled(bound, node_name)
         log.info("bound %s to %s", pod.key, node_name)
 
@@ -3628,7 +3811,8 @@ class Scheduler:
         reconciles per pod against store truth instead."""
         try:
             FAULTS.hit("bind")  # fault gate: bulk binding task
-            self._bind_many_impl(items)
+            with span("bind.bulk", pods=len(items)):
+                self._bind_many_impl(items)
         except Exception:
             log.exception("bulk bind task failed; reconciling %d "
                           "placement(s) against store truth", len(items))
@@ -3667,6 +3851,7 @@ class Scheduler:
                 self.queue.forget(key)
                 with self._metrics_lock:
                     self._metrics["pods_bound"] += 1
+                self._observe_bound((qpi,))
             else:
                 self._bind_failed(qpi, node_name, "bulk bind task aborted")
 
@@ -3683,6 +3868,8 @@ class Scheduler:
             [(k, n) for k, _, n in keyed]))
         with self._metrics_lock:
             self._metrics["pods_bound"] += len(bound_keys)
+        self._observe_bound([qpi for k, qpi, _n in keyed
+                             if k in bound_keys])
         self.queue.forget_many(bound_keys)
         if self._nominations:  # a bound nominee releases its reservation
             with self._nom_lock:

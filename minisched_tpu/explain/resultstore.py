@@ -45,6 +45,7 @@ from typing import Dict, List, NamedTuple, Optional
 import numpy as np
 
 from ..errors import ConflictError, NotFoundError
+from ..obs import span, traced
 from ..utils.retry import retry_with_exponential_backoff
 
 log = logging.getLogger(__name__)
@@ -151,6 +152,7 @@ class ResultStore:
             for k in keys:
                 self.flush_pod(k)
 
+    @traced("explain.ingest")
     def _ingest(self, pods, names, decision, plugin_set) -> List[str]:
         """Device readback + top-k selection + record registration."""
         filter_masks = np.asarray(decision.filter_masks)   # (F,P,N)
@@ -374,8 +376,9 @@ class ResultStore:
                 # so the step's device arrays aren't pinned through the
                 # (long) per-pod flush phase.
                 del item, pods, decision
-                for k in keys:
-                    self.flush_pod(k)
+                with span("explain.flush", pods=len(keys)):
+                    for k in keys:
+                        self.flush_pod(k)
             except Exception:
                 log.exception("async explain ingest/flush failed")
             finally:

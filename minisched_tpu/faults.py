@@ -77,6 +77,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from .obs import instant as _trace_instant
+
 log = logging.getLogger(__name__)
 
 #: The gate catalog; hit() rejects unknown names so a typo in a rule or a
@@ -239,6 +241,10 @@ class FaultRegistry:
             return None
         log.warning("fault gate %r fired (%s, call #%d)", gate,
                     fired.spec, call_no)
+        # Flight-recorder instant (obs): a faulted run's trace timeline
+        # shows WHERE each gate fired relative to the engine spans.
+        _trace_instant(f"fault.{gate}", spec=fired.spec, call=call_no,
+                       action=fired.action)
         if fired.action == "stall":
             time.sleep(fired.stall_s)
             return None

@@ -319,7 +319,11 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             rc: List[jnp.ndarray] = []
             masks: List[jnp.ndarray] = []
             for p in filters:
-                m = p.filter(pf_sub, nf, ctx)
+                # named_scope: pure metadata — labels the pass in an XLA
+                # profile so a TPU capture lines up with the engine's
+                # flight-recorder spans (obs) by name.
+                with jax.named_scope(f"minisched.filter.{p.name}"):
+                    m = p.filter(pf_sub, nf, ctx)
                 rc.append((valid_pair & ~m).sum(axis=1).astype(jnp.int32))
                 feasible = feasible & m
                 if explain:
@@ -351,8 +355,9 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             total = jnp.zeros_like(valid_pair, dtype=jnp.float32)
             raws, norms = [], []
             for p, w in zip(scorers, weights):
-                raw = p.score(pf_sub, nf, ctx).astype(jnp.float32)
-                norm = p.normalize(raw, feasible).astype(jnp.float32)
+                with jax.named_scope(f"minisched.score.{p.name}"):
+                    raw = p.score(pf_sub, nf, ctx).astype(jnp.float32)
+                    norm = p.normalize(raw, feasible).astype(jnp.float32)
                 total = total + w * norm
                 if explain:
                     raws.append(raw)
@@ -488,9 +493,11 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             # Gang-aware joint assignment (ops/gang.py); with no gangs in
             # the batch this reduces to plain capacity-aware greedy
             # assignment.
-            assign = gang_assign(
-                masked_total, pf.requests, nf.free,
-                eb.gang.group, eb.gang.min_count, key, greedy_fn=greedy_fn)
+            with jax.named_scope("minisched.assign"):
+                assign = gang_assign(
+                    masked_total, pf.requests, nf.free,
+                    eb.gang.group, eb.gang.min_count, key,
+                    greedy_fn=greedy_fn)
 
         # Spread-arbitration inputs: per (pod, GROUP), gathered at the
         # ASSIGNED node, so they must come after the assignment stage.

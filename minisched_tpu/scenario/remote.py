@@ -63,8 +63,10 @@ def serve(store_only: bool = False) -> None:
                     ).start()
     if svc is not None:
         # one /metrics scrape covers the whole co-located simulator,
-        # every profile included
+        # every profile included — flat gauges plus the per-pod latency
+        # histograms in native Prometheus histogram exposition
         api.metrics_providers.append(svc.metrics)
+        api.histogram_providers.append(svc.metrics_histograms)
     print(f"LISTENING {api.address}", flush=True)
     try:
         sys.stdin.read()  # parent closes the pipe → exit

@@ -96,6 +96,27 @@ class SchedulerService:
                 out[f"{name}_{k}"] = v
         return out
 
+    def metrics_histograms(self) -> Dict[str, dict]:
+        """Per-pod lifecycle latency histogram snapshots across every
+        profile (obs.Histogram snapshots: bounds/counts/sum/count),
+        keyed like :meth:`metrics` — unprefixed for single-profile,
+        profile-prefixed for multi. This is the
+        ``APIServer.histogram_providers`` feed for the native Prometheus
+        histogram exposition (`_bucket`/`_sum`/`_count`); ``metrics()``
+        itself stays ``Dict[str, float]`` (a pinned contract — the flat
+        gauges must remain scrape-compatible)."""
+        scheds = self.schedulers
+        if not scheds:
+            return {}
+        if not self._multi:
+            m = next(iter(scheds.values())).metrics()
+            return dict(m.get("histograms", {}))
+        out: Dict[str, dict] = {}
+        for name, engine in scheds.items():
+            for k, v in engine.metrics().get("histograms", {}).items():
+                out[f"{name}_{k}"] = v
+        return out
+
     def start_scheduler(self, profile: ProfileSpec = None,
                         config: Optional[SchedulerConfig] = None) -> Scheduler:
         if self._scheds:

@@ -423,6 +423,12 @@ def test_fail_closed_revocation_feeds_spread_arbitration():
         # nA would be the skew-2 commit the pre-arbitration fail-closed
         # revocation prevents
         assert y.spec.node_name == "nB", y.spec.node_name
+        # X's terminal verdict (status write + park) flushes on the
+        # commit worker, which runs concurrently with the binder task
+        # that made Y visible — wait for the asynchronous status write
+        # before asserting its attribution.
+        wait_until(lambda: bool(
+            c.get_pod("x").status.unschedulable_plugins), 10.0)
         x = c.get_pod("x")
         assert x.spec.node_name == ""
         assert "PodTopologySpread" in (x.status.unschedulable_plugins or ())

@@ -1,0 +1,357 @@
+"""Flight-recorder suite (minisched_tpu/obs + the engine seams).
+
+The acceptance bar this file pins: with ``MINISCHED_TRACE`` unset the
+recorder is a no-op (decisions bit-identical trace-on vs trace-off
+across the pipelined/resident/shortlist engine modes; the disabled span
+is one shared object behind a single attribute test); armed, the span
+stream nests correctly under the two-deep pipeline, fault fires and
+supervisor ladder transitions surface as instants, the exported JSON
+validates against the Chrome trace-event schema, the per-pod lifecycle
+histograms count exactly the bound decisions, and the engine_gap_s
+decomposition partitions gap_s_total exactly.
+"""
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from minisched_tpu import faults, obs
+from minisched_tpu.config import SchedulerConfig
+from minisched_tpu.obs import Histogram, hist_quantile
+from minisched_tpu.scenario import Cluster
+from minisched_tpu.service.defaultconfig import Profile
+from minisched_tpu.state import objects as obj
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import trace_view  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def recorder():
+    """Every test starts and leaves with the recorder disarmed and the
+    fault registry clean — armed state leaking across tests would slow
+    (and noise) the rest of the tier-1 run."""
+    obs.configure(False)
+    faults.configure("")
+    yield obs.TRACE
+    obs.configure(False)
+    faults.configure("")
+
+
+# ---- recorder units -------------------------------------------------------
+
+
+def test_off_mode_span_is_shared_noop():
+    assert not obs.TRACE.enabled
+    s1, s2 = obs.span("a"), obs.span("b", pods=3)
+    assert s1 is s2  # the singleton null span: zero allocation per seam
+    with s1:
+        s1.set(pods=1)  # no-op, must not raise
+    obs.instant("nothing", x=1)
+    assert obs.TRACE.events() == []
+
+
+def test_armed_span_and_instant_record():
+    obs.configure(True, buf=256)
+    with obs.span("outer", seq=1):
+        time.sleep(0.002)
+        with obs.span("inner") as sp:
+            sp.set(pods=7)
+        obs.instant("mark", gate="step")
+    evs = obs.TRACE.events()
+    names = [e["name"] for e in evs]
+    assert set(names) == {"outer", "inner", "mark"}
+    by = {e["name"]: e for e in evs}
+    assert by["mark"]["ph"] == "i"
+    assert by["inner"]["args"] == {"pods": 7}
+    assert by["outer"]["args"] == {"seq": 1}
+    # containment: inner ⊆ outer on the same thread
+    o, i = by["outer"], by["inner"]
+    assert o["tid"] == i["tid"]
+    assert o["ts_ns"] <= i["ts_ns"]
+    assert i["ts_ns"] + i["dur_ns"] <= o["ts_ns"] + o["dur_ns"]
+    assert o["dur_ns"] >= 2_000_000  # the sleep is inside the span
+
+
+def test_ring_wraps_keeping_newest():
+    obs.configure(True, buf=16)
+    for k in range(50):
+        obs.instant(f"e{k}")
+    evs = obs.TRACE.events()
+    assert len(evs) == 16
+    assert {e["name"] for e in evs} == {f"e{k}" for k in range(34, 50)}
+    assert obs.TRACE.dropped() == 34
+
+
+def test_reconfigure_clears_rings():
+    obs.configure(True, buf=64)
+    obs.instant("old")
+    obs.configure(True, buf=64)
+    obs.instant("new")
+    assert [e["name"] for e in obs.TRACE.events()] == ["new"]
+
+
+def test_histogram_observe_snapshot_quantile():
+    h = Histogram(bounds=(1.0, 2.0, 4.0))
+    h.observe(0.5)
+    h.observe_many([1.5, 3.0, 8.0])
+    snap = h.snapshot()
+    assert snap["counts"] == [1, 1, 1, 1]
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(13.0)
+    # quantiles interpolate inside the holding bucket; the +Inf bucket
+    # answers its lower bound (the last finite boundary)
+    assert 0.0 < hist_quantile(snap, 0.25) <= 1.0
+    assert 1.0 < hist_quantile(snap, 0.5) <= 2.0
+    assert hist_quantile(snap, 1.0) == pytest.approx(4.0)
+    assert hist_quantile({"bounds": [1.0], "counts": [0, 0], "sum": 0.0,
+                          "count": 0}, 0.5) == 0.0
+
+
+# ---- engine bursts --------------------------------------------------------
+
+PLUGINS = ["NodeUnschedulable", "NodeResourcesFit",
+           "NodeResourcesLeastAllocated"]
+N_PODS = 14
+
+
+def _config(**kw):
+    kw.setdefault("max_batch_size", 7)
+    kw.setdefault("batch_window_s", 0.3)
+    kw.setdefault("batch_idle_s", 0.1)
+    kw.setdefault("backoff_initial_s", 0.05)
+    kw.setdefault("backoff_max_s", 0.3)
+    return SchedulerConfig(**kw)
+
+
+def _pods(n=N_PODS):
+    """Unique priorities/sizes: deterministic pop + scan order, so two
+    identical runs place identically (the same discipline
+    tests/test_faults.py relies on for its bit-identical claims)."""
+    return [obj.Pod(
+        metadata=obj.ObjectMeta(name=f"p{i}", namespace="default"),
+        spec=obj.PodSpec(requests={"cpu": 100 + 17 * i},
+                         priority=500 - i)) for i in range(n)]
+
+
+def _run_burst(config, n_pods=N_PODS, settle_s=60, dump_to=None):
+    """One engine burst; returns (placements {name: node}, metrics)."""
+    c = Cluster()
+    try:
+        c.start(profile=Profile(plugins=list(PLUGINS)), config=config,
+                with_pv_controller=False)
+        for i, cpu in enumerate((64000, 48000, 40000, 36000)):
+            c.create_node(f"n{i}", cpu=cpu)
+        c.create_objects(_pods(n_pods))
+        deadline = time.monotonic() + settle_s
+        placements = {}
+        while time.monotonic() < deadline:
+            placements = {p.metadata.name: p.spec.node_name
+                          for p in c.list_pods() if p.spec.node_name}
+            if len(placements) == n_pods:
+                break
+            time.sleep(0.05)
+        assert len(placements) == n_pods, (
+            f"only {len(placements)}/{n_pods} bound")
+        # metrics AFTER all binds are visible (binder threads stamp
+        # pods_bound before the store write becomes listable, so the
+        # placement wait above is the ordering barrier)
+        m = c.service.scheduler.metrics()
+        if dump_to is not None:
+            c.service.scheduler.dump_trace(dump_to)
+        return placements, m
+    finally:
+        c.shutdown()
+
+
+@pytest.mark.parametrize("mode", [
+    {},                             # pipelined + resident + shortlist
+    {"pipeline": False},            # strictly synchronous cycle
+    {"device_resident": False},     # upload-every-batch + i32 fetch
+    {"shortlist": False},           # full-width scan
+])
+def test_decisions_bit_identical_trace_on_off(mode):
+    """MINISCHED_TRACE=0 vs =1 must not move a single placement: the
+    recorder sits outside the decision path by construction (no PRNG
+    draw, no input mutation), and this pins it per engine mode."""
+    obs.configure(False)
+    base, m0 = _run_burst(_config(**mode))
+    obs.configure(True, buf=1 << 15)
+    traced, m1 = _run_burst(_config(**mode))
+    assert traced == base
+    assert m1["pods_bound"] == m0["pods_bound"] == N_PODS
+    assert obs.TRACE.events(), "armed run recorded nothing"
+
+
+def test_span_nesting_and_ordering_under_pipeline():
+    """Two-deep pipelined run: spans on each thread must be properly
+    nested (disjoint or contained — a half-overlapping pair would mean
+    a broken begin/end pairing), per-seq prepare→resolve ordering
+    holds, and the seam catalog's core names all appear."""
+    obs.configure(True, buf=1 << 15)
+    _run_burst(_config())  # max_batch_size=7 → ≥2 batches via pipeline
+    evs = obs.TRACE.events()
+    names = {e["name"] for e in evs}
+    for expected in ("queue.pop", "prepare", "encode.pods",
+                     "cache.snapshot_assigned", "step.dispatch",
+                     "resolve", "fetch.decision", "commit", "bind.bulk"):
+        assert expected in names, (expected, sorted(names))
+    spans = [e for e in evs if e["ph"] == "X"]
+    by_tid = {}
+    for e in spans:
+        by_tid.setdefault(e["tid"], []).append(e)
+    for tid, lst in by_tid.items():
+        lst.sort(key=lambda e: (e["ts_ns"], -e["dur_ns"]))
+        for i, a in enumerate(lst):
+            for b in lst[i + 1:]:
+                a0, a1 = a["ts_ns"], a["ts_ns"] + a["dur_ns"]
+                b0, b1 = b["ts_ns"], b["ts_ns"] + b["dur_ns"]
+                assert b0 >= a1 or b1 <= a1, (
+                    f"half-overlapping spans on tid {tid}: "
+                    f"{a['name']} vs {b['name']}")
+    # per-batch ordering by the seq arg the engine attaches
+    starts = {}
+    for e in spans:
+        seq = (e["args"] or {}).get("seq")
+        if seq is not None:
+            starts[(e["name"], seq)] = e["ts_ns"]
+    seqs = {s for (n, s) in starts if n == "prepare"}
+    assert seqs, "no prepare spans carried a seq"
+    for s in seqs:
+        if ("resolve", s) in starts:
+            assert starts[("prepare", s)] < starts[("resolve", s)]
+
+
+def test_fault_fires_and_ladder_as_instants():
+    """Compose with MINISCHED_FAULTS: a step fault must appear as a
+    ``fault.step`` instant and the supervised containment as a
+    ``supervisor.escalate`` instant on the same timeline."""
+    obs.configure(True, buf=1 << 15)
+    faults.configure("step:err@2")
+    _run_burst(_config(probation_batches=1))
+    kinds = {e["name"] for e in obs.TRACE.events() if e["ph"] == "i"}
+    assert "fault.step" in kinds, kinds
+    assert "supervisor.escalate" in kinds, kinds
+
+
+def test_histogram_counts_equal_bound_decisions():
+    _, m = _run_burst(_config())
+    hists = m["histograms"]
+    assert hists["pod_create_to_bound_s"]["count"] == m["pods_bound"]
+    assert hists["pod_queue_wait_s"]["count"] == m["pods_bound"]
+    assert hists["pod_bind_s"]["count"] == m["pods_bound"]
+    assert m["pods_bound"] == N_PODS
+    # the windows are real (sum > 0) and the quantile is readable
+    snap = hists["pod_create_to_bound_s"]
+    assert snap["sum"] > 0.0
+    assert hist_quantile(snap, 0.5) >= 0.0
+
+
+def test_gap_decomposition_partitions_gap_total():
+    """gather/encode/fetch/commit must PARTITION gap_s_total — every
+    booking is component-tagged, so the identity is exact, not a 2%
+    approximation (the bench criterion is the loose outer bound)."""
+    _, m = _run_burst(_config())
+    parts = (m["gap_gather_s_total"] + m["gap_encode_s_total"]
+             + m["gap_fetch_s_total"] + m["gap_commit_s_total"])
+    assert parts == pytest.approx(m["gap_s_total"], abs=1e-9)
+    ser = m["batch_series"]
+    for k in ("gap_gather_s", "gap_encode_s", "gap_fetch_s",
+              "gap_commit_s"):
+        assert len(ser[k]) == len(ser["device_s"])
+
+
+def test_exported_trace_validates_and_loads(tmp_path):
+    obs.configure(True, buf=1 << 15)
+    path = str(tmp_path / "trace.json")
+    _run_burst(_config(), dump_to=path)
+    doc = json.load(open(path, encoding="utf-8"))
+    trace_view.validate(doc)  # raises on any schema violation
+    evs = doc["traceEvents"]
+    assert any(e["ph"] == "M" and e["name"] == "thread_name"
+               for e in evs), "thread-name metadata missing"
+    assert any(e["ph"] == "X" for e in evs)
+    # the summary/coverage tooling consumes the same file
+    spans = trace_view.span_summary(doc)
+    assert spans.get("resolve", {}).get("count", 0) >= 1
+    cov = trace_view.thread_coverage(doc)
+    sched = [v for k, v in cov.items() if "scheduling-loop" in k]
+    assert sched and max(sched) > 0.5, cov
+
+
+def test_unarmed_dump_writes_valid_empty_trace(tmp_path):
+    path = str(tmp_path / "empty.json")
+    _, _m = _run_burst(_config(), dump_to=path)
+    doc = json.load(open(path, encoding="utf-8"))
+    trace_view.validate(doc)
+    assert [e for e in doc["traceEvents"] if e["ph"] != "M"] == []
+
+
+# ---- exposition -----------------------------------------------------------
+
+
+def test_apiserver_typed_exposition_with_histograms():
+    """/metrics carries # HELP + # TYPE for every series and native
+    histogram exposition (_bucket with CUMULATIVE le labels, _sum,
+    _count) for histogram providers, while the flat names stay
+    scrape-compatible."""
+    import urllib.request
+
+    from minisched_tpu.apiserver import APIServer
+    from minisched_tpu.state.store import ClusterStore
+
+    h = Histogram(bounds=(0.001, 0.01))
+    h.observe_many([0.0005, 0.005, 0.5])
+    api = APIServer(ClusterStore())
+    api.metrics_providers.append(lambda: {"pods_bound": 3, "batches": 2})
+    api.histogram_providers.append(
+        lambda: {"pod_create_to_bound_s": h.snapshot()})
+    api.start()
+    try:
+        text = urllib.request.urlopen(
+            f"{api.address}/metrics", timeout=5).read().decode()
+    finally:
+        api.shutdown()
+    # typed: HELP + TYPE for flat series, names unchanged
+    assert "# HELP minisched_engine_pods_bound" in text
+    assert "# TYPE minisched_engine_batches gauge" in text
+    assert "minisched_engine_batches 2" in text
+    assert "# TYPE minisched_store_objects gauge" in text
+    # native histogram exposition with cumulative buckets
+    name = "minisched_engine_pod_create_to_bound_s"
+    assert f"# TYPE {name} histogram" in text
+    assert f'{name}_bucket{{le="0.001"}} 1' in text
+    assert f'{name}_bucket{{le="0.01"}} 2' in text
+    assert f'{name}_bucket{{le="+Inf"}} 3' in text
+    assert f"{name}_count 3" in text
+    assert f"{name}_sum" in text
+    # exposition validity: one TYPE line per metric name (strict
+    # parsers reject the whole scrape on a duplicate)
+    type_lines = [ln for ln in text.splitlines()
+                  if ln.startswith("# TYPE")]
+    assert len(type_lines) == len(set(type_lines))
+
+
+def test_service_histogram_provider_surface():
+    """SchedulerService.metrics() stays Dict[str, float] (pinned
+    contract) while metrics_histograms() carries the snapshots."""
+    from minisched_tpu.service.service import SchedulerService
+    from minisched_tpu.state.store import ClusterStore
+
+    svc = SchedulerService(ClusterStore())
+    assert svc.metrics_histograms() == {}
+    svc.start_scheduler(
+        Profile(name="default-scheduler", plugins=list(PLUGINS)),
+        _config())
+    try:
+        hists = svc.metrics_histograms()
+        assert "pod_create_to_bound_s" in hists
+        assert set(hists["pod_create_to_bound_s"]) == {
+            "bounds", "counts", "sum", "count"}
+        assert all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                   for v in svc.metrics().values())
+    finally:
+        svc.shutdown_scheduler()

@@ -13,6 +13,14 @@ runs plus the ratios; tools of record commit it as BENCH_PIPELINE.json.
 
 MINISCHED_BENCH_NODES / MINISCHED_BENCH_PODS override the 2000 x 1000
 CPU shape (the same shape `make bench-cpu` uses).
+
+Since the flight-recorder layer (minisched_tpu/obs) every phase also
+exports the engine_gap_s decomposition (*_gap_gather_s / *_gap_encode_s
+/ *_gap_fetch_s / *_gap_commit_s, partitioning *_gap_s exactly) and the
+histogram-derived create→bound percentiles (*_hist_p50_s/_p95_s/_p99_s,
+computed from the engine's fixed-bucket lifecycle histogram over every
+bound pod — not from sampled windows). Both ride in via
+bench.engine_bench; nothing here recomputes them.
 """
 import json
 import os
@@ -145,7 +153,8 @@ def main() -> None:
         k: ratio(k) for k in (
             "engine_sched_s", "engine_total_s", "stream_sched_s",
             "stream_commit_s", "skew_stream_sched_s",
-            "skew_stream_commit_s", "failflush_s")}
+            "skew_stream_commit_s", "failflush_s",
+            "stream_gap_s", "stream_hist_p99_s")}
     print(json.dumps(doc))
 
 

@@ -1,0 +1,177 @@
+"""Flight-recorder overhead + contract bench at CPU shapes.
+
+Interleaved tracer-off/on rounds (the BENCH_RESIDENCY drift-cancelling
+discipline) through bench.engine_bench — single-burst and sustained
+streaming — proving the three acceptance claims of the observability
+layer:
+
+  * recorder overhead: tracer-on create→bound time within 5% of
+    tracer-off on the CPU shape (min-of-N per mode; spans sit on
+    per-batch seams, so the armed cost is ~a dozen ring appends per
+    batch);
+  * gap decomposition: gap_gather_s + gap_encode_s + gap_fetch_s +
+    gap_commit_s sums to engine_gap_s within 2% (by construction every
+    gap booking is component-tagged; this proves it end-to-end through
+    the export path);
+  * the exported Chrome trace validates against the trace-event schema
+    (tools/trace_view.validate — the same check Perfetto's loader
+    implies), named spans cover ≥95% of the scheduling-loop thread's
+    busy window, and the lifecycle histogram counts every bound pod.
+
+Tools of record commit the output as BENCH_TRACE.json:
+
+    JAX_PLATFORMS=cpu python tools/bench_trace.py [> BENCH_TRACE.json]
+
+MINISCHED_BENCH_NODES / MINISCHED_BENCH_PODS override the 2000 x 1000
+CPU shape (the same shape the other CPU benches use).
+"""
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MODES = (("trace_off", False), ("trace_on", True))
+PHASES = ("engine", "stream")
+
+
+def run_phases(n: int, p: int) -> dict:
+    import bench
+    from bench_workload import BENCH_PLUGINS, make_workload
+
+    out = {}
+    mn, mp = make_workload(n, p)
+    out.update(bench.engine_bench(n, p, mn, mp, BENCH_PLUGINS,
+                                  lat_samples=2))
+    out.update(bench.engine_bench(n, p, mn, mp, BENCH_PLUGINS,
+                                  batch_size=max(64, p // 4),
+                                  prefix="stream", window_s=0.25))
+    return out
+
+
+def gap_sum_check(mode: dict) -> dict:
+    """Per phase: |sum(gap components) − gap_s| / gap_s (0 when the run
+    had no measurable gap)."""
+    out = {}
+    for prefix in PHASES:
+        total = mode.get(f"{prefix}_gap_s", 0.0)
+        parts = sum(mode.get(f"{prefix}_gap_{c}_s", 0.0)
+                    for c in ("gather", "encode", "fetch", "commit"))
+        out[f"{prefix}_gap_s"] = total
+        out[f"{prefix}_gap_components_s"] = round(parts, 4)
+        out[f"{prefix}_gap_sum_err_pct"] = (
+            round(100.0 * abs(parts - total) / total, 3) if total else 0.0)
+    return out
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    n = int(os.environ.get("MINISCHED_BENCH_NODES", "2000"))
+    p = int(os.environ.get("MINISCHED_BENCH_PODS", "1000"))
+    from minisched_tpu import obs
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import trace_view
+
+    # min-of-4 per mode: the 1-core bench hosts jitter ±30% on
+    # sub-second phases (GC, scheduler preemption), far above the
+    # recorder's real cost (~a dozen ring appends per batch) — the
+    # interleaved min-of-N is what makes the ≤5% overhead claim
+    # measurable at all.
+    rounds = int(os.environ.get("MINISCHED_BENCH_ROUNDS", "4"))
+    doc = {"nodes": n, "pods": p, "platform": "cpu",
+           "methodology": f"interleaved tracer-off/on rounds; time keys "
+                          f"are min-of-{rounds} full phase runs per mode "
+                          "(sub-second phases on a 1-core host are "
+                          "dominated by scheduler/GC jitter otherwise); "
+                          "overhead compares min-of-N create→bound "
+                          "windows; the gap decomposition and histogram "
+                          "keys come straight from engine metrics",
+           "faults_spec": os.environ.get("MINISCHED_FAULTS", ""),
+           "modes": {}}
+    runs = {label: [] for label, _ in MODES}
+    trace_doc = None
+    for _round in range(rounds):
+        for label, armed in MODES:  # interleaved: off, on, off, on
+            os.environ["MINISCHED_TRACE"] = "1" if armed else "0"
+            obs.configure(armed)
+            runs[label].append(run_phases(n, p))
+            if armed and trace_doc is None:
+                # Export THIS round's ring (the engine threads are done;
+                # the rings hold the newest events) and validate it —
+                # the Perfetto-loadable artifact claim, checked here.
+                with tempfile.TemporaryDirectory() as td:
+                    path = obs.TRACE.export_chrome(
+                        os.path.join(td, "trace.json"))
+                    trace_doc = json.load(open(path, encoding="utf-8"))
+    obs.configure(False)
+    for label, _ in MODES:
+        merged = dict(runs[label][0])
+        for rep in runs[label][1:]:
+            for k, v in rep.items():
+                if (k.endswith("_s") and isinstance(v, (int, float))
+                        and isinstance(merged.get(k), (int, float))):
+                    merged[k] = min(merged[k], v)
+        # The gap decomposition is a per-RUN identity: min-merging its
+        # components independently across rounds would mix runs and
+        # fake a sum mismatch. Take each phase's whole gap family from
+        # the round with the smallest total gap instead.
+        for prefix in PHASES:
+            best = min(runs[label],
+                       key=lambda r: r.get(f"{prefix}_gap_s", 0.0))
+            for k, v in best.items():
+                # scalar components AND their per-batch series twins —
+                # mixing rounds between the two would fake a mismatch
+                if (k.startswith(f"{prefix}_gap_")
+                        or k.startswith(f"{prefix}_batch_gap_")):
+                    merged[k] = v
+        merged.update(gap_sum_check(merged))
+        for prefix in PHASES:
+            hist_n = merged.get(f"{prefix}_hist_bound_count")
+            bound = merged.get(f"{prefix}_bound")
+            if hist_n is not None and bound is not None:
+                # ≥: later latency rounds keep feeding the histogram
+                # after the first-round throughput window closes
+                merged[f"{prefix}_hist_counts_all_bound"] = bool(
+                    hist_n >= bound)
+        doc["modes"][label] = merged
+    off, on = doc["modes"]["trace_off"], doc["modes"]["trace_on"]
+
+    overhead = {}
+    for prefix in PHASES:
+        a, b = off.get(f"{prefix}_sched_s"), on.get(f"{prefix}_sched_s")
+        if a and b:
+            overhead[f"{prefix}_overhead_pct"] = round(
+                100.0 * (b - a) / a, 2)
+    doc["recorder_overhead"] = overhead
+    doc["overhead_within_5pct"] = all(v <= 5.0 for v in overhead.values())
+    doc["gap_decomposition_within_2pct"] = all(
+        m.get(f"{prefix}_gap_sum_err_pct", 0.0) <= 2.0
+        for m in doc["modes"].values() for prefix in PHASES)
+
+    if trace_doc is not None:
+        try:
+            trace_view.validate(trace_doc)
+            spans = trace_view.span_summary(trace_doc)
+            cov = trace_view.thread_coverage(trace_doc)
+            sched_cov = max((v for k, v in cov.items()
+                             if "scheduling-loop" in k), default=0.0)
+            doc["trace"] = {
+                "schema_valid": True,
+                "events": len(trace_doc["traceEvents"]),
+                "span_names": sorted(spans),
+                "dropped_events": (trace_doc.get("otherData") or {})
+                .get("dropped_events", 0),
+                "thread_coverage": cov,
+                "scheduling_loop_coverage_pct": round(100 * sched_cov, 1),
+                "coverage_ge_95pct": bool(sched_cov >= 0.95),
+            }
+        except ValueError as e:
+            doc["trace"] = {"schema_valid": False, "error": str(e)}
+    print(json.dumps(doc))
+
+
+if __name__ == "__main__":
+    main()

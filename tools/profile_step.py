@@ -103,13 +103,16 @@ def main() -> None:
           f"{min(sl_k, n_pad) if sl_k else n_pad} per step; "
           "MINISCHED_SHORTLIST / MINISCHED_SHORTLIST_K)", flush=True)
 
+    stages = {}  # label → seconds, for the per-stage table below
+
     def timed(label, fn):
         out = fn()
         jax.block_until_ready(out)
         t0 = time.perf_counter()
         out = fn()
         jax.block_until_ready(out)
-        print(f"{label} = {time.perf_counter() - t0:.4f} s", flush=True)
+        stages[label] = time.perf_counter() - t0
+        print(f"{label} = {stages[label]:.4f} s", flush=True)
         return out
 
     if args.passes:
@@ -178,6 +181,22 @@ def main() -> None:
     else:
         print("sp_fetch_s / cdom_fetch_s skipped: no topology plugin in "
               "this profile (rerun with --c4)", flush=True)
+
+    # Per-stage table — the same decomposition the engine's flight
+    # recorder (minisched_tpu/obs) and the bench's engine_gap_s
+    # components report (gather/encode/h2d/dispatch/fetch/commit), here
+    # as the raw-step analogs at identical pads: step compute plus each
+    # readback path, with its share of the accounted total. Run the
+    # engine with MINISCHED_TRACE=1 + Scheduler.dump_trace (or `make
+    # bench-trace`) for the live-timeline twin of this table.
+    total = sum(stages.values()) or 1.0
+    print("\nper-stage table (raw-step attribution at engine pads):",
+          flush=True)
+    print(f"  {'stage':<16s} {'seconds':>9s} {'% accounted':>12s}",
+          flush=True)
+    for label, secs in stages.items():
+        print(f"  {label:<16s} {secs:>9.4f} {100.0 * secs / total:>11.1f}%",
+              flush=True)
 
 
 if __name__ == "__main__":

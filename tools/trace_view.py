@@ -1,0 +1,162 @@
+"""Flight-recorder trace viewer/validator — summarize a Chrome
+trace-event JSON exported by ``Scheduler.dump_trace`` (minisched_tpu/obs)
+without leaving the terminal.
+
+    python tools/trace_view.py TRACE.json [--thread NAME]
+
+Prints, per span name: count, total/mean/max milliseconds, and the share
+of the busiest thread's covered window; then the instant events (fault
+fires, supervisor ladder transitions, watchdog trips, desyncs) in
+timeline order. The same file loads in Perfetto (ui.perfetto.dev),
+chrome://tracing, or TensorBoard's trace viewer for the graphical
+timeline.
+
+Importable pieces (tests/test_obs.py and tools/bench_trace.py use
+them):
+
+    validate(doc)          raise ValueError unless ``doc`` is a
+                           schema-valid trace-event document
+    span_summary(doc)      {name: {"count", "total_ms", "mean_ms",
+                           "max_ms"}}
+    thread_coverage(doc)   {thread_label: fraction of the thread's
+                           first→last-event window covered by the UNION
+                           of its span intervals} — the "named spans
+                           account for ≥95% of engine_total_s"
+                           acceptance check runs on the scheduling-loop
+                           thread's entry
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict
+
+
+def validate(doc: dict) -> None:
+    """Chrome trace-event schema check (the object form this repo
+    emits): a ``traceEvents`` list whose entries carry the per-phase
+    required keys. Raises ValueError with the first offense."""
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError("not a trace-event document: no traceEvents key")
+    evs = doc["traceEvents"]
+    if not isinstance(evs, list):
+        raise ValueError("traceEvents is not a list")
+    for i, e in enumerate(evs):
+        if not isinstance(e, dict):
+            raise ValueError(f"event {i} is not an object")
+        ph = e.get("ph")
+        if ph not in ("X", "i", "I", "M", "B", "E"):
+            raise ValueError(f"event {i}: unknown phase {ph!r}")
+        if ph == "M":
+            if "name" not in e or "args" not in e:
+                raise ValueError(f"metadata event {i} lacks name/args")
+            continue
+        for k in ("name", "pid", "tid", "ts"):
+            if k not in e:
+                raise ValueError(f"event {i} ({ph}) lacks {k!r}")
+        if not isinstance(e["ts"], (int, float)):
+            raise ValueError(f"event {i}: ts is not a number")
+        if ph == "X":
+            if not isinstance(e.get("dur"), (int, float)):
+                raise ValueError(f"complete event {i} lacks numeric dur")
+            if e["dur"] < 0:
+                raise ValueError(f"complete event {i}: negative dur")
+
+
+def _thread_labels(doc: dict) -> Dict[int, str]:
+    names = {}
+    for e in doc["traceEvents"]:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            names[e["tid"]] = e["args"].get("name", str(e["tid"]))
+    return names
+
+
+def span_summary(doc: dict) -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    for e in doc["traceEvents"]:
+        if e.get("ph") != "X":
+            continue
+        s = out.setdefault(e["name"], {"count": 0, "total_ms": 0.0,
+                                       "max_ms": 0.0})
+        dur_ms = e["dur"] / 1e3
+        s["count"] += 1
+        s["total_ms"] += dur_ms
+        s["max_ms"] = max(s["max_ms"], dur_ms)
+    for s in out.values():
+        s["mean_ms"] = s["total_ms"] / max(1, s["count"])
+        for k in ("total_ms", "mean_ms", "max_ms"):
+            s[k] = round(s[k], 3)
+    return out
+
+
+def thread_coverage(doc: dict) -> Dict[str, float]:
+    """Fraction of each thread's first→last-event window covered by the
+    union of its span intervals (nested spans merge — a parent covering
+    its children counts once). Keys are ``name/tid`` — several engine
+    runs in one process each start their own scheduling-loop thread,
+    and folding them into one key would splice disjoint windows."""
+    labels = _thread_labels(doc)
+    by_tid: Dict[int, list] = {}
+    for e in doc["traceEvents"]:
+        if e.get("ph") == "X":
+            by_tid.setdefault(e["tid"], []).append(
+                (e["ts"], e["ts"] + e["dur"]))
+    out = {}
+    for tid, iv in by_tid.items():
+        iv.sort()
+        lo, hi = iv[0][0], max(b for _a, b in iv)
+        covered = 0.0
+        cur_a, cur_b = iv[0]
+        for a, b in iv[1:]:
+            if a <= cur_b:
+                cur_b = max(cur_b, b)
+            else:
+                covered += cur_b - cur_a
+                cur_a, cur_b = a, b
+        covered += cur_b - cur_a
+        label = f"{labels.get(tid, 'thread')}/{tid}"
+        out[label] = round(covered / max(hi - lo, 1e-9), 4)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace", help="Chrome trace-event JSON "
+                                  "(Scheduler.dump_trace output)")
+    ap.add_argument("--thread", default=None,
+                    help="only summarize spans from this thread name")
+    args = ap.parse_args()
+    doc = json.load(open(args.trace, encoding="utf-8"))
+    validate(doc)
+    labels = _thread_labels(doc)
+    if args.thread:
+        keep = {tid for tid, n in labels.items() if args.thread in n}
+        doc = {"traceEvents": [
+            e for e in doc["traceEvents"]
+            if e.get("ph") == "M" or e.get("tid") in keep]}
+    spans = span_summary(doc)
+    dropped = (doc.get("otherData") or {}).get("dropped_events", 0)
+    print(f"{args.trace}: {sum(s['count'] for s in spans.values())} "
+          f"spans across {len(spans)} names"
+          + (f" ({dropped} events dropped by the ring)" if dropped else ""))
+    print(f"  {'span':<24s} {'count':>7s} {'total ms':>10s} "
+          f"{'mean ms':>9s} {'max ms':>9s}")
+    for name, s in sorted(spans.items(), key=lambda kv: -kv[1]["total_ms"]):
+        print(f"  {name:<24s} {s['count']:>7d} {s['total_ms']:>10.3f} "
+              f"{s['mean_ms']:>9.3f} {s['max_ms']:>9.3f}")
+    cov = thread_coverage(doc)
+    if cov:
+        print("thread coverage (union of spans / thread window):")
+        for label, frac in sorted(cov.items()):
+            print(f"  {label:<24s} {100.0 * frac:>6.1f}%")
+    instants = [e for e in doc["traceEvents"] if e.get("ph") in ("i", "I")]
+    if instants:
+        print(f"instants ({len(instants)}):")
+        for e in sorted(instants, key=lambda e: e["ts"]):
+            print(f"  {e['ts'] / 1e3:>12.3f} ms  {e['name']}"
+                  f"  {e.get('args') or ''}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
