@@ -12,10 +12,11 @@ CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 # tier1 uses pipefail/PIPESTATUS (bash-isms).
 SHELL := /bin/bash
 
-.PHONY: test tier1 fault-smoke shortlist-smoke trace-smoke profile-smoke \
-        start start-remote start-client-engine demo docs bench \
-        bench_sharded bench-cpu bench-pipeline bench-residency \
-        bench-shortlist bench-trace dryrun dryrun-dcn soak soak-faults
+.PHONY: test tier1 fault-smoke shortlist-smoke trace-smoke churn-smoke \
+        profile-smoke start start-remote start-client-engine demo docs \
+        bench bench_sharded bench-cpu bench-pipeline bench-residency \
+        bench-shortlist bench-trace bench-churn dryrun dryrun-dcn soak \
+        soak-faults soak-churn
 
 # Unit + integration suite on a virtual 8-device CPU mesh.
 test:
@@ -41,12 +42,23 @@ trace-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs.py -x -q \
 	  -p no:cacheprovider -p no:randomly
 
+# Fast deterministic lifecycle suite (~60 s): seed determinism
+# (byte-identical event stream + canonical final state), per-generator
+# invariants on clean live runs, the cordon/drain facade verbs,
+# faulted-churn recovery, and the adversarial PDB overlap. A tier-1
+# prerequisite alongside fault-smoke/trace-smoke: the scenario oracle
+# every soak leans on must itself be deterministic and sound.
+churn-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_lifecycle.py -x -q \
+	  -p no:cacheprovider -p no:randomly
+
 # The EXACT ROADMAP tier-1 verify command (dots count + exit code
 # preserved) — what the driver runs after every PR; run it locally
 # before shipping. shortlist-smoke runs first: the arbitration
 # exactness contract gates the rest of the suite; trace-smoke next: the
-# measurement layer must not perturb decisions.
-tier1: shortlist-smoke trace-smoke
+# measurement layer must not perturb decisions; churn-smoke last: the
+# lifecycle oracle rides on both.
+tier1: shortlist-smoke trace-smoke churn-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -150,6 +162,15 @@ bench-shortlist:
 bench-trace:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_trace.py
 
+# p99-under-churn bench (the committed BENCH_CHURN.json): interleaved
+# clean/faulted lifecycle-churn rounds through bench.churn_bench —
+# clean rounds must run undegraded (resident, zero fault fires),
+# faulted rounds must exercise the supervisor ladder (escalations > 0)
+# and recover to resident; every lifecycle invariant enforced after
+# every event; latency keys histogram-derived over every bound pod.
+bench-churn:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_churn.py
+
 # Compile-check the flagship single-chip step and the multi-chip sharded
 # step on an 8-device virtual mesh.
 dryrun:
@@ -180,4 +201,16 @@ soak-faults:
 	  echo "soak-faults iteration $$i (MINISCHED_FAULT_SEED=$$i)"; \
 	  MINISCHED_FAULT_SEED=$$i $(CPU_MESH) $(PY) -m pytest \
 	    tests/test_chaos.py -x -q || exit 1; \
+	done
+
+# Lifecycle-churn soak: repeat the scenario-engine suite reseeding the
+# generator streams (and the fault PRNG for the faulted-churn case)
+# per iteration — successive runs explore different workload-dynamics
+# interleavings while any failing iteration replays exactly from its
+# seed (MINISCHED_LIFECYCLE_SEED=<i>).
+soak-churn:
+	@for i in $$(seq 1 $(SOAK_N)); do \
+	  echo "soak-churn iteration $$i (MINISCHED_LIFECYCLE_SEED=$$i)"; \
+	  MINISCHED_LIFECYCLE_SEED=$$i MINISCHED_FAULT_SEED=$$i $(CPU_MESH) \
+	    $(PY) -m pytest tests/test_lifecycle.py -x -q || exit 1; \
 	done

@@ -555,6 +555,22 @@ def run_child() -> None:
     print(json.dumps(result))
     sys.stdout.flush()
 
+    # ---- p99 under churn: cluster-lifecycle scenario engine ------------
+    # Production-shaped workload dynamics (autoscaling pool, reclamation
+    # waves, rolling upgrade under a disruption budget, diurnal + tenant
+    # arrivals) driving the real engine with every lifecycle invariant
+    # enforced; the latency keys come from the always-on create→bound
+    # histogram. Clean here (no faults): the artifact must prove
+    # degradation_state=resident with zero fires. The faulted
+    # counterpart lives in tools/bench_churn.py / BENCH_CHURN.json.
+    try:
+        if in_budget("churn_hist_p99_s"):
+            detail.update(churn_bench())
+    except Exception as e:
+        detail["churn_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(result))
+    sys.stdout.flush()
+
     # ---- explain-mode overhead -----------------------------------------
     # Same engine run at 1k nodes with and without the explainability
     # recorder (off-thread ingest, top-k annotations): the per-decision
@@ -1167,6 +1183,145 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                 f"{prefix}_quarantined":
                     int(m.get("quarantined_batches", 0)),
             }
+    return out
+
+
+def churn_bench(n_base_nodes=16, duration_s=6.0, seed=None, prefix="churn",
+                faults_spec="", max_unavailable=2, settle_timeout_s=60.0,
+                probation=2) -> dict:
+    """p99-under-churn phase: drive the REAL engine with the
+    cluster-lifecycle scenario subsystem (minisched_tpu/lifecycle) —
+    diurnal arrivals + a priority tenant mix over an autoscaling pool
+    under reclamation waves and a rolling upgrade sharing one
+    max-unavailable disruption budget — with every lifecycle invariant
+    enforced after every event. The published p50/p95/p99 come from the
+    engine's always-on create→bound histogram (every bound pod, not
+    sampled windows), and the supervisor/fault counters prove whether
+    the run was clean (``degradation_state=resident``, zero fires) or
+    exercised the degradation ladder (``faults_spec`` armed:
+    escalations > 0, then a post-churn probation pump must recover the
+    engine to ``resident``).
+
+    Env: MINISCHED_LIFECYCLE_SEED seeds the generator streams;
+    MINISCHED_LIFECYCLE_RATE / MINISCHED_LIFECYCLE_AMPLITUDE scale the
+    arrival curve."""
+    from minisched_tpu import faults as _faults
+    from minisched_tpu.config import SchedulerConfig
+    from minisched_tpu.lifecycle import (AutoscalerLoop, LifecycleDriver,
+                                         PoissonArrivals, ReclamationWave,
+                                         RollingUpgrade, TenantMix,
+                                         seed_from_env)
+    from minisched_tpu.scenario import Cluster
+    from minisched_tpu.service.defaultconfig import Profile
+
+    seed = seed_from_env() if seed is None else int(seed)
+    rate = float(os.environ.get("MINISCHED_LIFECYCLE_RATE", "40"))
+    amplitude = float(os.environ.get("MINISCHED_LIFECYCLE_AMPLITUDE", "0.6"))
+
+    c = Cluster()
+    c.start(
+        profile=Profile(name="churn",
+                        plugins=["NodeUnschedulable", "NodeResourcesFit",
+                                 "NodeResourcesLeastAllocated",
+                                 "DefaultPreemption"]),
+        config=SchedulerConfig(backoff_initial_s=0.05, backoff_max_s=0.2,
+                               max_batch_size=128,
+                               probation_batches=probation,
+                               resident_check_every=(1 if faults_spec
+                                                     else 0)),
+        with_pv_controller=False)
+    sched = c.service.scheduler
+    out = {}
+    try:
+        # The base pool exists before churn so the first arrivals have
+        # somewhere to land; faults arm AFTER boot (the sync path is not
+        # under test here).
+        driver = LifecycleDriver(c, seed=seed, pace=1.0, settle_s=8.0)
+        budget = driver.budget("base", max_unavailable=max_unavailable)
+        for _ in range(n_base_nodes):
+            driver.view.create_pool_node("base", cpu=4000)
+        driver.add(PoissonArrivals(
+            "arrivals", rate_pps=rate, duration_s=duration_s,
+            amplitude=amplitude, period_s=duration_s / 2, cpu=100,
+            prefix="ch"))
+        driver.add(TenantMix(
+            "tenants", rate_pps=rate / 2, duration_s=duration_s, cpu=150))
+        driver.add(AutoscalerLoop(
+            "autoscaler", pool="as", interval_s=0.4, min_nodes=2,
+            max_nodes=8, scale_up_pending=12, idle_rounds=2, cpu=4000,
+            drain_grace_s=0.3))
+        driver.add(ReclamationWave(
+            "reclaim", pool="base", interval_s=duration_s / 3,
+            wave_frac=0.2, grace_s=0.4,
+            waves=max(1, int(duration_s // 2)), budget=budget))
+        driver.add(RollingUpgrade(
+            "upgrade", pool="base", budget=budget, grace_s=0.3,
+            retry_s=0.25, start_after_s=0.5))
+        driver.install_default_invariants()
+        _faults.FAULTS.reset_counts()
+        if faults_spec:
+            _faults.configure(faults_spec, seed)
+        t0 = time.perf_counter()
+        driver.run(until_s=duration_s)
+        # Snapshot fires BEFORE disarming: configure("") resets the
+        # registry counters the metrics surface reads live.
+        fault_fires = sum(_faults.FAULTS.counts().values())
+        if faults_spec:
+            # Faults stop with the churn: quiescence below is recovery.
+            _faults.configure("")
+        settled = driver.settle(timeout=settle_timeout_s)
+        driver.check_invariants()
+        churn_s = time.perf_counter() - t0
+
+        # Recovery pump: the probation ladder re-escalates only on CLEAN
+        # batches, and a drained queue produces none — feed small bursts
+        # until the engine climbs back to the full fast path.
+        pumped = 0
+        if faults_spec:
+            deadline = time.time() + 30
+            while (sched.metrics()["degradation_state"] != "resident"
+                   and time.time() < deadline):
+                for i in range(8):
+                    driver.view.create_pod(f"pump-{pumped}-{i}", cpu=10)
+                pumped += 1
+                driver.settle(timeout=10)
+            driver.check_invariants()
+
+        m = sched.metrics()
+        out = {
+            f"{prefix}_seed": seed,
+            f"{prefix}_events": len(driver.events),
+            f"{prefix}_steps": driver.steps,
+            f"{prefix}_invariant_checks": driver.invariant_checks,
+            f"{prefix}_violations": 0,  # check_invariants raised otherwise
+            f"{prefix}_settled": bool(settled),
+            f"{prefix}_wall_s": round(churn_s, 3),
+            f"{prefix}_pods_bound": int(m["pods_bound"]),
+            f"{prefix}_pods_per_sec": round(
+                m["pods_bound"] / max(churn_s, 1e-9), 1),
+            f"{prefix}_batches": int(m["batches"]),
+            f"{prefix}_degradation_state": m["degradation_state"],
+            f"{prefix}_escalations": int(m.get("supervisor_escalations", 0)),
+            f"{prefix}_recoveries": int(m.get("supervisor_recoveries", 0)),
+            f"{prefix}_quarantined": int(m.get("quarantined_batches", 0)),
+            f"{prefix}_watchdog_trips": int(m.get("watchdog_trips", 0)),
+            f"{prefix}_fault_fires": int(fault_fires),
+            f"{prefix}_faulted_steps": driver.faulted_steps,
+            f"{prefix}_queue_moves": int(m.get("queue_moves", 0)),
+            f"{prefix}_queue_move_skips": int(m.get("queue_move_skips", 0)),
+            f"{prefix}_budget_denials": budget.denials,
+            f"{prefix}_budget_high_water": budget.high_water,
+            f"{prefix}_recovery_pumps": pumped,
+            **_hist_latency_keys(m, prefix),
+        }
+        for k in ("pods_created", "pods_evicted", "pods_recreated",
+                  "nodes_added", "nodes_deleted", "nodes_reclaimed",
+                  "nodes_upgraded", "cordons", "uncordons",
+                  "autoscaler_scale_ups", "autoscaler_scale_downs"):
+            out[f"{prefix}_{k}"] = driver.view.counters.get(k, 0)
+    finally:
+        _faults.configure("")
+        c.shutdown()
     return out
 
 

@@ -26,7 +26,8 @@ from ..encode import NodeFeatureCache
 from ..encode import features as F
 from ..state.objects import pod_requests
 from ..errors import NotFoundError
-from ..state.events import ActionType, ClusterEvent, GVK, watch_to_cluster_event
+from ..state.events import (ActionType, ClusterEvent, GVK,
+                            node_update_narrows_only, watch_to_cluster_event)
 from ..state.informer import InformerFactory, ResourceEventHandlers
 from ..state.store import EventType, WatchEvent
 
@@ -264,6 +265,15 @@ def _add_all_event_handlers(state: SharedClusterState,
 
     def node_update(old, new):
         state.cache.upsert_node(new)
+        # Drain/cordon-aware requeue (lifecycle churn): a purely
+        # NARROWING update — cordon, taints grown, allocatable shrunk,
+        # nothing else changed — cannot make any parked pod schedulable;
+        # fanning it out would revive the whole unschedulableQ per
+        # cordon and bump every engine's move cycle (in-flight batches
+        # would then route terminal verdicts to backoff, thrashing
+        # forever under sustained churn). The cache still observes it.
+        if node_update_narrows_only(old, new):
+            return
         move_all(watch_to_cluster_event(
             WatchEvent(EventType.MODIFIED, GVK.NODE, new, old)))
 

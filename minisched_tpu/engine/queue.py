@@ -104,6 +104,11 @@ class SchedulingQueue:
         # moveRequestCycle mechanism; the reference has the same race with a
         # tiny window, widened here by batch+compile latency).
         self._move_cycle = 0
+        # Requeue fan-out accounting (lifecycle churn observability):
+        # moves that scanned the unschedulableQ vs events dropped at the
+        # no-registered-interest gate.
+        self._moves = 0
+        self._move_skips = 0
         self._closed = False
         self._flusher = threading.Thread(
             target=self._flush_loop, args=(flush_interval,), daemon=True,
@@ -261,8 +266,22 @@ class SchedulingQueue:
 
     def move_all_to_active_or_backoff(self, event: ClusterEvent) -> None:
         """A cluster event occurred: revive matching unschedulable pods
-        (reference MoveAllToActiveOrBackoffQueue queue.go:54-82)."""
+        (reference MoveAllToActiveOrBackoffQueue queue.go:54-82).
+
+        Drain/cordon-aware gating: an event NO registered plugin has
+        interest in cannot revive anything — it is dropped before it
+        bumps the move cycle. Bumping unconditionally (the old behavior)
+        made every in-flight attempt that straddled ANY event route its
+        unschedulable verdict to backoff instead of parking; under
+        lifecycle churn (node updates every few hundred ms) terminal
+        pods then cycled backoff→active→reject forever. (Narrowing node
+        updates — cordons, shrinking allocatable — are additionally
+        suppressed upstream of the queue, engine/clusterstate.py.)"""
         with self._cond:
+            if not any(reg.matches(event) for reg in self._event_map):
+                self._move_skips += 1
+                return
+            self._moves += 1
             self._move_cycle += 1
             moved = []
             for key, qpi in list(self._unschedulable.items()):
@@ -404,7 +423,9 @@ class SchedulingQueue:
         with self._cond:
             return {"active": self._active_live,
                     "backoff": self._backoff_live,
-                    "unschedulable": len(self._unschedulable)}
+                    "unschedulable": len(self._unschedulable),
+                    "moves": self._moves,
+                    "move_skips": self._move_skips}
 
     def unschedulable_keys(self) -> Set[str]:
         with self._cond:

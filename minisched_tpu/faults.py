@@ -24,6 +24,13 @@ production scheduler's failure domain spans:
     informer    informer dispatch loop       (state/informer.py)
     http        RemoteStore HTTP exchange    (apiserver/client.py)
     checkpoint  durable snapshot write       (state/persistence.py)
+    lifecycle   scenario-driver step         (lifecycle/driver.py) —
+                composes workload churn with infrastructure faults in
+                one MINISCHED_FAULTS spec: ``err``/``die`` skip the
+                generator step (retried shortly after — a flaky
+                orchestrator tick), ``corrupt`` burns one PRNG draw
+                (deterministic schedule perturbation), ``stall``
+                delays the step.
 
 Configured once per process from ``MINISCHED_FAULTS`` (tests reconfigure
 via :func:`configure`), a comma-separated list of ``gate:action@trigger``
@@ -84,7 +91,7 @@ log = logging.getLogger(__name__)
 #: The gate catalog; hit() rejects unknown names so a typo in a rule or a
 #: call site cannot silently never fire.
 GATES = ("step", "fetch", "residency", "shortlist_repair", "commit",
-         "bind", "informer", "http", "checkpoint")
+         "bind", "informer", "http", "checkpoint", "lifecycle")
 
 _ACTIONS = ("err", "die", "corrupt", "stall")
 

@@ -1,0 +1,26 @@
+"""Cluster-lifecycle scenario engine: seed-deterministic, composable
+workload-dynamics generators with an always-on invariant oracle.
+
+See ``driver.py`` for the event-loop contract, ``generators.py`` for the
+catalog (autoscaler loops, reclamation waves, rolling upgrades, diurnal
+arrivals, tenant mixes), ``invariants.py`` for the checks every soak
+enforces, and ARCHITECTURE.md "Cluster-lifecycle scenario engine".
+"""
+from .driver import (AMPLITUDE_ENV, RATE_ENV, SEED_ENV, DisruptionBudget,
+                     InvariantViolation, LifecycleDriver, LifecycleEvent,
+                     LifecycleView, seed_from_env)
+from .generators import (AutoscalerLoop, Generator, PoissonArrivals,
+                         ReclamationWave, RollingUpgrade, TenantMix)
+from .invariants import (MonotoneVersions, bound_on_live_nodes,
+                         budget_respected, default_invariants, no_overcommit,
+                         no_pod_lost)
+
+__all__ = [
+    "AMPLITUDE_ENV", "RATE_ENV", "SEED_ENV",
+    "AutoscalerLoop", "DisruptionBudget", "Generator",
+    "InvariantViolation", "LifecycleDriver", "LifecycleEvent",
+    "LifecycleView", "MonotoneVersions", "PoissonArrivals",
+    "ReclamationWave", "RollingUpgrade", "TenantMix",
+    "bound_on_live_nodes", "budget_respected", "default_invariants",
+    "no_overcommit", "no_pod_lost", "seed_from_env",
+]

@@ -1,0 +1,144 @@
+"""Invariant layer: the checks that turn every lifecycle soak into a
+correctness oracle.
+
+Each invariant is a callable ``fn(view) -> list[str]`` (empty = holds);
+the driver runs all of them after every generator step and retries a
+non-empty result through its settle window (live mode: the event
+broadcaster commits Preempted events asynchronously and informers lag
+the store by design) before raising
+:class:`~.driver.InvariantViolation`.
+
+The default set:
+
+  * **no_pod_lost** — every pod the ledger expects is in the store, or
+    its absence is explained by a Preempted event (then it moves to the
+    preempted ledger for the reconciler); and no tombstoned pod ever
+    resurfaces (resurrection = a stale queue entry re-binding a deleted
+    incarnation).
+  * **bound_on_live_nodes** — a bound pod's node exists. The store
+    refuses bindings to missing nodes and ``delete_node`` sweeps
+    post-delete, so any violation is a real engine/GC defect, not a
+    tolerated transient.
+  * **disruption_budget** (per registered budget) — re-derived from the
+    STORE, not the budget object: cordoned live members of the pool
+    never exceed max_unavailable. The budget's own high-water is
+    checked too (trust, but verify both sides).
+  * **monotone_versions** — the store's resource_version and every
+    observed object's metadata.resource_version only ever advance
+    (generation counters are monotone across churn, delete/recreate
+    included).
+  * **no_overcommit** — no live node's bound pods exceed its
+    allocatable on any axis (the chaos-suite capacity contract, now
+    checked continuously instead of at quiescence only).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+
+def no_pod_lost(view) -> List[str]:
+    store_keys = {p.key for p in view.store.list("Pod")}
+    viols = []
+    missing = [k for k in view.expected_pods if k not in store_keys]
+    if missing:
+        preempted = view.preempted_event_keys()
+        for k in missing:
+            if k in preempted:
+                view.note_preempted(k)
+            else:
+                viols.append(f"pod {k} silently lost "
+                             "(absent, no Preempted event)")
+    resurrected = store_keys & view.deleted_pods
+    for k in sorted(resurrected):
+        viols.append(f"pod {k} resurrected after deletion")
+    return viols
+
+
+def bound_on_live_nodes(view) -> List[str]:
+    nodes = {n.metadata.name for n in view.store.list("Node")}
+    return [f"pod {p.key} bound to missing node {p.spec.node_name!r}"
+            for p in view.store.list("Pod")
+            if p.spec.node_name and p.spec.node_name not in nodes]
+
+
+def budget_respected(budget):
+    """Closure invariant over one :class:`~.driver.DisruptionBudget`."""
+
+    def check(view) -> List[str]:
+        viols = []
+        cordoned = [
+            n.metadata.name for n in view.store.list("Node")
+            if n.metadata.labels.get("minisched.io/pool") == budget.pool
+            and n.spec.unschedulable]
+        if len(cordoned) > budget.max_unavailable:
+            viols.append(
+                f"pool {budget.pool!r}: {len(cordoned)} cordoned "
+                f"({sorted(cordoned)}) > max_unavailable "
+                f"{budget.max_unavailable}")
+        if budget.high_water > budget.max_unavailable:
+            viols.append(
+                f"pool {budget.pool!r}: budget high-water "
+                f"{budget.high_water} > {budget.max_unavailable}")
+        return viols
+
+    return check
+
+
+class MonotoneVersions:
+    """Stateful: remembers the highest resource_version seen globally
+    and per object; any regression is a violation."""
+
+    def __init__(self):
+        self._rv = 0
+        self._per_obj: Dict[Tuple[str, str], int] = {}
+
+    def __call__(self, view) -> List[str]:
+        viols = []
+        rv = view.store.resource_version()
+        if rv < self._rv:
+            viols.append(f"store resource_version regressed {rv} < {self._rv}")
+        self._rv = max(self._rv, rv)
+        for kind in ("Node", "Pod"):
+            for o in view.store.list(kind):
+                k = (kind, o.key)
+                r = o.metadata.resource_version
+                last = self._per_obj.get(k, 0)
+                if r < last:
+                    viols.append(
+                        f"{kind} {o.key} resource_version regressed "
+                        f"{r} < {last}")
+                else:
+                    self._per_obj[k] = r
+        return viols
+
+
+def no_overcommit(view) -> List[str]:
+    nodes = {n.metadata.name: n for n in view.store.list("Node")}
+    used: Dict[str, Dict[str, float]] = {}
+    for p in view.store.list("Pod"):
+        if p.spec.node_name and p.spec.node_name in nodes:
+            u = used.setdefault(p.spec.node_name, {})
+            for k, v in p.spec.requests.items():
+                u[k] = u.get(k, 0.0) + v
+    viols = []
+    for name, u in used.items():
+        alloc = nodes[name].status.allocatable
+        for k, v in u.items():
+            if v > alloc.get(k, 0) + 1e-6:
+                viols.append(f"node {name} over-committed on {k}: "
+                             f"{v} > {alloc.get(k)}")
+    return viols
+
+
+def default_invariants(driver):
+    """(name, fn) pairs the driver installs by default — the standard
+    oracle plus one budget invariant per registered pool budget."""
+    out = [
+        ("no_pod_lost", no_pod_lost),
+        ("bound_on_live_nodes", bound_on_live_nodes),
+        ("monotone_versions", MonotoneVersions()),
+        ("no_overcommit", no_overcommit),
+    ]
+    for pool, b in sorted(driver.budgets().items()):
+        out.append((f"disruption_budget[{pool}]", budget_respected(b)))
+    return out

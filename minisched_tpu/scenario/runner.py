@@ -156,6 +156,62 @@ class Cluster:
     def delete_node(self, name: str) -> None:
         self.store.delete("Node", name)
 
+    # ---- node mutation verbs (client-go patch/cordon analogs) ----------
+    # Nodes could previously only be BORN unschedulable; these mutate a
+    # live node through the same store→watch→informer path the create
+    # verbs use, so the engine observes them exactly like a kubectl
+    # cordon/drain (the lifecycle generators drive churn through here).
+
+    def update_node(self, name: str, *, unschedulable: Optional[bool] = None,
+                    labels: Optional[dict] = None,
+                    taints: Optional[list] = None,
+                    allocatable: Optional[dict] = None,
+                    replace_labels: bool = False) -> obj.Node:
+        """Mutate a live node (get → modify → update CAS-free, like a
+        strategic-merge patch). ``labels`` merge by default
+        (``replace_labels=True`` substitutes the whole map); ``taints``
+        replace; ``allocatable`` axes merge."""
+        node = self.store.get("Node", name)
+        if unschedulable is not None:
+            node.spec.unschedulable = bool(unschedulable)
+        if taints is not None:
+            node.spec.taints = list(taints)
+        if labels is not None:
+            if replace_labels:
+                node.metadata.labels = dict(labels)
+            else:
+                node.metadata.labels.update(labels)
+        if allocatable is not None:
+            node.status.allocatable.update(allocatable)
+        return self.store.update(node)
+
+    def cordon(self, name: str) -> obj.Node:
+        """Mark unschedulable (kubectl cordon): new placements stop; a
+        purely-narrowing update, so the engine skips the requeue scan."""
+        return self.update_node(name, unschedulable=True)
+
+    def uncordon(self, name: str) -> obj.Node:
+        return self.update_node(name, unschedulable=False)
+
+    def drain(self, name: str, *, delete_pods: bool = True) -> List[obj.Pod]:
+        """kubectl-drain shape: cordon, then evict (delete) every pod
+        bound to the node. Returns the evicted pod objects — recreating
+        replacements is the caller's (controller's) job, exactly as with
+        a real drain."""
+        from ..errors import NotFoundError
+
+        self.cordon(name)
+        evicted: List[obj.Pod] = []
+        if delete_pods:
+            for p in self.list_pods():
+                if p.spec.node_name == name:
+                    try:
+                        self.store.delete("Pod", p.key)
+                    except NotFoundError:
+                        continue  # deleted concurrently: already gone
+                    evicted.append(p)
+        return evicted
+
     # ---- assertions ----------------------------------------------------
 
     def wait_for_pod_bound(self, name: str, namespace: str = "default",

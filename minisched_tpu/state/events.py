@@ -82,6 +82,44 @@ def watch_to_cluster_event(ev: WatchEvent) -> ClusterEvent:
     return ClusterEvent(ev.kind, action)
 
 
+def node_update_narrows_only(old, new) -> bool:
+    """True when a node MODIFIED event can only have REDUCED
+    schedulability — a cordon (unschedulable set), taints grown,
+    allocatable shrunk — with every widening-capable dimension (labels,
+    images, capacity, taint removal, any allocatable growth or axis
+    removal) unchanged. Such an event cannot make any parked pod
+    schedulable, so the requeue fan-out skips it entirely: under
+    lifecycle churn (cordon/drain waves every few hundred ms) the
+    unconditional fan-out otherwise revives the whole unschedulableQ on
+    every cordon, and every in-flight batch straddles a move cycle —
+    terminally-unschedulable pods then thrash through backoff forever
+    instead of parking. Conservative by construction: any dimension this
+    function doesn't understand makes it return False (fan out)."""
+    if old is None:
+        return False
+    if (new.metadata.labels != old.metadata.labels
+            or new.metadata.annotations != old.metadata.annotations
+            or new.status.images != old.status.images
+            or new.status.capacity != old.status.capacity):
+        return False
+    if old.spec.unschedulable and not new.spec.unschedulable:
+        return False  # uncordon widens
+    old_taints = {(t.key, t.value, t.effect) for t in old.spec.taints}
+    new_taints = {(t.key, t.value, t.effect) for t in new.spec.taints}
+    if not old_taints <= new_taints:
+        return False  # a taint was removed: widens
+    old_alloc, new_alloc = old.status.allocatable, new.status.allocatable
+    if set(old_alloc) - set(new_alloc):
+        # An axis REMOVED can widen: absent attachable-volumes falls
+        # back to the default ceiling (objects.py), which may exceed
+        # the old explicit value.
+        return False
+    for k, v in new_alloc.items():
+        if v > old_alloc.get(k, 0):
+            return False  # capacity grew on some axis
+    return True
+
+
 class EventBroadcaster:
     """Records scheduler lifecycle events into the store's Event collection
     (reference scheduler/scheduler.go:55-59 events.NewBroadcaster →

@@ -552,6 +552,30 @@ def test_checkpoint_fault_preserves_previous_snapshot(tmp_path, registry):
     cp.close()
 
 
+# ---- lifecycle gate: scenario-driver step faults (PR 7) -----------------
+
+
+def test_lifecycle_gate_skips_steps_but_loses_nothing(registry):
+    """The ``lifecycle`` gate composes workload churn with the fault
+    registry: an err at the scenario driver's step seam skips the tick
+    (counted) and retries it shortly after — the generator still
+    completes its schedule and the ledger stays whole."""
+    from minisched_tpu.lifecycle import LifecycleDriver, PoissonArrivals
+    from minisched_tpu.scenario import Cluster
+
+    c = Cluster()  # no engine: pure generation
+    d = LifecycleDriver(c, seed=3)
+    d.add(PoissonArrivals("arrivals", rate_pps=40, duration_s=1.0,
+                          prefix="flt"))
+    d.install_default_invariants()
+    _configure("lifecycle:err@2,lifecycle:err@5")
+    d.run()
+    assert registry.counts()["lifecycle"] == 2
+    assert d.faulted_steps == 2
+    assert d.view.counters.get("pods_created", 0) > 5
+    d.check_invariants()
+
+
 # ---- whole-suite coverage ------------------------------------------------
 
 

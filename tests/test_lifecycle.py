@@ -1,0 +1,422 @@
+"""Cluster-lifecycle scenario engine suite (minisched_tpu/lifecycle).
+
+What this file pins:
+
+  * Seed determinism — same MINISCHED_LIFECYCLE_SEED ⇒ byte-identical
+    event stream AND identical (canonicalized) final cluster state in
+    pure mode; a different seed diverges.
+  * Each generator's invariants hold on a clean LIVE run against the
+    real engine (the soak-as-oracle contract).
+  * The new Cluster facade verbs (cordon/uncordon/drain/update_node)
+    flow through the informer-observed path: cordon blocks placement,
+    uncordon revives via event-filtered requeue, a narrowing update
+    does NOT thrash the unschedulableQ.
+  * A faulted-churn run (MINISCHED_FAULTS composed with the lifecycle
+    registry) recovers: escalations > 0, zero invariant violations,
+    engine back to "resident" after a probation pump.
+  * The PDB-like disruption budget is provably never violated under an
+    adversarial upgrade+reclamation overlap on one pool (pure mode:
+    deterministic, and the invariant is re-derived from the store).
+
+``make churn-smoke`` runs this file alone; ``make soak-churn`` repeats
+it reseeding MINISCHED_LIFECYCLE_SEED per iteration.
+"""
+import os
+import time
+
+import pytest
+
+from minisched_tpu import faults
+from minisched_tpu.config import SchedulerConfig
+from minisched_tpu.lifecycle import (AutoscalerLoop, InvariantViolation,
+                                     LifecycleDriver, PoissonArrivals,
+                                     ReclamationWave, RollingUpgrade,
+                                     TenantMix, seed_from_env)
+from minisched_tpu.scenario import Cluster
+from minisched_tpu.service.defaultconfig import Profile
+
+SEED = seed_from_env()  # soak-churn reseeds per iteration via the env
+
+
+def pure_cluster() -> Cluster:
+    """A Cluster with NO engine attached: the store is mutated only by
+    the driver, so generator output is a pure function of the seed."""
+    return Cluster()
+
+
+def make_composed_driver(cluster, seed, *, pace=0.0, settle_s=0.0,
+                         duration=3.0):
+    """The standard composition: arrivals + tenants over an autoscaling
+    pool with reclamation + rolling upgrade sharing one budget."""
+    d = LifecycleDriver(cluster, seed=seed, pace=pace, settle_s=settle_s)
+    budget = d.budget("base", max_unavailable=2)
+    for _ in range(8):
+        d.view.create_pool_node("base", cpu=2000)
+    d.add(PoissonArrivals("arrivals", rate_pps=30, duration_s=duration,
+                          amplitude=0.6, period_s=duration / 2, prefix="lc"))
+    d.add(TenantMix("tenants", rate_pps=10, duration_s=duration,
+                    prefix="tm"))
+    d.add(AutoscalerLoop("autoscaler", pool="as", interval_s=0.25,
+                         min_nodes=2, max_nodes=6, scale_up_pending=10,
+                         idle_rounds=2, cpu=2000, drain_grace_s=0.2))
+    d.add(ReclamationWave("reclaim", pool="base", interval_s=1.0,
+                          wave_frac=0.25, grace_s=0.2, waves=2,
+                          budget=budget))
+    d.add(RollingUpgrade("upgrade", pool="base", budget=budget,
+                         grace_s=0.2, retry_s=0.1, start_after_s=0.3))
+    d.install_default_invariants()
+    return d, budget
+
+
+# ---- seed determinism (pure mode) ----------------------------------------
+
+
+def _pure_run(seed):
+    c = pure_cluster()
+    d, _b = make_composed_driver(c, seed)
+    d.run(until_s=6.0)
+    return d
+
+
+def test_same_seed_byte_identical_stream_and_state():
+    a = _pure_run(SEED)
+    b = _pure_run(SEED)
+    assert a.event_lines(), "composition generated no events"
+    # byte-identical event stream, line for line
+    assert a.event_lines() == b.event_lines()
+    assert a.stream_digest() == b.stream_digest()
+    # identical final cluster state (canonicalized: uids/wall-clock out)
+    assert a.state_digest() == b.state_digest()
+    # and the run actually exercised the catalog
+    counters = a.view.counters
+    assert counters.get("pods_created", 0) > 20
+    assert counters.get("nodes_reclaimed", 0) >= 1
+    assert counters.get("nodes_upgraded", 0) >= 1
+
+
+def test_different_seed_diverges():
+    a = _pure_run(SEED)
+    b = _pure_run(SEED + 1)
+    assert a.stream_digest() != b.stream_digest()
+
+
+def test_generator_stream_independence():
+    """Adding a generator must not shift another's draws (per-generator
+    PRNG streams): the arrivals-only prefix of a composed run matches a
+    solo arrivals run event-for-event."""
+    def arrivals_events(compose):
+        c = pure_cluster()
+        d = LifecycleDriver(c, seed=SEED)
+        d.add(PoissonArrivals("arrivals", rate_pps=30, duration_s=2.0,
+                              prefix="ind"))
+        if compose:
+            d.add(TenantMix("tenants", rate_pps=15, duration_s=2.0,
+                            prefix="ind-tm"))
+        d.run()
+        return [e.line() for e in d.events if e.gen == "arrivals"]
+
+    assert arrivals_events(False) == arrivals_events(True)
+
+
+# ---- invariants on clean live runs ---------------------------------------
+
+
+def live_cluster(**cfg_kw) -> Cluster:
+    c = Cluster()
+    cfg = SchedulerConfig(backoff_initial_s=0.05, backoff_max_s=0.2,
+                          max_batch_size=64, **cfg_kw)
+    c.start(profile=Profile(plugins=["NodeUnschedulable",
+                                     "NodeResourcesFit",
+                                     "NodeResourcesLeastAllocated",
+                                     "DefaultPreemption"]),
+            config=cfg, with_pv_controller=False)
+    return c
+
+
+def test_clean_composed_live_run_holds_invariants():
+    """The full composition against the real engine: every invariant
+    holds after every event, the cluster settles, nothing degrades."""
+    c = live_cluster()
+    try:
+        d, budget = make_composed_driver(c, SEED, pace=1.0, settle_s=8.0,
+                                         duration=2.5)
+        d.run(until_s=2.5)
+        assert d.settle(timeout=30), "cluster never settled after churn"
+        d.check_invariants()  # final oracle pass
+        m = c.service.scheduler.metrics()
+        assert m["pods_bound"] > 0
+        assert m["degradation_state"] == "resident"
+        assert sum(v for k, v in m.items()
+                   if k.startswith("fault_fires_")) == 0
+        assert budget.high_water <= 2
+    finally:
+        c.shutdown()
+
+
+class _BatchJob:
+    """Test generator: a fixed burst of finite-lifetime pods (a job) —
+    creates them, waits, then deletes them (work finished), so the
+    autoscaler sees pressure followed by genuine idleness."""
+
+    name = "batchjob"
+
+    def __init__(self, n=12, cpu=600, hold_s=2.0, prefix="asq"):
+        self.n, self.cpu, self.hold, self.prefix = n, cpu, hold_s, prefix
+
+    def run(self, env):
+        for i in range(self.n):
+            env.view.create_pod(f"{self.prefix}-{i}", cpu=self.cpu)
+            yield 0.01
+        yield self.hold
+        for p in sorted(env.view.store.list("Pod"), key=lambda p: p.key):
+            if p.metadata.name.startswith(self.prefix):
+                env.view.delete_pod(p.key)
+        yield 0.01
+
+
+def test_autoscaler_grows_under_pressure_and_drains_idle():
+    """Solo autoscaler: a finite job's pressure grows the pool; once the
+    job finishes, idleness drains empty nodes back toward min via the
+    full cordon→grace→delete sequence."""
+    c = live_cluster()
+    try:
+        d = LifecycleDriver(c, seed=SEED, pace=1.0, settle_s=8.0)
+        # 12 pods x 600 cpu need 4 nodes of 2000; min pool is 1 node
+        d.add(_BatchJob(n=12, cpu=600, hold_s=2.0))
+        d.add(AutoscalerLoop("autoscaler", pool="as", interval_s=0.15,
+                             min_nodes=1, max_nodes=8, scale_up_pending=2,
+                             idle_rounds=2, cpu=2000, drain_grace_s=0.15,
+                             rounds=45))
+        d.install_default_invariants()
+        d.run()
+        assert d.view.counters.get("autoscaler_scale_ups", 0) >= 1, \
+            "pressure never triggered a scale-up"
+        assert d.view.counters.get("autoscaler_scale_downs", 0) >= 1, \
+            "idleness never triggered a drain"
+        assert d.settle(timeout=30)
+        d.check_invariants()
+    finally:
+        c.shutdown()
+
+
+def test_reclamation_wave_evicts_and_replaces():
+    """Bound pods on reclaimed nodes are evicted and recreated (spot
+    restart semantics); no pod silently lost, no pod left bound to a
+    dead incarnation, replacement capacity appears."""
+    c = live_cluster()
+    try:
+        d = LifecycleDriver(c, seed=SEED, pace=1.0, settle_s=8.0)
+        for _ in range(6):
+            d.view.create_pool_node("spot", cpu=2000)
+        d.add(PoissonArrivals("load", rate_pps=60, duration_s=0.8,
+                              cpu=300, prefix="rw"))
+        d.add(ReclamationWave("reclaim", pool="spot", interval_s=1.0,
+                              wave_frac=0.4, grace_s=0.3, waves=2))
+        d.install_default_invariants()
+        d.run()
+        assert d.view.counters.get("nodes_reclaimed", 0) >= 2
+        assert d.settle(timeout=30)
+        d.check_invariants()
+        # replacements kept the pool at strength
+        assert len(d.view.pool_nodes("spot")) == 6
+    finally:
+        c.shutdown()
+
+
+# ---- facade verbs through the informer-observed path ---------------------
+
+
+def test_cordon_blocks_then_uncordon_revives():
+    c = live_cluster()
+    try:
+        c.create_node("only", cpu=1000)
+        c.create_pod("cp-wait", cpu=100)
+        c.wait_for_pod_bound("cp-wait", timeout=30)
+        c.cordon("only")
+        c.create_pod("cp-blocked", cpu=100)
+        pod = c.wait_for_pod_pending("cp-blocked", timeout=15)
+        assert "NodeUnschedulable" in pod.status.unschedulable_plugins
+        c.uncordon("only")  # widening update → event-filtered revival
+        c.wait_for_pod_bound("cp-blocked", timeout=15)
+    finally:
+        c.shutdown()
+
+
+def test_drain_evicts_bound_pods():
+    c = live_cluster()
+    try:
+        c.create_node("dr-n", cpu=1000)
+        for i in range(3):
+            c.create_pod(f"dr-p{i}", cpu=100)
+            c.wait_for_pod_bound(f"dr-p{i}", timeout=30)
+        evicted = c.drain("dr-n")
+        assert sorted(p.metadata.name for p in evicted) == [
+            "dr-p0", "dr-p1", "dr-p2"]
+        assert c.get_node("dr-n").spec.unschedulable
+        assert not c.list_pods()
+    finally:
+        c.shutdown()
+
+
+def test_update_node_allocatable_growth_revives_capacity_parked_pod():
+    c = live_cluster()
+    try:
+        c.create_node("small", cpu=100)
+        c.create_pod("big", cpu=500)
+        c.wait_for_pod_pending("big", timeout=15)
+        c.update_node("small", allocatable={"cpu": 1000.0})
+        c.wait_for_pod_bound("big", timeout=15)
+    finally:
+        c.shutdown()
+
+
+def test_narrowing_update_does_not_thrash_unschedulable_queue():
+    """A cordon on an unrelated node is a purely narrowing update: the
+    parked pod must NOT be revived (no backoff/active transition), and
+    the engine's requeue fan-out must not even scan for it."""
+    c = live_cluster()
+    try:
+        c.create_node("full", cpu=100)
+        c.create_node("other", cpu=100)
+        c.create_pod("stuck", cpu=5000)  # fits nowhere
+        c.wait_for_pod_pending("stuck", timeout=15)
+        q = c.service.scheduler.queue
+        # let the attempt park terminally
+        deadline = time.monotonic() + 10
+        while q.stats()["unschedulable"] != 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert q.stats()["unschedulable"] == 1
+        moves_before = q.stats()["moves"]
+        c.cordon("other")  # narrowing: suppressed before the queue
+        time.sleep(0.3)    # let the informer drain
+        st = q.stats()
+        assert st["unschedulable"] == 1, "narrowing update revived a pod"
+        assert st["moves"] == moves_before, \
+            "narrowing update reached the requeue fan-out"
+        # sanity: a WIDENING update still revives
+        c.update_node("other", allocatable={"cpu": 50000.0},
+                      unschedulable=False)
+        c.wait_for_pod_bound("stuck", timeout=15)
+    finally:
+        c.shutdown()
+
+
+# ---- faulted churn: compose both registries ------------------------------
+
+
+AMBIENT = ("step:err@2,step:err@0.05,fetch:corrupt@0.03,"
+           "residency:corrupt@0.03,commit:err@0.05,bind:err@0.03,"
+           "lifecycle:err@0.05")
+
+
+def test_faulted_churn_recovers_with_zero_violations():
+    """MINISCHED_FAULTS composed with the lifecycle registry: the
+    deterministic step:err@2 guarantees ≥1 escalation; the run must
+    hold every invariant, settle after faults stop, and climb back to
+    the full fast path under a probation pump."""
+    c = live_cluster(resident_check_every=1, probation_batches=2)
+    sched = c.service.scheduler
+    try:
+        d, _budget = make_composed_driver(c, SEED, pace=1.0, settle_s=8.0,
+                                          duration=2.0)
+        faults.FAULTS.reset_counts()
+        faults.configure(AMBIENT,
+                         int(os.environ.get("MINISCHED_FAULT_SEED", "0")))
+        d.run(until_s=2.0)
+        fired = sum(faults.FAULTS.counts().values())
+        faults.configure("")  # faults stop WITH the churn
+        assert fired >= 1, "ambient schedule never fired"
+        assert d.settle(timeout=45), "faulted churn never settled"
+        d.check_invariants()
+        m = sched.metrics()
+        assert m["supervisor_escalations"] >= 1, \
+            "the ladder was never exercised"
+        # probation pump: clean batches climb the engine back to resident
+        deadline = time.monotonic() + 30
+        i = 0
+        while (sched.metrics()["degradation_state"] != "resident"
+               and time.monotonic() < deadline):
+            for j in range(6):
+                d.view.create_pod(f"pump-{i}-{j}", cpu=10)
+            i += 1
+            d.settle(timeout=10)
+        assert sched.metrics()["degradation_state"] == "resident", \
+            "engine never recovered to the full fast path"
+        d.check_invariants()
+    finally:
+        faults.configure("")
+        c.shutdown()
+
+
+def test_lifecycle_fault_gate_skips_and_retries_steps():
+    """The lifecycle gate in pure mode: err skips the step (counted)
+    but the generator still completes — nothing is lost, the stream
+    just shifts by the retry delays."""
+    c = pure_cluster()
+    d = LifecycleDriver(c, seed=SEED)
+    d.add(PoissonArrivals("arrivals", rate_pps=50, duration_s=1.0,
+                          prefix="fg"))
+    faults.configure("lifecycle:err@3,lifecycle:err@7")
+    try:
+        d.run()
+    finally:
+        faults.configure("")
+    assert d.faulted_steps == 2
+    assert d.view.counters.get("pods_created", 0) > 10
+
+
+# ---- adversarial PDB overlap ---------------------------------------------
+
+
+def test_pdb_never_violated_under_adversarial_upgrade_reclaim_overlap():
+    """Upgrade and reclamation race for the SAME pool under one
+    max-unavailable=2 budget, with intervals tuned to collide. The
+    disruption-budget invariant (re-derived from the store after every
+    event) must never fire, and the budget must actually have been
+    contended — otherwise the test proves nothing."""
+    c = pure_cluster()
+    d = LifecycleDriver(c, seed=SEED)
+    budget = d.budget("base", max_unavailable=2)
+    for _ in range(10):
+        d.view.create_pool_node("base", cpu=2000)
+    d.add(ReclamationWave("reclaim", pool="base", interval_s=0.2,
+                          wave_frac=0.5, grace_s=0.3, waves=6,
+                          budget=budget))
+    d.add(RollingUpgrade("upgrade", pool="base", budget=budget,
+                         grace_s=0.3, retry_s=0.05))
+    d.install_default_invariants()
+    d.run(until_s=30.0)
+    assert budget.denials > 0, \
+        "no contention: the adversarial overlap never happened"
+    assert budget.high_water <= 2
+    assert d.view.counters.get("nodes_reclaimed", 0) >= 1
+    assert d.view.counters.get("nodes_upgraded", 0) >= 1
+    d.check_invariants()
+
+
+def test_budget_invariant_detects_violation():
+    """The oracle itself is live: cordon past the budget OUTSIDE the
+    acquire discipline and the invariant must raise."""
+    c = pure_cluster()
+    d = LifecycleDriver(c, seed=SEED)
+    d.budget("base", max_unavailable=1)
+    for _ in range(3):
+        d.view.create_pool_node("base", cpu=1000)
+    d.install_default_invariants()
+    for n in d.view.pool_nodes("base")[:2]:
+        d.view.cordon(n)  # two cordons, budget allows one
+    with pytest.raises(InvariantViolation, match="disruption_budget"):
+        d.check_invariants()
+
+
+def test_no_pod_lost_invariant_detects_silent_loss():
+    c = pure_cluster()
+    d = LifecycleDriver(c, seed=SEED)
+    d.install_default_invariants()
+    d.view.create_pod("will-vanish")
+    d.check_invariants()
+    # bypass the view (no ledger update): a silent loss
+    c.store.delete("Pod", "default/will-vanish")
+    with pytest.raises(InvariantViolation, match="no_pod_lost"):
+        d.check_invariants()

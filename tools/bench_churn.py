@@ -1,0 +1,103 @@
+"""p99-under-churn bench: the cluster-lifecycle scenario engine driving
+the real engine, interleaved clean/faulted rounds (the BENCH_TRACE
+drift-cancelling discipline), proving the acceptance claims:
+
+  * clean rounds run UNDEGRADED end-to-end: ``degradation_state=
+    resident``, zero fault fires, zero invariant violations — the p99
+    numbers describe the fast path under production-shaped churn, not a
+    degraded engine;
+  * faulted rounds (an ambient fault rate at every engine seam plus one
+    deterministic ``step:err`` so a round can never vacuously pass)
+    exercise the supervisor ladder — ``escalations > 0`` — and recover:
+    after the churn drains, a probation pump must return the engine to
+    ``resident``;
+  * EVERY round holds every lifecycle invariant (no pod silently lost,
+    bound pods only on live nodes, disruption budget never exceeded,
+    monotone version counters, no overcommit) after every event — the
+    soak doubles as a correctness oracle.
+
+Latency keys (``churn_hist_p50/_p95/_p99_s``) come from the engine's
+always-on create→bound histogram over every bound pod.
+
+Tools of record commit the output as BENCH_CHURN.json:
+
+    JAX_PLATFORMS=cpu python tools/bench_churn.py [> BENCH_CHURN.json]
+
+MINISCHED_LIFECYCLE_SEED / _RATE / _AMPLITUDE shape the workload;
+MINISCHED_BENCH_ROUNDS overrides the per-mode round count.
+"""
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: Ambient schedule for the faulted rounds: low rates at the seams churn
+#: exercises (the chaos-soak shape) plus one deterministic step fault so
+#: escalations can never be vacuously zero, plus the lifecycle gate so
+#: the scenario driver itself absorbs orchestrator-tick faults.
+FAULTED_SPEC = ("step:err@2,step:err@0.03,fetch:corrupt@0.02,"
+                "residency:corrupt@0.02,commit:err@0.05,bind:err@0.03,"
+                "informer:stall@10msx0.05,lifecycle:err@0.03")
+
+MODES = (("clean", ""), ("faulted", FAULTED_SPEC))
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import bench
+    from minisched_tpu.lifecycle import seed_from_env
+
+    rounds = int(os.environ.get("MINISCHED_BENCH_ROUNDS", "2"))
+    duration = float(os.environ.get("MINISCHED_LIFECYCLE_DURATION", "6"))
+    doc = {"platform": "cpu", "seed": seed_from_env(),
+           "duration_s": duration, "rounds": rounds,
+           "faulted_spec": FAULTED_SPEC,
+           "methodology":
+               "interleaved clean/faulted lifecycle-churn rounds through "
+               "bench.churn_bench (diurnal arrivals + tenant mix + "
+               "autoscaler + reclamation waves + rolling upgrade sharing "
+               "one max-unavailable budget); every lifecycle invariant "
+               "checked after every event; latency keys are histogram-"
+               "derived over every bound pod; per-mode scalar keys are "
+               "from the round with the most pods bound",
+           "modes": {}}
+    # Warmup round (discarded): eats the engine's pad-bucket XLA
+    # compiles, which otherwise land inside round 1's create→bound
+    # histogram and pollute the published p99 with compile stalls.
+    bench.churn_bench(seed=seed_from_env(), duration_s=min(2.0, duration))
+    runs = {label: [] for label, _ in MODES}
+    for r in range(rounds):
+        for label, spec in MODES:  # interleaved: clean, faulted, ...
+            runs[label].append(bench.churn_bench(
+                seed=seed_from_env() + r, faults_spec=spec,
+                duration_s=duration))
+    for label, _spec in MODES:
+        best = max(runs[label], key=lambda m: m.get("churn_pods_bound", 0))
+        best["churn_rounds"] = len(runs[label])
+        best["churn_pods_bound_per_round"] = [
+            m.get("churn_pods_bound", 0) for m in runs[label]]
+        best["churn_escalations_per_round"] = [
+            m.get("churn_escalations", 0) for m in runs[label]]
+        doc["modes"][label] = best
+
+    clean_rounds, faulted_rounds = runs["clean"], runs["faulted"]
+    doc["clean_undegraded"] = all(
+        m.get("churn_degradation_state") == "resident"
+        and m.get("churn_fault_fires", 1) == 0 for m in clean_rounds)
+    doc["faulted_exercised_ladder"] = all(
+        m.get("churn_escalations", 0) > 0 for m in faulted_rounds)
+    doc["faulted_recovered_to_resident"] = all(
+        m.get("churn_degradation_state") == "resident"
+        for m in faulted_rounds)
+    doc["zero_invariant_violations"] = all(
+        m.get("churn_violations", 1) == 0
+        for rs in runs.values() for m in rs)
+    doc["all_settled"] = all(
+        m.get("churn_settled") for rs in runs.values() for m in rs)
+    print(json.dumps(doc))
+
+
+if __name__ == "__main__":
+    main()
