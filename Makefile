@@ -12,11 +12,12 @@ CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 # tier1 uses pipefail/PIPESTATUS (bash-isms).
 SHELL := /bin/bash
 
-.PHONY: test tier1 fault-smoke shortlist-smoke trace-smoke churn-smoke \
-        profile-smoke start start-remote start-client-engine demo docs \
-        bench bench_sharded bench-cpu bench-pipeline bench-residency \
-        bench-shortlist bench-trace bench-churn dryrun dryrun-dcn soak \
-        soak-faults soak-churn
+.PHONY: test tier1 fault-smoke shortlist-smoke trace-smoke slo-smoke \
+        churn-smoke profile-smoke start start-remote start-client-engine \
+        demo docs bench bench_sharded bench-cpu bench-pipeline \
+        bench-residency bench-shortlist bench-trace bench-slo \
+        bench-churn bench-check dryrun dryrun-dcn soak soak-faults \
+        soak-churn
 
 # Unit + integration suite on a virtual 8-device CPU mesh.
 test:
@@ -42,6 +43,18 @@ trace-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs.py -x -q \
 	  -p no:cacheprovider -p no:randomly
 
+# Fast deterministic temporal-telemetry suite (~60 s): timeline ring
+# cadence/wrap, histogram-delta quantiles, decisions bit-identical
+# armed-vs-unarmed per engine mode, SLO burn-window logic + the
+# faulted-churn early-warning chain (alert before quarantine, counted
+# supervisor reaction), the /timeline endpoint, the resultstore
+# retention bound, and the bench_compare regression gate. A tier-1
+# prerequisite alongside trace-smoke: the layer that DECIDES whether
+# the engine regressed must itself be pinned.
+slo-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_timeline.py -x -q \
+	  -p no:cacheprovider -p no:randomly
+
 # Fast deterministic lifecycle suite (~60 s): seed determinism
 # (byte-identical event stream + canonical final state), per-generator
 # invariants on clean live runs, the cordon/drain facade verbs,
@@ -58,7 +71,7 @@ churn-smoke:
 # exactness contract gates the rest of the suite; trace-smoke next: the
 # measurement layer must not perturb decisions; churn-smoke last: the
 # lifecycle oracle rides on both.
-tier1: shortlist-smoke trace-smoke churn-smoke
+tier1: shortlist-smoke trace-smoke slo-smoke churn-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -161,6 +174,24 @@ bench-shortlist:
 # bound decision.
 bench-trace:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_trace.py
+
+# Temporal-telemetry contract bench at CPU shapes, interleaved off/on
+# rounds (the committed BENCH_SLO.json): timeline+sentinel overhead
+# ≤5% on the create→bound window at the worst-case every-batch
+# cadence, zero alerts on clean rounds, and the faulted-churn round's
+# early-warning chain (burn-rate alert BEFORE quarantine, counted
+# supervisor reaction, per-generator attribution tags on the rows).
+bench-slo:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_slo.py
+
+# Cross-run perf-regression gate: capture a fresh interleaved
+# min-of-N run at the check shape (500 x 250 CPU) and diff it against
+# the newest comparable entry of the committed BENCH_LEDGER.json with
+# noise-aware per-key-class thresholds (tools/bench_compare.py).
+# Nonzero exit = regression. Bootstrap/refresh the baseline with
+# `python tools/bench_compare.py --capture --update`.
+bench-check:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_compare.py --capture
 
 # p99-under-churn bench (the committed BENCH_CHURN.json): interleaved
 # clean/faulted lifecycle-churn rounds through bench.churn_bench —

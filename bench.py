@@ -796,6 +796,136 @@ def run_child() -> None:
     emit_and_exit(0)
 
 
+# ---------------------------------------------------------------------------
+# cross-run perf ledger (BENCH_LEDGER.json): normalized key series appended
+# per run so tools/bench_compare.py can diff a fresh run against the
+# committed trajectory — the committed BENCH_*.json artifacts alone are
+# point-in-time and were never compared, so a perf regression landed
+# silently. `make bench-check` gates on it.
+# ---------------------------------------------------------------------------
+
+LEDGER_SCHEMA = 1
+
+#: The normalized, cross-run-comparable key set. Direction is derived
+#: from the name by tools/bench_compare.py: *_pods_per_sec higher is
+#: better; *_s / *_bytes lower is better.
+LEDGER_DETAIL_KEYS = (
+    "device_s", "encode_s", "commit_s",
+    "engine_pods_per_sec", "engine_sched_s",
+    "engine_hist_p50_s", "engine_hist_p95_s", "engine_hist_p99_s",
+    "engine_gap_s", "engine_step_s", "engine_encode_s",
+    "engine_commit_s", "engine_h2d_bytes", "engine_fetch_bytes",
+    "stream_pods_per_sec", "stream_hist_p99_s", "stream_gap_s",
+    "churn_pods_per_sec", "churn_hist_p50_s", "churn_hist_p95_s",
+    "churn_hist_p99_s",
+)
+
+
+def ledger_keys(detail: dict, headline_value: float = 0.0) -> dict:
+    """Extract the normalized key series from a bench detail dict —
+    only numeric, non-zero keys make the series (a skipped phase must
+    not record a fake 0 that every later run would 'regress' against)."""
+    keys = {}
+    if headline_value:
+        keys["raw_pods_per_sec"] = headline_value
+    for k in LEDGER_DETAIL_KEYS:
+        v = detail.get(k)
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v:
+            keys[k] = v
+    return keys
+
+
+def append_ledger(entry: dict, path: str) -> None:
+    """Append one run entry ({ts, platform, nodes, pods, keys}) to the
+    ledger at ``path`` (created if absent), atomically — a killed bench
+    must not leave a torn JSON that poisons every later compare."""
+    doc = {"schema": LEDGER_SCHEMA, "runs": []}
+    try:
+        with open(path, encoding="utf-8") as f:
+            loaded = json.load(f)
+        if isinstance(loaded, dict) and isinstance(loaded.get("runs"),
+                                                   list):
+            doc = loaded
+    except (OSError, json.JSONDecodeError):
+        pass
+    doc["runs"].append(entry)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def ledger_entry_from_result(parsed: dict) -> dict:
+    detail = parsed.get("detail", {}) or {}
+    return {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        # Methodology stamp: full-bench phases and the bench-check
+        # capture use different batch sizes / windows / lat_samples at
+        # the same shape — tools/bench_compare.latest_baseline matches
+        # on this so the noise thresholds only ever compare
+        # like-for-like runs.
+        "source": "bench",
+        "platform": detail.get("platform", "unknown"),
+        "nodes": detail.get("nodes", 0),
+        "pods": detail.get("pods", 0),
+        "keys": ledger_keys(detail, float(parsed.get("value", 0.0))),
+    }
+
+
+def check_phases(n_nodes: int, n_pods: int, lat_samples: int = 2) -> dict:
+    """The check-shape phase pair every cross-run comparison tool runs
+    (tools/bench_compare.py capture, tools/bench_slo.py off/on rounds):
+    one engine burst + one sustained-stream round through the real
+    product path. ONE definition — tools hand-coding the pair would
+    drift apart and silently break off/on-vs-ledger comparability."""
+    from bench_workload import BENCH_PLUGINS, make_workload
+
+    out = {}
+    mk_nodes, mk_pods = make_workload(n_nodes, n_pods)
+    out.update(engine_bench(n_nodes, n_pods, mk_nodes, mk_pods,
+                            BENCH_PLUGINS, lat_samples=lat_samples))
+    out.update(engine_bench(n_nodes, n_pods, mk_nodes, mk_pods,
+                            BENCH_PLUGINS,
+                            batch_size=max(64, n_pods // 4),
+                            prefix="stream", window_s=0.25))
+    return out
+
+
+def maybe_append_ledger(parsed: dict) -> None:
+    """Append this run to the ledger unless disabled.
+    MINISCHED_BENCH_LEDGER: unset/default → BENCH_LEDGER.json beside
+    this file; ``0`` disables; any other value is the path.
+
+    Baseline hygiene: a run with injected faults armed, fault fires
+    recorded, or a degraded engine state is NOT a baseline — appending
+    it would make it the newest same-shape entry bench_compare diffs
+    against, and the gate would then bless exactly the regression it
+    exists to catch. Such runs are skipped (the fault counters in the
+    bench JSON itself still record that the run was faulted)."""
+    target = os.environ.get("MINISCHED_BENCH_LEDGER", "BENCH_LEDGER.json")
+    if not target or target == "0":
+        return
+    if os.environ.get("MINISCHED_FAULTS"):
+        return  # fault-armed runs are never baselines
+    detail = parsed.get("detail", {}) or {}
+    for prefix in ("engine", "stream", "churn"):
+        if detail.get(f"{prefix}_fault_fires"):
+            return
+        state = detail.get(f"{prefix}_degradation_state")
+        if state not in (None, "resident"):
+            return
+    if not os.path.isabs(target):
+        target = os.path.join(REPO, target)
+    entry = ledger_entry_from_result(parsed)
+    if not entry["keys"]:
+        return  # a dead run records nothing
+    try:
+        append_ledger(entry, target)
+    except Exception as e:  # the ledger must never fail the bench
+        print(f"ledger append failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+
 _HBM_PEAK_GBPS = {
     # chip generation → HBM bandwidth (GB/s); conservative public numbers
     "v4": 1228.0, "v5 lite": 819.0, "v5e": 819.0, "v5p": 2765.0,
@@ -1182,13 +1312,23 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                     int(m.get("supervisor_escalations", 0)),
                 f"{prefix}_quarantined":
                     int(m.get("quarantined_batches", 0)),
+                # Temporal telemetry (obs/timeseries + obs/slo): ring
+                # rows taken, burn-rate alerts fired, and the
+                # supervisor's counted early-warning reactions — all 0
+                # with MINISCHED_TIMELINE unset (the overhead artifact
+                # BENCH_SLO.json interleaves on/off on these).
+                f"{prefix}_timeline_snapshots":
+                    int(m.get("timeline_snapshots", 0)),
+                f"{prefix}_slo_alerts": int(m.get("slo_alerts_total", 0)),
+                f"{prefix}_early_warnings":
+                    int(m.get("supervisor_early_warnings", 0)),
             }
     return out
 
 
 def churn_bench(n_base_nodes=16, duration_s=6.0, seed=None, prefix="churn",
                 faults_spec="", max_unavailable=2, settle_timeout_s=60.0,
-                probation=2) -> dict:
+                probation=2, recovery_deadline_s=30.0) -> dict:
     """p99-under-churn phase: drive the REAL engine with the
     cluster-lifecycle scenario subsystem (minisched_tpu/lifecycle) —
     diurnal arrivals + a priority tenant mix over an autoscaling pool
@@ -1276,9 +1416,13 @@ def churn_bench(n_base_nodes=16, duration_s=6.0, seed=None, prefix="churn",
         # Recovery pump: the probation ladder re-escalates only on CLEAN
         # batches, and a drained queue produces none — feed small bursts
         # until the engine climbs back to the full fast path.
+        # ``recovery_deadline_s`` needs headroom when an SLO sentinel
+        # is armed: the probation gate refuses to climb while the burn
+        # windows still hold, so recovery = burn-clear + probation, not
+        # just probation (tools/bench_slo.py passes a longer deadline).
         pumped = 0
         if faults_spec:
-            deadline = time.time() + 30
+            deadline = time.time() + recovery_deadline_s
             while (sched.metrics()["degradation_state"] != "resident"
                    and time.time() < deadline):
                 for i in range(8):
@@ -1312,8 +1456,32 @@ def churn_bench(n_base_nodes=16, duration_s=6.0, seed=None, prefix="churn",
             f"{prefix}_budget_denials": budget.denials,
             f"{prefix}_budget_high_water": budget.high_water,
             f"{prefix}_recovery_pumps": pumped,
+            # Temporal telemetry: snapshot rows, burn-rate alerts, and
+            # early-warning reactions (all 0 with MINISCHED_TIMELINE
+            # unset; tools/bench_slo.py arms the sentinel and proves an
+            # alert fires BEFORE the ladder reaches quarantine).
+            f"{prefix}_timeline_snapshots":
+                int(m.get("timeline_snapshots", 0)),
+            f"{prefix}_slo_alerts": int(m.get("slo_alerts_total", 0)),
+            f"{prefix}_early_warnings":
+                int(m.get("supervisor_early_warnings", 0)),
             **_hist_latency_keys(m, prefix),
         }
+        tl = sched.timeline()
+        if tl.get("alerts"):
+            first = tl["alerts"][0]
+            out[f"{prefix}_first_alert"] = {
+                "slo": first.get("slo"), "t": first.get("t"),
+                "degradation_level": first.get("degradation_level")}
+        if tl.get("entries"):
+            out[f"{prefix}_timeline_entries"] = len(tl["entries"])
+            # attribution evidence: the union of generator tags the
+            # ring attributed windows to (a reclamation wave is visible
+            # as its generator's tag on the rows where latency moved)
+            tags = sorted({t for e in tl["entries"]
+                           for t in (e.get("tags") or {})})
+            if tags:
+                out[f"{prefix}_timeline_tags"] = tags
         for k in ("pods_created", "pods_evicted", "pods_recreated",
                   "nodes_added", "nodes_deleted", "nodes_reclaimed",
                   "nodes_upgraded", "cordons", "uncordons",
@@ -1469,6 +1637,7 @@ def main() -> None:
     if parsed is not None and "error" not in parsed.get("detail", {}):
         parsed.setdefault("detail", {})["attempts"] = attempts or None
         print(json.dumps(parsed))
+        maybe_append_ledger(parsed)
         return
     attempts["ambient"] = (diag or parsed.get("detail", {}).get("error", "?"))
 
@@ -1490,6 +1659,7 @@ def main() -> None:
     if parsed is not None:
         parsed.setdefault("detail", {})["attempts"] = attempts
         print(json.dumps(parsed))
+        maybe_append_ledger(parsed)
         return
     attempts["cpu-fallback"] = diag
 

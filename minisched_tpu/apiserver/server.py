@@ -30,6 +30,13 @@ store's existing CRUD + versioned watch:
            (requires persist_path; 409 otherwise) — the etcdctl-snapshot
            analog; interval + shutdown checkpoints run automatically
     GET    /healthz
+    GET    /timeline                     → temporal-telemetry JSON:
+           per-profile snapshot rings (gauges, window counter deltas,
+           histogram-delta quantiles, attribution tags) + SLO alert
+           logs from any provider registered through
+           ``APIServer.timeline_providers`` (a co-located
+           SchedulerService appends ``timeline()``). Empty-but-valid
+           with MINISCHED_TIMELINE unset.
     GET    /metrics                      → Prometheus text exposition:
            server request/rejection counters, per-kind object counts,
            watch-log depth, plus any gauges registered through
@@ -115,6 +122,12 @@ class APIServer:
         # appends metrics_histograms here (the per-pod latency
         # histograms the engine feeds from lifecycle stamps).
         self.histogram_providers: list = []
+        # /timeline extension point: callables returning {profile name:
+        # timeline document} (Scheduler.timeline() dicts — snapshot
+        # ring + SLO alerts). A co-located SchedulerService appends
+        # ``timeline()``; the endpoint merges every provider into one
+        # JSON body. Providers must be thread-safe.
+        self.timeline_providers: list = []
         # server-side request counters for /metrics (lock-guarded)
         self._counters: dict = {}
         self._counters_lock = threading.Lock()
@@ -134,7 +147,8 @@ class APIServer:
                                 self.metrics_providers, self._counters,
                                 self._counters_lock, self.checkpointer,
                                 self._mutating_cv, self._track_mutation,
-                                self._draining, self.histogram_providers)
+                                self._draining, self.histogram_providers,
+                                self.timeline_providers)
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
@@ -189,7 +203,8 @@ def _make_handler(store: ClusterStore, token: str | None = None,
                   counters_lock: threading.Lock | None = None,
                   checkpointer=None, mutating_cv=None,
                   track_mutation=None, draining=None,
-                  histogram_providers: list | None = None):
+                  histogram_providers: list | None = None,
+                  timeline_providers: list | None = None):
     if counters is None:
         counters = {}
     if counters_lock is None:
@@ -356,6 +371,8 @@ def _make_handler(store: ClusterStore, token: str | None = None,
                 return self._send(200, {"ok": True})
             if kind == "metrics":
                 return self._guard(self._metrics)
+            if kind == "timeline":
+                return self._guard(self._timeline)
             if kind == "watch":
                 return self._guard(lambda: self._watch(q))
             if kind == "snapshot":
@@ -488,6 +505,26 @@ def _make_handler(store: ClusterStore, token: str | None = None,
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def _timeline(self):
+            """Temporal-telemetry JSON: every provider's per-profile
+            timeline documents merged into one body. A broken provider
+            must not 500 the endpoint — its profiles are skipped and
+            the error noted, same contract as the metrics providers."""
+            merged: dict = {}
+            errors = 0
+            for provider in (timeline_providers or ()):
+                try:
+                    doc = provider()
+                    if isinstance(doc, dict):
+                        merged.update(doc)
+                except Exception:
+                    errors += 1
+                    log.exception("timeline provider failed")
+            body = {"timelines": merged}
+            if errors:
+                body["provider_errors"] = errors
+            self._send(200, body)
 
         def _watch(self, q):
             """Stateless long-poll watch: each call opens a cursor at

@@ -35,6 +35,8 @@ from ..encode.features import NodeFeatures
 from ..errors import ConflictError, NotFoundError
 from ..faults import FAULTS, FaultWorkerDeath
 from ..obs import Histogram, instant, span
+from ..obs import slo as slo_mod
+from ..obs.timeseries import TIMELINE, TimelineTracker
 from ..ops.pipeline import Decision, build_step
 from ..ops.residency import (I16_SAT, apply_rows, apply_rows_bytes,
                              pack_decision_slim, unpack_decision_slim)
@@ -65,6 +67,14 @@ class EngineDesync(RuntimeError):
 #: The supervisor's degradation ladder, fastest first. Level indexes it.
 DEGRADATION_LADDER = ("resident", "upload", "sync", "quarantine")
 
+#: SLO early-warning pre-arming (obs/slo.py → _Supervisor.early_warning):
+#: a burning SLO arms the per-batch watchdog at this fallback deadline
+#: for this many batches even when MINISCHED_WATCHDOG is unset — the
+#: sentinel's trend verdict buys the ladder a tripwire BEFORE a wedged
+#: step forces the exception path.
+SLO_PREARM_BATCHES = 64
+SLO_PREARM_WATCHDOG_S = 30.0
+
 
 class _Supervisor:
     """Fault detection + containment state for one engine.
@@ -89,12 +99,16 @@ class _Supervisor:
     clean batches at a degraded level the supervisor re-escalates one
     rung back toward the full fast path."""
 
-    __slots__ = ("_sched", "level", "_clean")
+    __slots__ = ("_sched", "level", "_clean", "prearm")
 
     def __init__(self, sched: "Scheduler"):
         self._sched = sched
         self.level = 0
         self._clean = 0
+        # Batches left on the SLO early-warning posture: while > 0 the
+        # watchdog runs at SLO_PREARM_WATCHDOG_S even with the knob
+        # unset. Scheduling-thread only, like ``level``.
+        self.prearm = 0
 
     def allows_residency(self) -> bool:
         return self.level == 0
@@ -113,9 +127,43 @@ class _Supervisor:
         log.warning("supervisor: degraded to %r (%s)",
                     DEGRADATION_LADDER[self.level], reason)
 
+    def early_warning(self, reason: str) -> None:
+        """SLO sentinel input (obs/slo.py): a burning objective is
+        treated as a leading indicator of the faults the ladder exists
+        to contain. Two counted reactions, both cheap and reversible:
+        the probation counter resets (a degraded engine cannot climb
+        back toward the fast path while its SLO burns — extending
+        probation), and the per-batch watchdog is pre-armed for the
+        next SLO_PREARM_BATCHES batches even when MINISCHED_WATCHDOG is
+        unset. No rung changes here — the sentinel warns, the detectors
+        decide."""
+        self._clean = 0
+        self.prearm = SLO_PREARM_BATCHES
+        self._sched._sup_count("supervisor_early_warnings")
+        instant("supervisor.early_warning", reason=reason,
+                level=self.level)
+        log.warning("supervisor: SLO early warning (%s); probation "
+                    "extended, watchdog pre-armed for %d batches",
+                    reason, SLO_PREARM_BATCHES)
+
     def note_clean(self) -> None:
-        """One batch resolved with no fault. Probation bookkeeping."""
+        """One batch resolved with no fault. Probation bookkeeping.
+        While any SLO is burning the engine cannot climb — fault-free
+        batches during a burn don't count toward probation (the
+        'probation extension' contract early_warning announces; the
+        rising-edge alert alone would let a CONTINUOUS burn lapse after
+        one reset), and the watchdog pre-arm stays topped up."""
+        burning = self._sched._slo_burning_any()
+        if burning:
+            # Topped up BEFORE the level-0 early return: a continuous
+            # burn on a healthy engine fires exactly one rising-edge
+            # alert, and without this the pre-armed watchdog would
+            # lapse after SLO_PREARM_BATCHES while the burn persists.
+            self.prearm = SLO_PREARM_BATCHES
         if self.level == 0:
+            return
+        if burning:
+            self._clean = 0
             return
         self._clean += 1
         if self._clean >= max(1, self._sched.config.probation_batches):
@@ -1108,11 +1156,28 @@ class Scheduler:
             "shortlist_repairs": 0, "shortlist_certified": 0,
             "shortlist_checks": 0, "shortlist_desyncs": 0,
             "last_shortlist_repairs": 0,
+            # Temporal telemetry + SLO sentinel (obs/timeseries,
+            # obs/slo): burn-rate alerts fired (total + per-objective
+            # keys created on first fire) and the supervisor's counted
+            # early-warning reactions.
+            "slo_alerts_total": 0, "supervisor_early_warnings": 0,
         }
+        # Rolling time-series ring of metrics() snapshots
+        # (MINISCHED_TIMELINE; obs/timeseries.py). The tracker always
+        # exists — cheap — and tick() is gated on the process-wide
+        # enabled attribute at the one call site (_resolve_batch), so
+        # the disarmed hot-path cost is a single attribute test.
+        self._timeline = TimelineTracker(self.metrics)
+        # SLO sentinel, built lazily from the epoch-current process
+        # config at first armed tick (tests re-arm between runs).
+        self._slo_sentinel: Optional[slo_mod.SLOSentinel] = None
+        self._slo_epoch = -1
 
     def _sup_count(self, key: str, n: int = 1) -> None:
+        # get-based: per-objective SLO alert counters are created on
+        # first fire (the objective catalog is env-configurable).
         with self._metrics_lock:
-            self._metrics[key] += n
+            self._metrics[key] = self._metrics.get(key, 0) + n
 
     def _book_gap(self, component: str, dt: float) -> None:
         """Book inter-batch glue into gap_s_total, tagged with its
@@ -2104,6 +2169,58 @@ class Scheduler:
             inf.fetch1 = self._metrics["fetch_bytes_total"]
         self._watchdog_check(inf)
         self._sup.note_clean()
+        if TIMELINE.enabled:
+            self._timeline_tick()
+
+    def _timeline_tick(self) -> None:
+        """Temporal-telemetry cadence point (scheduling thread, one per
+        resolved batch, gated on TIMELINE.enabled at the call site).
+        When the cadence elapses the tracker appends a snapshot row and
+        the SLO sentinel evaluates its burn windows over the ring; a
+        rising-edge alert is counted, emitted as a trace instant,
+        appended to the /timeline alerts list, and fed to the
+        supervisor as an early warning."""
+        entry = self._timeline.tick()
+        if entry is None:
+            return
+        cfg = slo_mod.SLO
+        if not cfg.enabled:
+            return
+        if self._slo_sentinel is None or self._slo_epoch != cfg.epoch:
+            self._slo_sentinel = slo_mod.SLOSentinel.from_config(cfg)
+            self._slo_epoch = cfg.epoch
+        for alert in self._slo_sentinel.evaluate(self._timeline.entries()):
+            self._sup_count("slo_alerts_total")
+            self._sup_count(f"slo_alerts_{alert['slo']}")
+            instant("slo.burn", **{k: v for k, v in alert.items()
+                                   if isinstance(v, (int, float, str))})
+            self._timeline.note_alert(alert)
+            self._sup.early_warning(f"slo:{alert['slo']}")
+        for name in self._slo_sentinel.last_cleared:
+            instant("slo.clear", slo=name)
+
+    def _slo_burning_any(self) -> bool:
+        """Is any SYMPTOM objective of the CURRENT sentinel burning?
+        (The supervisor's probation gate; scheduling-thread reads of
+        the sentinel's own last-evaluate state.) The degraded-posture
+        objective is excluded by construction: it burns BECAUSE the
+        engine is degraded, and gating the climb on it would livelock
+        the ladder at the degraded rung forever — the gate heeds what
+        the users feel (latency, desyncs, faults, invariants), never
+        the containment posture itself."""
+        sent = self._slo_sentinel
+        if (sent is None or not slo_mod.SLO.enabled
+                or self._slo_epoch != slo_mod.SLO.epoch):
+            return False
+        return any(sent.burning.get(s.name) for s in sent.specs
+                   if s.kind != "degraded")
+
+    def timeline(self) -> Dict:
+        """The GET /timeline JSON payload for this engine: the snapshot
+        ring (gauges + window deltas + histogram-delta quantiles +
+        attribution tags) and the SLO alert log. Empty-but-valid when
+        MINISCHED_TIMELINE is unset."""
+        return self._timeline.to_doc()
 
     def _rollback_assumed(self, inf: "_InflightBatch") -> None:
         if not inf.assumed:
@@ -2127,6 +2244,13 @@ class Scheduler:
         the NEXT batches stop leaning on a path that just took 100× its
         budget (wedged tunnel, thrashing backend)."""
         wd = self.config.watchdog_s
+        if self._sup.prearm > 0:
+            # SLO early-warning posture: run with the fallback deadline
+            # (or the configured one if tighter) for the pre-armed
+            # batches, then stand down.
+            self._sup.prearm -= 1
+            wd = min(wd, SLO_PREARM_WATCHDOG_S) if wd else \
+                SLO_PREARM_WATCHDOG_S
         if not wd:
             return
         gather_gap = max(0.0, inf.t_fetch_start - inf.t_dispatch)
@@ -3542,6 +3666,34 @@ class Scheduler:
         # name for humans/tests (non-numeric — dropped from exposition).
         out["degradation_level"] = self._sup.level
         out["degradation_state"] = DEGRADATION_LADDER[self._sup.level]
+        # Temporal telemetry: snapshot/drop counts for the timeline
+        # ring and the per-objective burning gauges (1 while an SLO's
+        # burn windows are both over threshold — the sentinel clears
+        # them on recovery). Alert counters live in the metrics dict
+        # itself (slo_alerts_total + slo_alerts_<name>).
+        out["timeline_snapshots"] = self._timeline.snapshots()
+        out["timeline_dropped"] = self._timeline.dropped()
+        # Burning gauges only while the sentinel that computed them is
+        # the CURRENT one: after a disarm/reconfigure evaluate() never
+        # runs again, and exporting the retired sentinel's dict would
+        # pin a stale "burning" 1 on /metrics forever (the series
+        # disappearing on disarm is the standard exposition shape).
+        # Re-derived at the CURRENT clock (burning_now): an idle engine
+        # resolves no batches, so the batch-driven evaluate() alone
+        # would latch a stale 1 after the queue drains.
+        sent = self._slo_sentinel
+        if (sent is not None and slo_mod.SLO.enabled
+                and self._slo_epoch == slo_mod.SLO.epoch):
+            live = sent.burning_now(self._timeline.entries(),
+                                    self._timeline.now_t())
+            for name, burning in live.items():
+                out[f"slo_burning_{name}"] = int(burning)
+        # Explainability-store retention (explain/resultstore.py): live
+        # record/bitmask counts and the eviction counter the churn
+        # bound is pinned by. Only meaningful with explain mode on.
+        if self.recorder is not None:
+            for k, v in self.recorder.stats().items():
+                out[f"resultstore_{k}"] = v
         # Per-gate fault-injection fire counts (PROCESS-wide registry —
         # shared across co-located engines; with MINISCHED_FAULTS unset
         # all zeros, proving a run was fault-free).

@@ -75,13 +75,25 @@ class ResultStore:
                  async_flush: bool = False, top_k: int = 128,
                  retry_initial_s: float = 0.05, retry_steps: int = 6,
                  full_n_retain: Optional[int] = None,
-                 full_n_budget_bytes: int = 128 << 20):
+                 full_n_budget_bytes: int = 128 << 20,
+                 max_results: int = 8192):
         self._cluster = store
         self._flush = flush
         self._top_k = top_k
         self._lock = threading.Lock()
-        # pod key → (batch record, pod row)
+        # pod key → (batch record, pod row). Bounded at ``max_results``
+        # newest-recorded pods: the flush path evicts on success, but a
+        # pod whose flush exhausted its CAS retries keeps a downgraded
+        # dict entry until its next update event — and a pod that goes
+        # TERMINAL (deleted under lifecycle churn) never gets one, so
+        # sustained churn would otherwise grow the store without bound.
+        # Terminal pods are also swept eagerly: the service wires pod
+        # DELETE events to :meth:`delete_data`. Both paths count into
+        # ``evictions`` (stats()/Scheduler.metrics
+        # ``resultstore_evictions``), pinned by the churn test.
         self._results: Dict[str, tuple] = {}
+        self._max_results = max(1, int(max_results))
+        self._evictions = 0
         # pod key → (name→col, (F, ceil(N/8)) uint8 fail bit-planes,
         # fnames); FIFO-bounded by ``full_n_retain`` rows when given,
         # else by a BYTE budget (a fixed row count would silently blow up
@@ -233,6 +245,10 @@ class ResultStore:
         keys = []
         with self._lock:
             for i, pod in enumerate(pods):
+                # pop-then-insert keeps dict order = recording recency,
+                # so the retention bound below evicts the STALEST pod's
+                # record, not an arbitrary one (LRU-by-record).
+                self._results.pop(pod.key, None)
                 self._results[pod.key] = (batch, i)
                 keys.append(pod.key)
                 if packed is not None:
@@ -248,6 +264,9 @@ class ResultStore:
             if packed is not None:
                 while len(self._filter_bits) > retain:
                     self._filter_bits.pop(next(iter(self._filter_bits)))
+            while len(self._results) > self._max_results:
+                self._results.pop(next(iter(self._results)))
+                self._evictions += 1
         return keys
 
     # ---- flushing (reference addSchedulingResultToPod store.go:90-135) --
@@ -421,14 +440,34 @@ class ResultStore:
                 for f, fn in enumerate(fnames)}
 
     def delete_data(self, key: str) -> None:
-        # Only _results is purged: _queued_keys counts are owned by the
-        # enqueue/worker pairing — popping here would make the worker's
-        # later decrement steal a NEWER queued batch's count. A queued
-        # record for a deleted pod flushes as a harmless no-op
-        # (flush_pod → NotFound → evict).
+        """Terminal sweep: the pod is gone, so its recorded results can
+        never flush (NotFound) or be queried meaningfully — evict both
+        tiers now instead of waiting for the retention bound. The
+        service wires pod DELETE informer events here, so lifecycle
+        churn (evictions, reclamation waves) cannot grow the store.
+
+        Only _results/_filter_bits are purged: _queued_keys counts are
+        owned by the enqueue/worker pairing — popping here would make
+        the worker's later decrement steal a NEWER queued batch's
+        count. A queued record for a deleted pod flushes as a harmless
+        no-op (flush_pod → NotFound → evict)."""
         with self._lock:
-            self._results.pop(key, None)
-            self._filter_bits.pop(key, None)
+            evicted = self._results.pop(key, None) is not None
+            evicted = (self._filter_bits.pop(key, None)
+                       is not None) or evicted
+            if evicted:
+                self._evictions += 1
+
+    def stats(self) -> Dict[str, int]:
+        """Retention observability (surfaced as ``resultstore_*`` in
+        Scheduler.metrics): live record/bitmask counts, queued batches'
+        pending keys, and the eviction counter (retention bound +
+        terminal sweeps)."""
+        with self._lock:
+            return {"results": len(self._results),
+                    "filter_bits": len(self._filter_bits),
+                    "queued": len(self._queued_keys),
+                    "evictions": self._evictions}
 
     def pending_keys(self) -> List[str]:
         """Everything not yet flushed: ingested results AND batches still

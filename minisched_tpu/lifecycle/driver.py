@@ -58,6 +58,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..faults import FAULTS, FaultInjected
 from ..obs import instant
+from ..obs.timeseries import note_activity
 from ..state import objects as obj
 from ..errors import NotFoundError
 
@@ -424,6 +425,12 @@ class LifecycleDriver:
         self.events.append(ev)
         instant(f"lifecycle.{verb}", t=round(self.clock, 6),
                 gen=ev.gen, detail=detail)
+        # Per-generator attribution for the temporal-telemetry ring
+        # (obs/timeseries): each timeline snapshot carries the delta of
+        # these counters, so a reclamation wave is VISIBLE in the same
+        # row where p99 moved. Disarmed: one attribute test.
+        if ev.gen != "-":
+            note_activity(ev.gen)
 
     def event_lines(self) -> List[str]:
         return [e.line() for e in self.events]
@@ -514,6 +521,10 @@ class LifecycleDriver:
                     time.sleep(0.02)
                     viols = fn(self.view)
             if viols:
+                # SLO-visible before the raise unwinds the run: the
+                # sentinel's invariant_violations objective watches this
+                # tag (threshold 0 — one confirmed violation burns).
+                note_activity("invariant_violation", len(viols))
                 raise InvariantViolation(
                     f"[{name}] after step #{self.steps} "
                     f"(t={self.clock:.3f}, seed={self.seed}): "

@@ -67,6 +67,10 @@ def serve(store_only: bool = False) -> None:
         # histograms in native Prometheus histogram exposition
         api.metrics_providers.append(svc.metrics)
         api.histogram_providers.append(svc.metrics_histograms)
+        # temporal telemetry: GET /timeline serves every profile's
+        # snapshot ring + SLO alert log (empty-but-valid when
+        # MINISCHED_TIMELINE is unset)
+        api.timeline_providers.append(svc.timeline)
     print(f"LISTENING {api.address}", flush=True)
     try:
         sys.stdin.read()  # parent closes the pipe → exit

@@ -117,6 +117,17 @@ class SchedulerService:
                 out[f"{name}_{k}"] = v
         return out
 
+    def timeline(self) -> Dict[str, dict]:
+        """Per-profile temporal-telemetry documents (the ``GET
+        /timeline`` payload): profile name → ``Scheduler.timeline()``
+        dict (snapshot ring + SLO alert log). Always keyed by profile
+        name — the timeline is a diagnostic surface, and an explicit
+        key survives a later second profile without renaming (unlike
+        metrics(), whose unprefixed single-profile names are a pinned
+        scrape contract)."""
+        return {name: engine.timeline()
+                for name, engine in self.schedulers.items()}
+
     def start_scheduler(self, profile: ProfileSpec = None,
                         config: Optional[SchedulerConfig] = None) -> Scheduler:
         if self._scheds:
@@ -180,7 +191,13 @@ class SchedulerService:
                     # the whole run, not one per pod on the dispatch
                     # thread
                     on_update_many=lambda pairs: recorder.on_pod_events(
-                        [new.key for _old, new in pairs])))
+                        [new.key for _old, new in pairs]),
+                    # terminal sweep: a deleted pod's recorded results
+                    # can never flush or be queried — evict both tiers
+                    # so lifecycle churn cannot grow the store
+                    # (resultstore retention bound; counted in
+                    # resultstore_evictions)
+                    on_delete=lambda pod: recorder.delete_data(pod.key)))
         for p, plugin_set in built:
             # In multi-profile mode each engine only takes pods naming its
             # profile; a single profile keeps the accept-everything legacy

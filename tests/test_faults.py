@@ -157,6 +157,11 @@ def test_supervisor_ladder_and_probation_unit():
         def _sup_count(self, k, n=1):
             self.counts[k] = self.counts.get(k, 0) + n
 
+        def _slo_burning_any(self):
+            # no SLO sentinel in this unit harness (the burning gate
+            # has its own suite in tests/test_timeline.py)
+            return False
+
     fake = _FakeSched()
     sup = _Supervisor(fake)
     assert DEGRADATION_LADDER[sup.level] == "resident"

@@ -335,6 +335,140 @@ def test_apiserver_typed_exposition_with_histograms():
     assert len(type_lines) == len(set(type_lines))
 
 
+def _parse_prometheus_strict(text: str):
+    """Strict text-format (0.0.4) pass — the checks a picky scraper
+    applies before accepting a body: every sample line belongs to a
+    family announced by exactly one # HELP and one # TYPE line (with a
+    known type and non-empty help text), every value parses as a
+    float, and every histogram's buckets are strictly-le-ordered,
+    CUMULATIVE-monotone, end at +Inf, and agree with _count. Returns
+    (types, samples) for content assertions."""
+    import re as _re
+
+    helps, types = {}, {}
+    samples = []
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# HELP "):
+            parts = ln.split(" ", 3)
+            assert len(parts) == 4 and parts[3].strip(), ln
+            assert parts[2] not in helps, f"duplicate HELP {parts[2]}"
+            helps[parts[2]] = parts[3]
+        elif ln.startswith("# TYPE "):
+            parts = ln.split(" ")
+            assert len(parts) == 4, ln
+            name, mtype = parts[2], parts[3]
+            assert mtype in ("counter", "gauge", "histogram",
+                             "summary", "untyped"), ln
+            assert name not in types, f"duplicate TYPE {name}"
+            assert name in helps, f"TYPE before HELP for {name}"
+            types[name] = mtype
+        elif ln.startswith("#"):
+            continue
+        else:
+            m = _re.match(
+                r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s(\S+)$', ln)
+            assert m, f"unparseable sample line: {ln!r}"
+            name, labels, val = m.group(1), m.group(2) or "", m.group(3)
+            samples.append((name, labels, float(val)))
+    hist: dict = {}
+    for name, labels, val in samples:
+        fam = name
+        if name not in types:
+            for suf in ("_bucket", "_sum", "_count"):
+                if name.endswith(suf) and name[:-len(suf)] in types:
+                    fam = name[:-len(suf)]
+                    break
+        assert fam in types, f"sample {name} has no HELP/TYPE family"
+        if types[fam] == "histogram":
+            h = hist.setdefault(fam, {"buckets": [], "count": None,
+                                      "sum": None})
+            if name.endswith("_bucket"):
+                m = _re.search(r'le="([^"]+)"', labels)
+                assert m, f"bucket without le label: {labels}"
+                le = (float("inf") if m.group(1) == "+Inf"
+                      else float(m.group(1)))
+                h["buckets"].append((le, val))
+            elif name.endswith("_count"):
+                h["count"] = val
+            elif name.endswith("_sum"):
+                h["sum"] = val
+            else:
+                raise AssertionError(
+                    f"bare sample {name} under histogram family {fam}")
+    for fam, h in hist.items():
+        assert h["buckets"], f"histogram {fam} has no buckets"
+        les = [le for le, _ in h["buckets"]]
+        assert les == sorted(les) and len(set(les)) == len(les), (
+            f"{fam}: le labels not strictly increasing")
+        assert les[-1] == float("inf"), f"{fam}: missing +Inf bucket"
+        cums = [c for _, c in h["buckets"]]
+        assert cums == sorted(cums), (
+            f"{fam}: bucket counts not cumulative-monotone")
+        assert h["count"] is not None and cums[-1] == h["count"], (
+            f"{fam}: +Inf bucket != _count")
+        assert h["sum"] is not None, f"{fam}: missing _sum"
+    return types, samples
+
+
+def test_metrics_strict_parse_under_concurrent_scrape_burst():
+    """The FULL /metrics output of a live engine (store gauges, fault
+    counters, engine provider, native histograms) must survive a
+    strict format pass — HELP/TYPE on every series, histogram bucket
+    monotonicity — on every response of a concurrent scrape burst (a
+    Prometheus fleet scrapes without coordinating; a torn or
+    interleaved body would poison the fleet's view)."""
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    from minisched_tpu.apiserver import APIServer
+    from minisched_tpu.service.service import SchedulerService
+    from minisched_tpu.state.store import ClusterStore
+
+    store = ClusterStore()
+    svc = SchedulerService(store)
+    svc.start_scheduler(
+        Profile(name="default-scheduler", plugins=list(PLUGINS)),
+        _config())
+    api = APIServer(store)
+    api.metrics_providers.append(svc.metrics)
+    api.histogram_providers.append(svc.metrics_histograms)
+    api.start()
+    try:
+        for i, cpu in enumerate((64000, 48000)):
+            store.create(obj.Node(
+                metadata=obj.ObjectMeta(name=f"n{i}"),
+                status=obj.NodeStatus(allocatable={"cpu": cpu})))
+        store.create_many(_pods(8))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if svc.metrics().get("pods_bound", 0) >= 8:
+                break
+            time.sleep(0.05)
+
+        def scrape(_i):
+            body = urllib.request.urlopen(
+                f"{api.address}/metrics", timeout=10).read().decode()
+            return _parse_prometheus_strict(body)
+
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            results = list(pool.map(scrape, range(32)))
+        for types, samples in results:
+            names = {n for n, _l, _v in samples}
+            # the whole surface is present on every response
+            assert "minisched_engine_pods_bound" in names
+            assert "minisched_store_resource_version" in names
+            assert "minisched_fault_fires_total" in names
+            assert types.get("minisched_engine_pod_create_to_bound_s") \
+                == "histogram"
+            assert any(n.startswith("minisched_apiserver_")
+                       for n in names)
+    finally:
+        api.shutdown()
+        svc.shutdown_scheduler()
+
+
 def test_service_histogram_provider_surface():
     """SchedulerService.metrics() stays Dict[str, float] (pinned
     contract) while metrics_histograms() carries the snapshots."""

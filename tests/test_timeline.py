@@ -1,0 +1,877 @@
+"""Temporal-telemetry suite (obs/timeseries + obs/slo + the ledger).
+
+The acceptance bar this file pins: with ``MINISCHED_TIMELINE`` unset
+the timeline is a no-op (decisions bit-identical armed-vs-unarmed
+across the pipelined/resident/shortlist/sync engine modes; the hot
+path pays one attribute test); armed, the ring snapshots at the
+configured cadence with histogram-DELTA quantiles and per-generator
+attribution tags, wraps at capacity keeping the newest rows, and the
+SLO sentinel's multi-window burn-rate logic fires a counted,
+trace-visible, /timeline-visible alert BEFORE the degradation ladder
+reaches quarantine in a faulted churn run — with the supervisor's
+early-warning reaction counted. The cross-run ledger gate
+(tools/bench_compare.py) flags a synthetically degraded run and passes
+a clean self-compare; the resultstore retention bound holds under
+churn; tools/trace_view.py exits non-zero on schema violations and
+zero on an empty/unarmed trace.
+"""
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from minisched_tpu import faults, obs
+from minisched_tpu.config import SchedulerConfig
+from minisched_tpu.obs import slo, timeseries
+from minisched_tpu.scenario import Cluster
+from minisched_tpu.service.defaultconfig import Profile
+from minisched_tpu.state import objects as obj
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+sys.path.insert(0, REPO)
+import bench_compare  # noqa: E402
+import trace_view  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def disarm():
+    """Every test starts and leaves with timeline, sentinel, tracer,
+    and fault registry disarmed — armed state leaking across tests
+    would slow (and noise) the rest of the tier-1 run."""
+    timeseries.configure(False)
+    slo.configure("")
+    obs.configure(False)
+    faults.configure("")
+    yield
+    timeseries.configure(False)
+    slo.configure("")
+    obs.configure(False)
+    faults.configure("")
+
+
+# ---- timeseries units -----------------------------------------------------
+
+
+def test_parse_every_grammar():
+    assert timeseries.parse_every("8") == (8, None)
+    assert timeseries.parse_every("2s") == (None, 2.0)
+    assert timeseries.parse_every("500ms") == (None, 0.5)
+    with pytest.raises(ValueError):
+        timeseries.parse_every("0")
+    with pytest.raises(ValueError):
+        timeseries.parse_every("junk")
+
+
+def test_disarmed_is_noop():
+    assert not timeseries.TIMELINE.enabled
+    timeseries.note_activity("x")  # single attribute test, records nothing
+    assert timeseries.TIMELINE.activity() == {}
+    tr = timeseries.TimelineTracker(lambda: {})
+    assert tr.entries() == [] and tr.alerts() == []
+    assert tr.to_doc()["enabled"] is False
+
+
+def _fake_metrics(state):
+    """metrics()-shaped dict factory a unit tracker can snapshot."""
+    def fn():
+        return {
+            "batches": state["batches"], "pods_bound": state["bound"],
+            "pods_failed": 0, "degradation_level": state.get("level", 0),
+            "batch_faults": state.get("faults", 0),
+            "residency_desyncs": 0, "shortlist_desyncs": 0,
+            "histograms": {
+                "pod_create_to_bound_s": {
+                    "bounds": [0.1, 1.0], "counts": list(state["counts"]),
+                    "sum": 0.0, "count": sum(state["counts"])},
+            },
+        }
+    return fn
+
+
+def test_tracker_cadence_wrap_and_histogram_deltas():
+    timeseries.configure(True, every="2", capacity=4)
+    state = {"batches": 0, "bound": 0, "counts": [0, 0, 0]}
+    tr = timeseries.TimelineTracker(_fake_metrics(state))
+    assert tr.tick() is None  # first armed tick primes the baselines
+    # batch cadence: every second tick after priming snapshots
+    entries = []
+    for i in range(1, 13):
+        state["batches"] = i
+        state["bound"] = 3 * i
+        state["counts"] = [i, i // 2, 0]  # window deltas stay positive
+        e = tr.tick()
+        if e is not None:
+            entries.append(e)
+    assert len(entries) == 6
+    assert tr.snapshots() == 6
+    # capacity 4: the ring wrapped keeping the newest
+    kept = tr.entries()
+    assert len(kept) == 4 and tr.dropped() == 2
+    assert [e["batches"] for e in kept] == sorted(
+        e["batches"] for e in kept)
+    assert kept[-1]["batches"] == 12
+    # counter deltas cover exactly the window (3 bound per batch x 2)
+    assert kept[-1]["d_pods_bound"] == pytest.approx(6.0)
+    # histogram-DELTA quantile: each window added 2 obs in bucket 0 and
+    # 1 in bucket 1 → window p50 interpolates inside the first bucket
+    assert kept[-1]["window_bound"] == 3
+    assert 0.0 < kept[-1]["create_bound_p50_s"] <= 0.1
+
+
+def test_wall_clock_cadence_and_reconfigure_epoch():
+    timeseries.configure(True, every="50ms", capacity=8)
+    state = {"batches": 0, "bound": 0, "counts": [0, 0, 0]}
+    tr = timeseries.TimelineTracker(_fake_metrics(state))
+    assert tr.tick() is None  # prime
+    assert tr.tick() is None  # within the window
+    time.sleep(0.06)
+    assert tr.tick() is not None
+    # reconfigure bumps the epoch: the tracker resets instead of
+    # splicing two configurations' windows
+    timeseries.configure(True, every="1", capacity=8)
+    assert tr.tick() is None  # re-prime under the new epoch
+    assert tr.entries() == []
+    assert tr.tick() is not None
+
+
+def test_attribution_tags_delta_per_snapshot():
+    timeseries.configure(True, every="1", capacity=8)
+    state = {"batches": 0, "bound": 0, "counts": [0, 0, 0]}
+    tr = timeseries.TimelineTracker(_fake_metrics(state))
+    tr.tick()  # prime
+    timeseries.note_activity("reclaim", 3)
+    e1 = tr.tick()
+    assert e1["tags"] == {"reclaim": 3}
+    e2 = tr.tick()  # no new activity → no tags key
+    assert "tags" not in e2
+    timeseries.note_activity("upgrade")
+    e3 = tr.tick()
+    assert e3["tags"] == {"upgrade": 1}
+
+
+# ---- SLO sentinel units ---------------------------------------------------
+
+
+def test_slo_spec_grammar():
+    specs, s, l, b = slo.parse_spec("1")
+    assert {sp.name for sp in specs} >= {"create_bound_p99",
+                                        "desync_rate",
+                                        "degraded_fraction"}
+    assert (s, l, b) == (5.0, 30.0, 0.5)
+    specs, s, l, b = slo.parse_spec(
+        "create_bound_p99=0.25,short=2,long=8,burn=0.4")
+    assert s == 2.0 and l == 8.0 and b == 0.4
+    assert next(sp for sp in specs
+                if sp.name == "create_bound_p99").threshold == 0.25
+    with pytest.raises(ValueError):
+        slo.parse_spec("nope=1")
+    with pytest.raises(ValueError):
+        slo.parse_spec("burn=2.0")
+    with pytest.raises(ValueError):
+        slo.parse_spec("create_bound_p99")
+    # non-positive windows would silently neuter the sentinel
+    with pytest.raises(ValueError):
+        slo.parse_spec("short=-1")
+    with pytest.raises(ValueError):
+        slo.parse_spec("long=0")
+
+
+def _entries(values, dt=1.0, key="create_bound_p99_s"):
+    """Synthetic ring: one entry per value, dt apart; None = idle
+    window (the entry doesn't carry the quantile key)."""
+    out = []
+    for i, v in enumerate(values):
+        e = {"t": i * dt, "degradation_level": 0}
+        if v is not None:
+            e[key] = v
+        out.append(e)
+    return out
+
+
+def test_multi_window_burn_rising_edge_and_clear():
+    spec = slo.SLOSpec("create_bound_p99", "window_quantile",
+                       "create_bound_p99_s", 1.0)
+    sent = slo.SLOSentinel([spec], short_s=2.0, long_s=6.0, burn=0.5)
+    # healthy history → no alert
+    assert sent.evaluate(_entries([0.1] * 8)) == []
+    assert sent.burning["create_bound_p99"] is False
+    # a single bad snapshot burns the short window but not the long one
+    assert sent.evaluate(_entries([0.1] * 7 + [5.0])) == []
+    # sustained burn through both windows → exactly one rising-edge
+    # alert, and the gauge stays up without re-alerting
+    burning = _entries([0.1] * 2 + [5.0] * 6)
+    alerts = sent.evaluate(burning)
+    assert len(alerts) == 1
+    a = alerts[0]
+    assert a["slo"] == "create_bound_p99"
+    assert a["short_burn"] >= 0.5 and a["long_burn"] >= 0.5
+    assert sent.burning["create_bound_p99"] is True
+    assert sent.evaluate(burning) == []  # still burning, no re-alert
+    # recovery clears the gauge; a later relapse alerts again
+    assert sent.evaluate(_entries([0.1] * 8)) == []
+    assert sent.burning["create_bound_p99"] is False
+    assert len(sent.evaluate(burning)) == 1
+
+
+def test_idle_windows_do_not_vote():
+    """Entries without the quantile key (nothing bound that window)
+    are excluded from the burn denominator — an idle engine must not
+    alert OR mask a real burn."""
+    spec = slo.SLOSpec("create_bound_p99", "window_quantile",
+                       "create_bound_p99_s", 1.0)
+    sent = slo.SLOSentinel([spec], short_s=3.0, long_s=8.0, burn=0.5)
+    # idle gaps between bad windows: the voting entries all breach
+    vals = [None, 5.0, None, 5.0, None, 5.0, None, 5.0]
+    assert len(sent.evaluate(_entries(vals))) == 1
+    # all idle → nothing votes, nothing alerts
+    sent2 = slo.SLOSentinel([spec], 3.0, 8.0, 0.5)
+    assert sent2.evaluate(_entries([None] * 8)) == []
+
+
+def test_incident_class_single_event_alerts():
+    """Threshold-0 incident objectives (desyncs, invariant violations)
+    must alert on ONE event — the burn fraction must not dilute a
+    single breaching row across the clean rows around it."""
+    spec = slo.SLOSpec("desync_rate", "delta", "desyncs", 0.0)
+    assert spec.incident
+    sent = slo.SLOSentinel([spec], short_s=5.0, long_s=20.0, burn=0.5)
+    entries = [{"t": float(i), "d_desyncs": 0.0} for i in range(20)]
+    assert sent.evaluate(entries) == []
+    # one desync among 19 clean rows inside both windows → alert
+    entries[-1]["d_desyncs"] = 1.0
+    alerts = sent.evaluate(entries)
+    assert len(alerts) == 1 and alerts[0]["short_burn"] == 1.0
+    # quantile objectives keep fraction semantics (no saturation)
+    q = slo.SLOSpec("create_bound_p99", "window_quantile",
+                    "create_bound_p99_s", 1.0)
+    assert not q.incident
+
+
+def test_parse_every_rejects_nonpositive_duration():
+    with pytest.raises(ValueError):
+        timeseries.parse_every("0s")
+    with pytest.raises(ValueError):
+        timeseries.parse_every("-5s")
+
+
+def test_slo_configure_implies_timeline():
+    """Programmatic arming of the sentinel alone must arm the timeline
+    too — the sentinel reads the ring, so a disarmed timeline would
+    silently never evaluate. Disarming is symmetric: the sentinel
+    disarms the timeline IT armed, and leaves an explicitly-armed one
+    alone."""
+    assert not timeseries.TIMELINE.enabled
+    slo.configure("1")
+    assert timeseries.TIMELINE.enabled
+    slo.configure("")  # symmetric: the implied timeline disarms too
+    assert not timeseries.TIMELINE.enabled
+    # an explicitly-armed timeline keeps its cadence and survives the
+    # sentinel's disarm
+    timeseries.configure(True, every="3", capacity=32)
+    slo.configure("create_bound_p99=0.5")
+    assert timeseries.TIMELINE.every_batches == 3
+    slo.configure("")
+    assert timeseries.TIMELINE.enabled
+
+
+def test_delta_and_degraded_kinds():
+    d = slo.SLOSpec("desync_rate", "delta", "desyncs", 0.0)
+    g = slo.SLOSpec("degraded_fraction", "degraded",
+                    "degradation_level", 0.0)
+    ent = {"t": 0.0, "d_desyncs": 1.0, "degradation_level": 2,
+           "tags": {"invariant_violation": 1}}
+    assert d.breaches(ent) is True
+    assert g.breaches(ent) is True
+    t = slo.SLOSpec("invariant_violations", "tag",
+                    "invariant_violation", 0.0)
+    assert t.breaches(ent) is True
+    clean = {"t": 0.0, "d_desyncs": 0.0, "degradation_level": 0}
+    assert d.breaches(clean) is False and g.breaches(clean) is False
+    assert t.breaches(clean) is False
+
+
+# ---- engine integration ---------------------------------------------------
+
+PLUGINS = ["NodeUnschedulable", "NodeResourcesFit",
+           "NodeResourcesLeastAllocated"]
+N_PODS = 14
+
+
+def _config(**kw):
+    kw.setdefault("max_batch_size", 7)
+    kw.setdefault("batch_window_s", 0.3)
+    kw.setdefault("batch_idle_s", 0.1)
+    kw.setdefault("backoff_initial_s", 0.05)
+    kw.setdefault("backoff_max_s", 0.3)
+    return SchedulerConfig(**kw)
+
+
+def _pods(n=N_PODS):
+    return [obj.Pod(
+        metadata=obj.ObjectMeta(name=f"p{i}", namespace="default"),
+        spec=obj.PodSpec(requests={"cpu": 100 + 17 * i},
+                         priority=500 - i)) for i in range(n)]
+
+
+def _run_burst(config, n_pods=N_PODS, settle_s=60):
+    c = Cluster()
+    try:
+        c.start(profile=Profile(plugins=list(PLUGINS)), config=config,
+                with_pv_controller=False)
+        for i, cpu in enumerate((64000, 48000, 40000, 36000)):
+            c.create_node(f"n{i}", cpu=cpu)
+        c.create_objects(_pods(n_pods))
+        deadline = time.monotonic() + settle_s
+        placements = {}
+        while time.monotonic() < deadline:
+            placements = {p.metadata.name: p.spec.node_name
+                          for p in c.list_pods() if p.spec.node_name}
+            if len(placements) == n_pods:
+                break
+            time.sleep(0.05)
+        assert len(placements) == n_pods, (
+            f"only {len(placements)}/{n_pods} bound")
+        m = c.service.scheduler.metrics()
+        tl = c.service.scheduler.timeline()
+        return placements, m, tl
+    finally:
+        c.shutdown()
+
+
+@pytest.mark.parametrize("mode", [
+    {},                             # pipelined + resident + shortlist
+    {"pipeline": False},            # strictly synchronous cycle
+    {"device_resident": False},     # upload-every-batch + i32 fetch
+    {"shortlist": False},           # full-width scan
+])
+def test_decisions_bit_identical_timeline_on_off(mode):
+    """MINISCHED_TIMELINE/MINISCHED_SLO armed vs unarmed must not move
+    a single placement: the snapshot path reads metrics, never an
+    engine input or PRNG draw — pinned per engine mode."""
+    base, m0, _ = _run_burst(_config(**mode))
+    timeseries.configure(True, every="1", capacity=128)
+    slo.configure("1")
+    armed, m1, tl = _run_burst(_config(**mode))
+    assert armed == base
+    assert m1["pods_bound"] == m0["pods_bound"] == N_PODS
+    assert m1["timeline_snapshots"] >= 1
+    assert tl["entries"], "armed run snapshotted nothing"
+
+
+def test_timeline_rows_carry_window_latency():
+    """A sustained multi-batch run's later rows must carry the
+    histogram-delta quantiles (windows where pods actually bound)."""
+    timeseries.configure(True, every="1", capacity=256)
+    _, m, tl = _run_burst(_config(max_batch_size=3), n_pods=18)
+    assert m["timeline_snapshots"] >= 2
+    rows = [e for e in tl["entries"] if e.get("window_bound")]
+    assert rows, tl["entries"]
+    assert any("create_bound_p99_s" in e for e in rows)
+    # gauges rode along
+    assert all("degradation_level" in e for e in tl["entries"])
+
+
+def test_timeline_http_endpoint_and_service_surface():
+    """GET /timeline serves every profile's ring + alerts; the service
+    surface keys by profile name; unarmed = empty-but-valid."""
+    import urllib.request
+
+    from minisched_tpu.apiserver import APIServer
+    from minisched_tpu.service.service import SchedulerService
+    from minisched_tpu.state.store import ClusterStore
+
+    timeseries.configure(True, every="1", capacity=64)
+    store = ClusterStore()
+    svc = SchedulerService(store)
+    svc.start_scheduler(Profile(name="default-scheduler",
+                                plugins=list(PLUGINS)), _config())
+    api = APIServer(store)
+    api.timeline_providers.append(svc.timeline)
+    api.start()
+    try:
+        for i, cpu in enumerate((64000, 48000)):
+            store.create(obj.Node(
+                metadata=obj.ObjectMeta(name=f"n{i}"),
+                status=obj.NodeStatus(allocatable={"cpu": cpu})))
+        store.create_many(_pods(8))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if svc.metrics().get("pods_bound", 0) >= 8:
+                break
+            time.sleep(0.05)
+        body = json.loads(urllib.request.urlopen(
+            f"{api.address}/timeline", timeout=5).read().decode())
+        assert "timelines" in body
+        doc = body["timelines"]["default-scheduler"]
+        assert doc["enabled"] is True
+        assert isinstance(doc["entries"], list)
+        assert isinstance(doc["alerts"], list)
+        assert doc["snapshots"] >= len(doc["entries"])
+    finally:
+        api.shutdown()
+        svc.shutdown_scheduler()
+    # unarmed: still a valid document, just empty
+    timeseries.configure(False)
+    svc2 = SchedulerService(ClusterStore())
+    svc2.start_scheduler(Profile(name="default-scheduler",
+                                 plugins=list(PLUGINS)), _config())
+    try:
+        doc = svc2.timeline()["default-scheduler"]
+        assert doc["enabled"] is False and doc["entries"] == []
+    finally:
+        svc2.shutdown_scheduler()
+
+
+def test_faulted_churn_alert_before_quarantine():
+    """The acceptance chain end-to-end: a faulted churn run
+    (MINISCHED_FAULTS + the lifecycle driver) must raise at least one
+    burn-rate alert BEFORE the ladder reaches quarantine, visible as a
+    trace instant, a metrics counter, and a /timeline alert entry, with
+    the supervisor's early-warning reaction counted — and the timeline
+    rows must carry per-generator attribution tags (the reclamation
+    wave is visible where the counters moved)."""
+    from minisched_tpu.lifecycle import (LifecycleDriver, PoissonArrivals,
+                                         ReclamationWave)
+
+    timeseries.configure(True, every="1", capacity=512)
+    slo.configure("batch_fault_rate=0,short=1,long=4,burn=0.25")
+    obs.configure(True, buf=1 << 15)
+
+    c = Cluster()
+    c.start(profile=Profile(name="churn", plugins=list(PLUGINS)),
+            config=SchedulerConfig(backoff_initial_s=0.05,
+                                   backoff_max_s=0.2, max_batch_size=16,
+                                   probation_batches=2),
+            with_pv_controller=False)
+    sched = c.service.scheduler
+    try:
+        driver = LifecycleDriver(c, seed=11, pace=1.0, settle_s=8.0)
+        for _ in range(6):
+            driver.view.create_pool_node("base", cpu=4000)
+        driver.add(PoissonArrivals("arrivals", rate_pps=40,
+                                   duration_s=4.0, cpu=100, prefix="ch"))
+        driver.add(ReclamationWave("reclaim", pool="base",
+                                   interval_s=1.2, wave_frac=0.3,
+                                   grace_s=0.3, waves=2))
+        driver.install_default_invariants()
+        # Deterministic fault schedule: every 3rd step dispatch errs.
+        # Never two consecutive, so each fault escalates at most one
+        # rung and probation (2 clean batches) recovers it — the ladder
+        # can never reach quarantine, making "alert BEFORE quarantine"
+        # structural rather than probabilistic.
+        faults.configure(",".join(f"step:err@{n}"
+                                  for n in range(2, 120, 3)))
+        driver.run(until_s=4.0)
+        # Keep faulted traffic flowing until the burn windows trip (the
+        # Poisson run alone may end before both windows fill).
+        pump_dl = time.monotonic() + 30
+        i = 0
+        while (time.monotonic() < pump_dl
+               and sched.metrics()["slo_alerts_total"] == 0):
+            for j in range(6):
+                driver.view.create_pod(f"pump-{i}-{j}", cpu=50)
+            i += 1
+            time.sleep(0.25)
+        faults.configure("")
+        driver.settle(timeout=30)
+
+        m = sched.metrics()
+        tl = sched.timeline()
+        assert m["slo_alerts_total"] >= 1, m
+        assert m["slo_alerts_batch_fault_rate"] >= 1
+        assert m["supervisor_early_warnings"] >= 1
+        assert tl["alerts"], "alert missing from the /timeline log"
+        first = tl["alerts"][0]
+        # the early-warning property: the first alert fired while the
+        # ladder was still above the quarantine rung
+        assert first["degradation_level"] < 3, first
+        # trace-instant visibility on the flight recorder's timeline
+        kinds = {e["name"] for e in obs.TRACE.events() if e["ph"] == "i"}
+        assert "slo.burn" in kinds, kinds
+        assert "supervisor.early_warning" in kinds, kinds
+        # per-generator attribution tags on the snapshot rows
+        tags = {t for e in tl["entries"] for t in (e.get("tags") or {})}
+        assert "arrivals" in tags, tags
+        assert "reclaim" in tags, tags
+    finally:
+        faults.configure("")
+        c.shutdown()
+
+
+def test_early_warning_extends_probation_and_prearms_watchdog():
+    """The supervisor reaction in isolation: early_warning resets the
+    probation counter (a degraded engine cannot climb while burning)
+    and pre-arms the per-batch watchdog."""
+    from minisched_tpu.engine.scheduler import (SLO_PREARM_BATCHES,
+                                                Scheduler)
+    from minisched_tpu.plugins import NodeUnschedulable, PluginSet
+    from minisched_tpu.state.store import ClusterStore
+
+    sched = Scheduler(ClusterStore(), PluginSet([NodeUnschedulable()]),
+                      SchedulerConfig(probation_batches=2))
+    try:
+        sup = sched._sup
+        sup.level = 1
+        sup._clean = 1  # one clean batch from re-escalating
+        sup.early_warning("slo:test")
+        assert sup._clean == 0
+        assert sup.prearm == SLO_PREARM_BATCHES
+        m = sched.metrics()
+        assert m["supervisor_early_warnings"] == 1
+        # note_clean now needs the full probation again
+        sup.note_clean()
+        assert sup.level == 1
+        sup.note_clean()
+        assert sup.level == 0
+    finally:
+        sched.shutdown()
+
+
+def test_continuous_burn_blocks_probation_climb():
+    """The probation-extension contract under a CONTINUOUS burn: the
+    rising-edge alert resets probation once, but fault-free batches
+    while the SLO still burns must not count toward climbing either —
+    and the watchdog pre-arm stays topped up until the burn clears."""
+    from minisched_tpu.engine.scheduler import (SLO_PREARM_BATCHES,
+                                                Scheduler)
+    from minisched_tpu.plugins import NodeUnschedulable, PluginSet
+    from minisched_tpu.state.store import ClusterStore
+
+    timeseries.configure(True, every="1")
+    slo.configure("1")
+    sched = Scheduler(ClusterStore(), PluginSet([NodeUnschedulable()]),
+                      SchedulerConfig(probation_batches=2))
+    try:
+        sched._slo_sentinel = slo.SLOSentinel.from_config(slo.SLO)
+        sched._slo_epoch = slo.SLO.epoch
+        sup = sched._sup
+        sup.level = 1
+        sup.prearm = 0
+        sched._slo_sentinel.burning["create_bound_p99"] = True
+        for _ in range(5):  # would normally climb after 2
+            sup.note_clean()
+        assert sup.level == 1, "climbed while the SLO was burning"
+        assert sup.prearm == SLO_PREARM_BATCHES
+        # burn clears → probation counts again and the engine climbs
+        sched._slo_sentinel.burning["create_bound_p99"] = False
+        sup.note_clean()
+        sup.note_clean()
+        assert sup.level == 0
+        # the degraded-posture objective must NOT gate the climb: it
+        # burns BECAUSE the engine is degraded, and heeding it would
+        # livelock the ladder at the degraded rung forever
+        sup.level = 1
+        sched._slo_sentinel.burning["degraded_fraction"] = True
+        sup.note_clean()
+        sup.note_clean()
+        assert sup.level == 0, "degraded_fraction livelocked the ladder"
+        sched._slo_sentinel.burning["degraded_fraction"] = False
+        # at level 0 under a CONTINUOUS burn the watchdog pre-arm must
+        # stay topped up (only one rising-edge alert ever fires, so
+        # without the top-up it would lapse mid-burn)
+        sched._slo_sentinel.burning["create_bound_p99"] = True
+        sup.prearm = 3
+        sup.note_clean()
+        assert sup.level == 0
+        assert sup.prearm == SLO_PREARM_BATCHES
+    finally:
+        sched.shutdown()
+
+
+# ---- cross-run perf ledger ------------------------------------------------
+
+
+def test_burning_gauge_not_stale_after_disarm_or_idle():
+    """Two latching bugs the gauge export must not have: a retired
+    sentinel exporting after disarm, and a flag evaluate() set staying
+    1 forever on an IDLE engine (no batches → no evaluate) after the
+    burn windows slid past the breaching rows."""
+    # sentinel-level: burning_now re-derives against the current clock
+    spec = slo.SLOSpec("create_bound_p99", "window_quantile",
+                       "create_bound_p99_s", 1.0)
+    sent = slo.SLOSentinel([spec], short_s=2.0, long_s=6.0, burn=0.5)
+    burning = _entries([0.1] * 2 + [5.0] * 6)
+    assert len(sent.evaluate(burning)) == 1
+    assert sent.burning_now(burning, now_t=7.0)["create_bound_p99"]
+    # clock advances with no new rows: windows empty out, gauge drops
+    # — without mutating the sentinel's own state
+    assert not sent.burning_now(burning, now_t=50.0)["create_bound_p99"]
+    assert sent.burning["create_bound_p99"] is True
+    # recovery via evaluate() records the falling edge (the engine
+    # emits the documented slo.clear instant from it)
+    assert sent.evaluate(_entries([0.1] * 8)) == []
+    assert sent.last_cleared == ["create_bound_p99"]
+
+    # engine-level: idle empty ring re-derives to 0; disarm removes
+    # the series entirely
+    from minisched_tpu.engine.scheduler import Scheduler
+    from minisched_tpu.plugins import NodeUnschedulable, PluginSet
+    from minisched_tpu.state.store import ClusterStore
+
+    timeseries.configure(True, every="1")
+    slo.configure("1")
+    sched = Scheduler(ClusterStore(), PluginSet([NodeUnschedulable()]),
+                      SchedulerConfig())
+    try:
+        cfg = slo.SLO
+        sched._slo_sentinel = slo.SLOSentinel.from_config(cfg)
+        sched._slo_epoch = cfg.epoch
+        sched._slo_sentinel.burning["create_bound_p99"] = True
+        assert sched.metrics()["slo_burning_create_bound_p99"] == 0
+        slo.configure("")  # disarm: the retired sentinel must not export
+        assert "slo_burning_create_bound_p99" not in sched.metrics()
+    finally:
+        sched.shutdown()
+
+
+def test_ledger_skips_faulted_and_degraded_runs(tmp_path, monkeypatch):
+    """A fault-armed or degraded run must never become the baseline the
+    regression gate diffs against."""
+    import bench
+
+    path = str(tmp_path / "ledger.json")
+    monkeypatch.setenv("MINISCHED_BENCH_LEDGER", path)
+    good = {"value": 100.0, "detail": {
+        "nodes": 10, "pods": 5, "platform": "cpu",
+        "engine_pods_per_sec": 100.0, "engine_fault_fires": 0,
+        "engine_degradation_state": "resident"}}
+    bench.maybe_append_ledger(good)
+    assert len(json.load(open(path))["runs"]) == 1
+    # fault fires recorded → skipped
+    bad = {"value": 50.0, "detail": {
+        "nodes": 10, "pods": 5, "platform": "cpu",
+        "engine_pods_per_sec": 50.0, "engine_fault_fires": 3}}
+    bench.maybe_append_ledger(bad)
+    assert len(json.load(open(path))["runs"]) == 1
+    # degraded end state → skipped
+    degraded = {"value": 50.0, "detail": {
+        "nodes": 10, "pods": 5, "platform": "cpu",
+        "engine_pods_per_sec": 50.0, "engine_fault_fires": 0,
+        "engine_degradation_state": "sync"}}
+    bench.maybe_append_ledger(degraded)
+    assert len(json.load(open(path))["runs"]) == 1
+    # MINISCHED_FAULTS armed → skipped regardless of counters
+    monkeypatch.setenv("MINISCHED_FAULTS", "step:err@once")
+    bench.maybe_append_ledger(good)
+    assert len(json.load(open(path))["runs"]) == 1
+
+
+def test_ledger_keys_and_append(tmp_path):
+    import bench
+
+    detail = {"nodes": 500, "pods": 250, "platform": "cpu",
+              "engine_pods_per_sec": 900.0, "engine_sched_s": 0.5,
+              "engine_hist_p99_s": 0.2, "engine_h2d_bytes": 1000,
+              "engine_note": "text is skipped", "stream_pods_per_sec": 0.0}
+    keys = bench.ledger_keys(detail, headline_value=1234.5)
+    assert keys["raw_pods_per_sec"] == 1234.5
+    assert keys["engine_pods_per_sec"] == 900.0
+    assert "engine_note" not in keys
+    assert "stream_pods_per_sec" not in keys  # zero = skipped phase
+    path = str(tmp_path / "ledger.json")
+    entry = bench.ledger_entry_from_result(
+        {"value": 1234.5, "detail": detail})
+    bench.append_ledger(entry, path)
+    bench.append_ledger(entry, path)
+    doc = json.load(open(path))
+    assert doc["schema"] == bench.LEDGER_SCHEMA
+    assert len(doc["runs"]) == 2
+    assert doc["runs"][0]["nodes"] == 500
+    # a torn/corrupt ledger is replaced, not crashed on
+    open(path, "w").write("{not json")
+    bench.append_ledger(entry, path)
+    assert len(json.load(open(path))["runs"]) == 1
+
+
+def test_bench_compare_detects_degraded_and_passes_clean():
+    base = {"engine_pods_per_sec": 1000.0, "engine_sched_s": 1.0,
+            "engine_hist_p99_s": 0.5, "engine_h2d_bytes": 10000.0}
+    # clean self-compare: every key within tolerance
+    rep = bench_compare.compare(dict(base), base)
+    assert rep["ok"] and not rep["regressions"]
+    assert rep["checked"] == 4
+    # synthetically degraded run: throughput halved, latency tripled,
+    # transfer bytes doubled — every class must flag
+    degraded = {"engine_pods_per_sec": 450.0, "engine_sched_s": 3.0,
+                "engine_hist_p99_s": 2.0, "engine_h2d_bytes": 20000.0}
+    rep = bench_compare.compare(degraded, base)
+    assert not rep["ok"]
+    flagged = {r["key"] for r in rep["regressions"]}
+    assert flagged == set(base)
+    # noise inside the per-class tolerance does NOT flag
+    noisy = {"engine_pods_per_sec": 800.0, "engine_sched_s": 1.3,
+             "engine_hist_p99_s": 0.6, "engine_h2d_bytes": 10500.0}
+    rep = bench_compare.compare(noisy, base)
+    assert rep["ok"], rep["regressions"]
+    # keys on one side only are informational, never failures
+    rep = bench_compare.compare({"new_key_s": 1.0}, base)
+    assert rep["ok"] and "new_key_s" in rep["uncompared"]
+
+
+def test_bench_compare_baseline_matching():
+    ledger = {"schema": 1, "runs": [
+        {"nodes": 500, "pods": 250, "platform": "cpu", "ts": "a",
+         "source": "bench-check", "keys": {"engine_sched_s": 1.0}},
+        {"nodes": 2000, "pods": 1000, "platform": "cpu", "ts": "b",
+         "source": "bench-check", "keys": {"engine_sched_s": 9.0}},
+        {"nodes": 500, "pods": 250, "platform": "cpu", "ts": "c",
+         "source": "bench-check", "keys": {"engine_sched_s": 2.0}},
+        # a full-bench run at the SAME shape: different phase
+        # methodology, must never be picked as the check baseline
+        {"nodes": 500, "pods": 250, "platform": "cpu", "ts": "d",
+         "source": "bench", "keys": {"engine_sched_s": 99.0}},
+    ]}
+    hit = bench_compare.latest_baseline(ledger, 500, 250, "cpu")
+    assert hit["ts"] == "c"  # newest LIKE-FOR-LIKE wins
+    assert bench_compare.latest_baseline(ledger, 500, 250, "tpu") is None
+    assert bench_compare.latest_baseline(
+        ledger, 500, 250, "cpu", source="bench")["ts"] == "d"
+
+
+def test_committed_ledger_has_check_shape_baseline():
+    """make bench-check compares against the committed ledger; the
+    committed artifact must carry a baseline at the check shape."""
+    doc = json.load(open(os.path.join(REPO, "BENCH_LEDGER.json")))
+    assert doc["schema"] == 1
+    assert bench_compare.latest_baseline(doc, 500, 250, "cpu"), (
+        "no 500x250 cpu baseline in BENCH_LEDGER.json — run "
+        "`python tools/bench_compare.py --capture --update`")
+
+
+# ---- resultstore retention under churn ------------------------------------
+
+
+def test_resultstore_bounded_under_churn():
+    """Sustained lifecycle churn (create → record → delete, repeated)
+    must not grow the explain store: the retention bound caps recorded
+    results, the terminal sweep evicts deleted pods' records, and both
+    are counted in resultstore_evictions."""
+    import numpy as np
+
+    from minisched_tpu.explain.resultstore import ResultStore
+    from minisched_tpu.state.store import ClusterStore
+
+    class _K:
+        __slots__ = ("key",)
+
+        def __init__(self, k):
+            self.key = k
+
+    class _PS:
+        filter_plugins = [type("F", (), {"name": "NodeResourcesFit"})()]
+        score_plugins = []
+
+        @staticmethod
+        def weight_of(p):
+            return 1.0
+
+    class _D:
+        pass
+
+    rs = ResultStore(ClusterStore(), flush=False, top_k=8,
+                     max_results=16)
+    names = [f"n{i}" for i in range(8)]
+    d = _D()
+    d.filter_masks = np.ones((1, 4, 8), dtype=bool)
+    d.raw_scores = np.zeros((0, 4, 8), np.float32)
+    d.norm_scores = d.raw_scores
+    for wave in range(20):
+        rs.record_batch([_K(f"ns/p{wave}-{i}") for i in range(4)],
+                        names, d, _PS())
+    st = rs.stats()
+    assert st["results"] <= 16
+    assert st["evictions"] >= 64 - 16, st
+    # terminal sweep: deleting a recorded pod evicts and counts
+    live = rs.pending_keys()[0]
+    before = rs.stats()["evictions"]
+    rs.delete_data(live)
+    st = rs.stats()
+    assert st["evictions"] == before + 1
+    assert live not in rs.pending_keys()
+    rs.close()
+
+
+def test_engine_churn_sweeps_deleted_pods_results():
+    """Service-level: with explain mode on, deleted pods' records are
+    swept via the informer DELETE hook and the eviction counter is
+    visible in Scheduler.metrics()."""
+    c = Cluster()
+    try:
+        c.start(profile=Profile(plugins=list(PLUGINS)),
+                config=_config(explain=True), with_pv_controller=False)
+        for i, cpu in enumerate((64000, 48000)):
+            c.create_node(f"n{i}", cpu=cpu)
+        c.create_objects(_pods(8))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if c.service.scheduler.metrics()["pods_bound"] >= 8:
+                break
+            time.sleep(0.05)
+        rs = c.service.result_store
+        assert rs is not None
+        rs.drain(timeout=10)
+        for i in range(8):
+            c.store.delete("Pod", f"default/p{i}")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            st = rs.stats()
+            if st["results"] == 0 and st["filter_bits"] == 0:
+                break
+            time.sleep(0.05)
+        st = rs.stats()
+        assert st["results"] == 0 and st["filter_bits"] == 0, st
+        m = c.service.scheduler.metrics()
+        assert "resultstore_evictions" in m
+        assert m["resultstore_results"] == 0
+    finally:
+        c.shutdown()
+
+
+# ---- trace_view CLI contract ----------------------------------------------
+
+
+def _run_trace_view(tmp_path, doc, monkeypatch, raw=None):
+    path = str(tmp_path / "t.json")
+    with open(path, "w") as f:
+        if raw is not None:
+            f.write(raw)
+        else:
+            json.dump(doc, f)
+    monkeypatch.setattr(sys, "argv", ["trace_view.py", path])
+    return trace_view.main()
+
+
+def test_trace_view_exit_codes(tmp_path, monkeypatch, capsys):
+    # valid empty/unarmed trace → 0, a note, no stack trace
+    empty = {"traceEvents": [{"ph": "M", "name": "thread_name",
+                              "pid": 1, "tid": 1, "args": {"name": "x"}}]}
+    assert _run_trace_view(tmp_path, empty, monkeypatch) == 0
+    assert "empty trace" in capsys.readouterr().out
+    assert _run_trace_view(tmp_path, {"traceEvents": []},
+                           monkeypatch) == 0
+    # schema violation → 2 on stderr
+    bad = {"traceEvents": [{"ph": "X", "name": "a", "pid": 1, "tid": 1,
+                            "ts": 0.0}]}  # X without dur
+    assert _run_trace_view(tmp_path, bad, monkeypatch) == 2
+    assert "schema violation" in capsys.readouterr().err
+    assert _run_trace_view(tmp_path, {"nope": 1}, monkeypatch) == 2
+    # unreadable input → 1
+    assert _run_trace_view(tmp_path, None, monkeypatch,
+                           raw="{not json") == 1
+    monkeypatch.setattr(sys, "argv", ["trace_view.py",
+                                      str(tmp_path / "missing.json")])
+    assert trace_view.main() == 1
+    # a real valid trace still summarizes and returns 0
+    ok = {"traceEvents": [
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1,
+         "args": {"name": "scheduling-loop"}},
+        {"ph": "X", "name": "resolve", "pid": 1, "tid": 1, "ts": 0.0,
+         "dur": 10.0},
+        {"ph": "i", "name": "fault.step", "pid": 1, "tid": 1,
+         "ts": 5.0},
+    ]}
+    assert _run_trace_view(tmp_path, ok, monkeypatch) == 0
+    assert "resolve" in capsys.readouterr().out

@@ -1,0 +1,236 @@
+"""Cross-run perf-regression gate: diff a fresh bench capture against
+the committed BENCH_LEDGER.json with noise-aware thresholds.
+
+The committed BENCH_*.json artifacts are point-in-time proofs; nothing
+ever compared two runs, so a perf regression would land silently. This
+tool closes the loop:
+
+    # the `make bench-check` gate: capture a fresh interleaved
+    # min-of-N run at the check shape and diff it against the newest
+    # committed ledger entry of the same (nodes, pods, platform)
+    JAX_PLATFORMS=cpu python tools/bench_compare.py --capture
+
+    # bootstrap / refresh the baseline (appends the capture)
+    JAX_PLATFORMS=cpu python tools/bench_compare.py --capture --update
+
+    # pure diff mode (tests, offline triage)
+    python tools/bench_compare.py --fresh run.json [--ledger PATH]
+
+Noise discipline: the capture runs ``--rounds`` full bench rounds
+(default 3) and keeps the MIN of every time/byte key and the MAX of
+every throughput key per round — single-round wall-clock on a busy CPU
+host jitters far beyond any real regression. Thresholds are per-key-
+class (classified by name suffix):
+
+    *_pods_per_sec   regression when fresh < base × (1 − 0.40)
+    *_s              regression when fresh > base × (1 + 0.50)
+    *_bytes          regression when fresh > base × (1 + 0.10)
+                     (byte ledgers are near-deterministic — decisions
+                     are bit-identical run-to-run — so a 10% growth is
+                     a protocol change, not noise)
+
+Keys present on only one side are reported informationally, never
+failed: phases get skipped under budget pressure, and a fresh key must
+not brick the gate. Exit codes: 0 = no regression, 1 = regression(s),
+2 = no comparable baseline / unreadable input.
+
+Env: MINISCHED_BENCH_NODES / MINISCHED_BENCH_PODS override the capture
+shape (default 500 × 250 — small enough that `make bench-check` stays
+a minutes-class gate), MINISCHED_BENCH_ROUNDS the round count.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: name-suffix → (direction, relative tolerance). Direction "up" =
+#: higher is better (throughput); "down" = lower is better.
+TOLERANCES = (
+    ("_pods_per_sec", ("up", 0.40)),
+    ("_bytes", ("down", 0.10)),
+    ("_s", ("down", 0.50)),
+)
+
+
+def classify(key: str) -> Optional[Tuple[str, float]]:
+    for suffix, spec in TOLERANCES:
+        if key.endswith(suffix):
+            return spec
+    return None
+
+
+def compare(fresh: Dict[str, float], base: Dict[str, float],
+            scale: float = 1.0) -> dict:
+    """Per-key verdicts. ``scale`` multiplies every tolerance (a soak
+    host under load can loosen the gate without editing the table)."""
+    regressions, improvements, within, uncompared = [], [], [], []
+    for key in sorted(set(fresh) | set(base)):
+        f, b = fresh.get(key), base.get(key)
+        spec = classify(key)
+        if f is None or b is None or spec is None or not b:
+            uncompared.append(key)
+            continue
+        direction, tol = spec
+        tol *= scale
+        ratio = f / b
+        rec = {"key": key, "fresh": round(f, 6), "base": round(b, 6),
+               "ratio": round(ratio, 4), "tolerance": tol,
+               "direction": direction}
+        if direction == "up":
+            if ratio < 1.0 - tol:
+                regressions.append(rec)
+            elif ratio > 1.0 + tol:
+                improvements.append(rec)
+            else:
+                within.append(rec)
+        else:
+            if ratio > 1.0 + tol:
+                regressions.append(rec)
+            elif ratio < 1.0 - tol:
+                improvements.append(rec)
+            else:
+                within.append(rec)
+    return {"ok": not regressions, "regressions": regressions,
+            "improvements": improvements, "within": within,
+            "uncompared": uncompared,
+            "checked": len(regressions) + len(improvements) + len(within)}
+
+
+def latest_baseline(ledger: dict, nodes: int, pods: int, platform: str,
+                    source: str = "bench-check") -> Optional[dict]:
+    """Newest committed run entry at the same shape+platform AND the
+    same methodology stamp — the noise thresholds only mean anything
+    between like-for-like runs, and a full `bench.py` run at the check
+    shape uses different phase parameters (batch sizes, gather
+    windows, lat_samples) than the capture, so matching on shape alone
+    would diff across methodologies."""
+    for run in reversed(ledger.get("runs") or []):
+        if (run.get("nodes") == nodes and run.get("pods") == pods
+                and run.get("platform") == platform
+                and run.get("source") == source):
+            return run
+    return None
+
+
+def capture(nodes: int, pods: int, rounds: int) -> dict:
+    """Fresh interleaved min-of-N capture at the check shape: the
+    engine burst + sustained-stream phases through the REAL product
+    path (bench.engine_bench), min-merged on time/byte keys and
+    max-merged on throughput keys across rounds."""
+    import bench
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    merged: Dict[str, float] = {}
+    for _ in range(max(1, rounds)):
+        # the shared check-shape phase pair (bench.check_phases) —
+        # bench_slo runs the SAME pair, so off/on overhead numbers and
+        # the ledger baseline stay methodology-comparable
+        keys = bench.ledger_keys(bench.check_phases(nodes, pods))
+        for k, v in keys.items():
+            spec = classify(k)
+            if k not in merged:
+                merged[k] = v
+            elif spec and spec[0] == "up":
+                merged[k] = max(merged[k], v)
+            else:
+                merged[k] = min(merged[k], v)
+    return {"ts": bench.time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                      bench.time.gmtime()),
+            "source": "bench-check",
+            "platform": platform, "nodes": nodes, "pods": pods,
+            "rounds": rounds, "keys": merged}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ledger",
+                    default=os.path.join(REPO, "BENCH_LEDGER.json"))
+    ap.add_argument("--fresh", default=None,
+                    help="diff this run file ({keys: ...} or a full "
+                         "ledger entry) instead of capturing")
+    ap.add_argument("--capture", action="store_true",
+                    help="run a fresh interleaved min-of-N capture")
+    ap.add_argument("--update", action="store_true",
+                    help="append the fresh capture to the ledger "
+                         "(baseline bootstrap/refresh)")
+    ap.add_argument("--rounds", type=int, default=int(
+        os.environ.get("MINISCHED_BENCH_ROUNDS", "3")))
+    ap.add_argument("--tolerance-scale", type=float, default=1.0)
+    args = ap.parse_args()
+
+    if args.update and os.environ.get("MINISCHED_FAULTS"):
+        # A fault-armed capture must never become the baseline the
+        # regression gate diffs against (same hygiene as bench.py's
+        # maybe_append_ledger).
+        print("bench_compare: refusing --update with MINISCHED_FAULTS "
+              "armed — a faulted run is not a baseline",
+              file=sys.stderr)
+        return 2
+
+    try:
+        with open(args.ledger, encoding="utf-8") as f:
+            ledger = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        if not (args.capture and args.update):
+            print(f"bench_compare: cannot read ledger {args.ledger}: {e}",
+                  file=sys.stderr)
+            return 2
+        ledger = {"schema": 1, "runs": []}
+
+    if args.fresh:
+        try:
+            with open(args.fresh, encoding="utf-8") as f:
+                entry = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench_compare: cannot read {args.fresh}: {e}",
+                  file=sys.stderr)
+            return 2
+        if "keys" not in entry:
+            entry = {"keys": entry, "nodes": 0, "pods": 0,
+                     "platform": "unknown", "source": "bench-check"}
+    elif args.capture:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        nodes = int(os.environ.get("MINISCHED_BENCH_NODES", "500"))
+        pods = int(os.environ.get("MINISCHED_BENCH_PODS", "250"))
+        entry = capture(nodes, pods, args.rounds)
+    else:
+        print("bench_compare: need --capture or --fresh", file=sys.stderr)
+        return 2
+
+    base = latest_baseline(ledger, entry.get("nodes", 0),
+                           entry.get("pods", 0),
+                           entry.get("platform", "unknown"),
+                           source=entry.get("source", "bench-check"))
+    if args.update:
+        import bench
+
+        bench.append_ledger(entry, args.ledger)
+    if base is None:
+        report = {"ok": args.update, "baseline": None,
+                  "fresh": entry,
+                  "note": ("no comparable baseline in the ledger "
+                           f"(shape {entry.get('nodes')}x"
+                           f"{entry.get('pods')} on "
+                           f"{entry.get('platform')})"
+                           + ("; appended as the new baseline"
+                              if args.update else ""))}
+        print(json.dumps(report, indent=1))
+        return 0 if args.update else 2
+    report = compare(entry["keys"], base["keys"],
+                     scale=args.tolerance_scale)
+    report["baseline_ts"] = base.get("ts")
+    report["fresh_keys"] = entry["keys"]
+    print(json.dumps(report, indent=1))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,10 @@ timeline order. The same file loads in Perfetto (ui.perfetto.dev),
 chrome://tracing, or TensorBoard's trace viewer for the graphical
 timeline.
 
+CI-gating exit codes: 0 = valid (an EMPTY/unarmed trace is valid and
+reported as such, never a stack trace), 1 = unreadable input, 2 =
+schema violation.
+
 Importable pieces (tests/test_obs.py and tools/bench_trace.py use
 them):
 
@@ -120,15 +124,37 @@ def thread_coverage(doc: dict) -> Dict[str, float]:
     return out
 
 
-def main() -> None:
+def main() -> int:
+    """CLI entry. CI-gating exit codes: 0 = valid (including a valid
+    EMPTY/unarmed trace, which prints a note instead of a stack
+    trace), 1 = unreadable input (missing file / not JSON), 2 = schema
+    violation."""
     ap = argparse.ArgumentParser()
     ap.add_argument("trace", help="Chrome trace-event JSON "
                                   "(Scheduler.dump_trace output)")
     ap.add_argument("--thread", default=None,
                     help="only summarize spans from this thread name")
     args = ap.parse_args()
-    doc = json.load(open(args.trace, encoding="utf-8"))
-    validate(doc)
+    try:
+        with open(args.trace, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"trace_view: cannot read {args.trace}: {e}",
+              file=sys.stderr)
+        return 1
+    try:
+        validate(doc)
+    except ValueError as e:
+        print(f"trace_view: schema violation in {args.trace}: {e}",
+              file=sys.stderr)
+        return 2
+    if not any(e.get("ph") != "M" for e in doc["traceEvents"]):
+        # A valid-but-empty export (recorder unarmed, or armed with no
+        # traffic) is a normal artifact, not an error — dump_trace
+        # writes exactly this with MINISCHED_TRACE unset.
+        print(f"{args.trace}: empty trace (0 events — recorder "
+              "unarmed or no traffic recorded)")
+        return 0
     labels = _thread_labels(doc)
     if args.thread:
         keep = {tid for tid, n in labels.items() if args.thread in n}
@@ -156,6 +182,7 @@ def main() -> None:
         for e in sorted(instants, key=lambda e: e["ts"]):
             print(f"  {e['ts'] / 1e3:>12.3f} ms  {e['name']}"
                   f"  {e.get('args') or ''}")
+    return 0
 
 
 if __name__ == "__main__":
