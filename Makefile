@@ -13,11 +13,11 @@ CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 SHELL := /bin/bash
 
 .PHONY: test tier1 fault-smoke shortlist-smoke trace-smoke slo-smoke \
-        churn-smoke profile-smoke start start-remote start-client-engine \
-        demo docs bench bench_sharded bench-cpu bench-pipeline \
-        bench-residency bench-shortlist bench-trace bench-slo \
-        bench-churn bench-check dryrun dryrun-dcn soak soak-faults \
-        soak-churn
+        churn-smoke overload-smoke profile-smoke start start-remote \
+        start-client-engine demo docs bench bench_sharded bench-cpu \
+        bench-pipeline bench-residency bench-shortlist bench-trace \
+        bench-slo bench-churn bench-overload bench-check dryrun \
+        dryrun-dcn soak soak-faults soak-churn soak-overload
 
 # Unit + integration suite on a virtual 8-device CPU mesh.
 test:
@@ -65,13 +65,25 @@ churn-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_lifecycle.py -x -q \
 	  -p no:cacheprovider -p no:randomly
 
+# Fast deterministic overload-control suite (~2 min): controller-off
+# bit-identity per engine mode, ladder hysteresis (no flapping under
+# an oscillating burn/clean input), saturating-burst shedding that
+# loses nothing (oracle-checked), brownout engage/recover in ladder
+# order, the apiserver 429 verdict, and the RemoteStore circuit
+# breaker. A tier-1 prerequisite after slo-smoke: the layer that
+# ACTUATES on the sentinel's verdicts must itself be pinned.
+overload-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_overload.py -x -q \
+	  -p no:cacheprovider -p no:randomly
+
 # The EXACT ROADMAP tier-1 verify command (dots count + exit code
 # preserved) — what the driver runs after every PR; run it locally
 # before shipping. shortlist-smoke runs first: the arbitration
 # exactness contract gates the rest of the suite; trace-smoke next: the
-# measurement layer must not perturb decisions; churn-smoke last: the
-# lifecycle oracle rides on both.
-tier1: shortlist-smoke trace-smoke slo-smoke churn-smoke
+# measurement layer must not perturb decisions; overload-smoke after
+# slo-smoke (the actuator rides the sentinel); churn-smoke last: the
+# lifecycle oracle rides on all of them.
+tier1: shortlist-smoke trace-smoke slo-smoke overload-smoke churn-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -184,14 +196,30 @@ bench-trace:
 bench-slo:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_slo.py
 
+# Overload-control contract bench (the committed BENCH_OVERLOAD.json):
+# interleaved controller-off/on rounds of the same saturating
+# priority-mixed churn phase — off: unbounded p99 growth baseline; on:
+# counted low-priority shedding with the high-priority p99 bounded,
+# zero invariant violations, every shed pod re-admitted, and a full
+# brownout engage→recover cycle with the timeline-derived no-flap
+# check. The armed round's stable keys append to BENCH_LEDGER.json
+# (source bench-overload) so bench-check gates them.
+bench-overload:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_overload.py
+
 # Cross-run perf-regression gate: capture a fresh interleaved
 # min-of-N run at the check shape (500 x 250 CPU) and diff it against
 # the newest comparable entry of the committed BENCH_LEDGER.json with
-# noise-aware per-key-class thresholds (tools/bench_compare.py).
-# Nonzero exit = regression. Bootstrap/refresh the baseline with
-# `python tools/bench_compare.py --capture --update`.
+# noise-aware per-key-class thresholds (tools/bench_compare.py),
+# then a one-round overload capture gated on its CLAIM contract
+# (tools/bench_overload.py --check; the cross-run key diff is
+# advisory — overload keys scale with host speed). Nonzero exit =
+# regression/claim failure. Bootstrap/refresh the baselines with
+# `python tools/bench_compare.py --capture --update` /
+# `python tools/bench_overload.py --check --update`.
 bench-check:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_compare.py --capture
+	JAX_PLATFORMS=cpu $(PY) tools/bench_overload.py --check
 
 # p99-under-churn bench (the committed BENCH_CHURN.json): interleaved
 # clean/faulted lifecycle-churn rounds through bench.churn_bench —
@@ -244,4 +272,17 @@ soak-churn:
 	  echo "soak-churn iteration $$i (MINISCHED_LIFECYCLE_SEED=$$i)"; \
 	  MINISCHED_LIFECYCLE_SEED=$$i MINISCHED_FAULT_SEED=$$i $(CPU_MESH) \
 	    $(PY) -m pytest tests/test_lifecycle.py -x -q || exit 1; \
+	done
+
+# Composed fault+overload ladder soak: repeat the overload suite
+# reseeding the lifecycle generator streams AND the fault PRNG per
+# iteration — each run lands the injected faults and the saturation
+# curve on different interleavings of the two ladders, while any
+# failing iteration replays exactly from its seeds.
+soak-overload:
+	@for i in $$(seq 1 $(SOAK_N)); do \
+	  echo "soak-overload iteration $$i (MINISCHED_LIFECYCLE_SEED=$$i)"; \
+	  MINISCHED_LIFECYCLE_SEED=$$i MINISCHED_FAULT_SEED=$$i \
+	    JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_overload.py -x -q \
+	    -p no:cacheprovider -p no:randomly || exit 1; \
 	done

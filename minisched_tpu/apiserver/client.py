@@ -33,6 +33,7 @@ from ..errors import (AlreadyExistsError, ConflictError, NotFoundError,
                       UnauthorizedError, WatchFellBehindError)
 from ..faults import FAULTS, FaultInjected
 from ..state import objects as obj
+from ..utils.breaker import BreakerOpenError, CircuitBreaker
 from ..utils.retry import jittered_delays
 
 log = logging.getLogger(__name__)
@@ -85,7 +86,9 @@ class RemoteStore:
     def __init__(self, address: str, timeout: float = 10.0,
                  token: Optional[str] = None,
                  qps: float = 5000.0, burst: int = 5000,
-                 retry_deadline_s: float = 5.0):
+                 retry_deadline_s: float = 5.0,
+                 breaker_threshold: int = 6,
+                 breaker_reset_s: float = 0.5):
         """``retry_deadline_s``: transient failures (connection refused/
         reset, 5xx, malformed frames) are retried with jittered
         exponential backoff until this much wall time has passed, then
@@ -93,9 +96,22 @@ class RemoteStore:
         wire does not fail the first engine call that hits it. 0
         disables (every failure propagates immediately, the pre-retry
         behavior). Mutating verbs only retry failures that provably
-        precede application (see _transient)."""
+        precede application (see _transient).
+
+        A shared circuit breaker (utils/breaker.py) fronts the retry
+        loop: ``breaker_threshold`` consecutive wire-class failures —
+        across ALL threads, this is the client-wide health verdict —
+        open it, after which a hard-down server is PROBED once per
+        ``breaker_reset_s`` instead of hammered with a fresh connection
+        per retry slot per thread until every deadline lapses. Calls
+        arriving while it is open sleep toward the probe slot (still
+        bounded by their own retry deadline). ``breaker_threshold=0``
+        disables. State/counters surface via :meth:`breaker_stats` and
+        the engine's ``/metrics`` (``store_breaker_*``)."""
         self.address = address.rstrip("/")
         self.retry_deadline_s = retry_deadline_s
+        self.breaker = (CircuitBreaker(breaker_threshold, breaker_reset_s)
+                        if breaker_threshold > 0 else None)
         u = urllib.parse.urlparse(self.address)
         if u.scheme not in ("http", "https"):
             raise ValueError(f"unsupported scheme in {address!r}; "
@@ -214,19 +230,62 @@ class RemoteStore:
                     if self.retry_deadline_s > 0 else None)
         delays = jittered_delays(initial_duration=0.05, factor=2.0,
                                  max_duration=1.0)
+        last_err: Optional[Exception] = None
         while True:
+            if self.breaker is not None and not self.breaker.allow():
+                # Open breaker: the server is known-down — don't touch
+                # the socket. Sleep toward the next probe slot (bounded
+                # by this call's own deadline) instead of burning a
+                # retry on a guaranteed connection failure.
+                e: Exception = BreakerOpenError(
+                    f"circuit open to {self.address}")
+                if last_err is not None:
+                    e.__cause__ = last_err
+                now = time.monotonic()
+                if deadline is None or now >= deadline:
+                    raise e
+                wait = max(self.breaker.next_probe_in(), 0.01)
+                time.sleep(min(wait, deadline - now))
+                continue
             try:
                 FAULTS.hit("http")  # fault gate: RemoteStore HTTP
-                return self._call_once(method, path, body=body,
-                                       timeout=timeout, _retries=_retries)
+                out = self._call_once(method, path, body=body,
+                                      timeout=timeout, _retries=_retries)
+                if self.breaker is not None:
+                    self.breaker.record_success()
+                return out
             except (NotFoundError, UnauthorizedError, AlreadyExistsError,
                     ConflictError, WatchFellBehindError):
-                raise  # typed API verdicts are answers, not failures
+                # typed API verdicts are answers, not failures — the
+                # wire is healthy, the breaker heals on them
+                if self.breaker is not None:
+                    self.breaker.record_success()
+                raise
             except Exception as e:
+                # Remaining failures are wire-shaped (refused/reset/
+                # timeout/5xx/malformed/injected) — feed the breaker
+                # even when THIS verb cannot safely retry (a
+                # mid-mutation disconnect still proves the server
+                # unhealthy; the ambiguity stays the caller's). A
+                # non-5xx _ServerError is an ANSWER (the server is up,
+                # the request was bad) and heals the breaker instead.
+                if self.breaker is not None:
+                    if (isinstance(e, _ServerError)
+                            and not 500 <= e.status < 600):
+                        self.breaker.record_success()
+                    else:
+                        self.breaker.record_failure()
+                last_err = e
                 now = time.monotonic()
                 if (deadline is None or now >= deadline
                         or not self._transient(e, method)):
                     raise
+                if (self.breaker is not None
+                        and self.breaker.state != 0):
+                    # Breaker tripped: it owns the pacing from here —
+                    # the top of the loop sleeps toward the probe slot
+                    # instead of this schedule's jittered dial-retry.
+                    continue
                 sleep = min(next(delays), max(0.0, deadline - now))
                 log.warning("transient apiserver failure (%s %s: %s); "
                             "retrying in %.2fs", method, path, e, sleep)
@@ -405,6 +464,12 @@ class RemoteStore:
             return bool(self._call("GET", "/healthz").get("ok"))
         except Exception:
             return False
+
+    def breaker_stats(self) -> dict:
+        """Circuit-breaker state/counters for the /metrics surface
+        (Scheduler.metrics() prefixes these ``store_``). Empty when the
+        breaker is disabled."""
+        return self.breaker.stats() if self.breaker is not None else {}
 
 
 class RemoteWatcher:

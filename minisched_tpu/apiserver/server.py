@@ -128,6 +128,15 @@ class APIServer:
         # ``timeline()``; the endpoint merges every provider into one
         # JSON body. Providers must be thread-safe.
         self.timeline_providers: list = []
+        # Overload admission extension point: callables returning None
+        # (admit) or a reason string — a non-None verdict rejects POD
+        # creates with a typed 429 (reason ``SchedulerOverloaded`` +
+        # Retry-After), the k8s APF-style backpressure remote producers
+        # honor by backing off. A co-located SchedulerService appends
+        # ``admission_reject_reason`` (engine/overload.py); only pod
+        # creates are gated — node adds / deletes / binds must keep
+        # flowing, they are what RECOVERS an overloaded cluster.
+        self.admission_providers: list = []
         # server-side request counters for /metrics (lock-guarded)
         self._counters: dict = {}
         self._counters_lock = threading.Lock()
@@ -148,7 +157,8 @@ class APIServer:
                                 self._counters_lock, self.checkpointer,
                                 self._mutating_cv, self._track_mutation,
                                 self._draining, self.histogram_providers,
-                                self.timeline_providers)
+                                self.timeline_providers,
+                                self.admission_providers)
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
@@ -204,7 +214,8 @@ def _make_handler(store: ClusterStore, token: str | None = None,
                   checkpointer=None, mutating_cv=None,
                   track_mutation=None, draining=None,
                   histogram_providers: list | None = None,
-                  timeline_providers: list | None = None):
+                  timeline_providers: list | None = None,
+                  admission_providers: list | None = None):
     if counters is None:
         counters = {}
     if counters_lock is None:
@@ -599,6 +610,27 @@ def _make_handler(store: ClusterStore, token: str | None = None,
                 return self._guard(run)
             if kind is None:
                 return self._error(404, "no route")
+            if kind == "Pod" and admission_providers:
+                # Overload backpressure: a co-located engine at its
+                # shed/brownout rung answers pod creates with a typed
+                # 429-style verdict (counted, Retry-After) — the wire
+                # analog of the queue-ingress shed lane. Only POD
+                # creates: capacity-adding traffic must keep flowing.
+                reason = None
+                for provider in admission_providers:
+                    try:
+                        reason = provider()
+                    except Exception:
+                        log.exception("admission provider failed")
+                        reason = None
+                    if reason:
+                        break
+                if reason:
+                    bump("rejected_overloaded")
+                    self._drain_body()
+                    return self._error(429, reason,
+                                       reason="SchedulerOverloaded",
+                                       headers={"Retry-After": "1"})
 
             def run():
                 body = self._body()

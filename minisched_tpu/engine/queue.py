@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
+from ..faults import FAULTS, FaultInjected
 from ..obs import span
 from ..state.events import ClusterEvent
 from ..state.objects import Pod, gang_key
@@ -66,9 +67,13 @@ class QueuedPodInfo:
     gathered_at: float = 0.0
     decided_at: float = 0.0
     # Which sub-queue holds the pod ("active" | "backoff" | "unsched" |
-    # "popped") — lets update/delete be O(1) dict lookups instead of the
-    # linear scans the round-1 design used (quadratic churn at 10k+ pods).
+    # "shed" | "popped") — lets update/delete be O(1) dict lookups
+    # instead of the linear scans the round-1 design used (quadratic
+    # churn at 10k+ pods).
     where: str = "active"
+    # Times this pod was parked in the overload shed lane (doubles the
+    # shed backoff per re-shed, up to the ceiling).
+    shed_count: int = 0
     # Lazy-deletion marker: list/heap entries for a deleted pod stay in
     # place and are skipped at pop/flush time (heap removal is O(n)).
     gone: bool = False
@@ -109,6 +114,19 @@ class SchedulingQueue:
         # no-registered-interest gate.
         self._moves = 0
         self._move_skips = 0
+        # Overload shed lane (engine/overload.py): NEW arrivals the
+        # admission gate declines park here — a heap of (ready, seq,
+        # qpi) like backoffQ, drained by the flusher, which re-offers
+        # each due entry to the gate (still shedding ⇒ re-park with
+        # doubled backoff; recovered ⇒ activeQ). Counted, never
+        # dropped: the lifecycle no_pod_lost oracle covers it.
+        self._shed: List = []
+        self._shed_live = 0
+        self._shed_total = 0       # shed EVENTS (re-parks included)
+        self._shed_pods = 0        # unique pods ever shed (first park)
+        self._shed_readmitted = 0
+        self._admission = None  # callable(pod) -> bool, or None
+        self._shed_backoff_fn = None  # () -> (initial_s, max_s), live
         self._closed = False
         self._flusher = threading.Thread(
             target=self._flush_loop, args=(flush_interval,), daemon=True,
@@ -117,13 +135,57 @@ class SchedulingQueue:
 
     # ---- producers ------------------------------------------------------
 
+    def set_admission(self, fn, *, backoff_fn=None) -> None:
+        """Install the overload admission gate at the ingress seam:
+        ``fn(pod) -> bool`` (False = park in the shed lane). The gate is
+        consulted for NEW arrivals and for due shed entries at flush
+        time — requeues of in-flight pods never shed (backpressure
+        applies at ingress, not to work already admitted).
+        ``backoff_fn() -> (initial_s, max_s)`` resolves the shed-lane
+        backoff at each park, so knobs reconfigured on a LIVE engine
+        (overload.configure between runs) take effect instead of
+        latching the construction-time values. ``None`` uninstalls /
+        keeps the defaults."""
+        with self._cond:
+            self._admission = fn
+            self._shed_backoff_fn = backoff_fn
+
+    def _ingress_fault(self) -> bool:
+        """The ``admission`` fault gate (faults.py), hit once per
+        ingress transaction (the per-batch-seam discipline). ``corrupt``
+        force-sheds the whole transaction — the chaos handle on the
+        shed path (pods re-admit via the flusher; nothing is lost);
+        ``err`` models the verdict machinery failing and FAILS OPEN
+        (admit — a broken gate must not drop ingress); ``stall`` sleeps
+        in the registry. Never called under the queue lock."""
+        try:
+            return FAULTS.hit("admission") == "corrupt"
+        except FaultInjected:
+            return False
+
+    def _admits(self, pod: Pod) -> bool:
+        """Consult the installed admission gate (caller may hold the
+        lock — the gate is a plain int compare on the overload
+        controller). A raising gate fails open."""
+        fn = self._admission
+        if fn is None:
+            return True
+        try:
+            return bool(fn(pod))
+        except Exception:
+            return True
+
     def add(self, pod: Pod) -> None:
         """New unscheduled pod (reference queue.go:35-43)."""
+        forced = self._ingress_fault()
         with self._cond:
             if pod.key in self._known or self._closed:
                 return
             self._known.add(pod.key)
             qpi = QueuedPodInfo(pod=pod)
+            if forced or not self._admits(pod):
+                self._push_shed(qpi)
+                return
             self._push_active(qpi)
             self._cond.notify_all()
 
@@ -132,6 +194,7 @@ class SchedulingQueue:
         a whole arrival burst (per-pod adds wake the batch-gathering
         ``pop_batch`` thread once per pod — 10k context-switch round-trips
         per workload submission)."""
+        forced = self._ingress_fault()
         with self._cond:
             if self._closed:
                 return
@@ -140,7 +203,11 @@ class SchedulingQueue:
                 if pod.key in self._known:
                     continue
                 self._known.add(pod.key)
-                self._push_active(QueuedPodInfo(pod=pod))
+                qpi = QueuedPodInfo(pod=pod)
+                if forced or not self._admits(pod):
+                    self._push_shed(qpi)
+                    continue
+                self._push_active(qpi)
                 added = True
             if added:
                 self._cond.notify_all()
@@ -174,6 +241,8 @@ class SchedulingQueue:
                 self._active_live -= 1
             elif qpi.where == "backoff":
                 self._backoff_live -= 1
+            elif qpi.where == "shed":
+                self._shed_live -= 1
             elif qpi.where == "unsched":
                 self._unschedulable.pop(key, None)
 
@@ -391,13 +460,20 @@ class SchedulingQueue:
         boundary splitting a gang would otherwise reject it for missing
         quorum). Members still in their backoff window are pulled too —
         gang activation bypasses backoff, like upstream coscheduling's
-        sibling activation — but parked unschedulable members are left to
+        sibling activation — and so are SHED members (a gang split
+        across the shedding transition would otherwise miss quorum on
+        every attempt until the lane drained, and a shed-lane
+        readmission fires no reviving ClusterEvent for the parked
+        siblings). Parked unschedulable members are left to
         event-driven revival. Non-blocking."""
         with self._cond:
             members = [q for q in self._active
                        if not q.gone and gang_key(q.pod) == group]
             in_backoff = [e for e in self._backoff
                           if not e[2].gone and gang_key(e[2].pod) == group]
+            in_shed = [e for e in self._shed
+                       if not e[2].gone and e[2].where == "shed"
+                       and gang_key(e[2].pod) == group]
             if members:
                 self._active = [q for q in self._active
                                 if q.gone or gang_key(q.pod) != group]
@@ -408,6 +484,13 @@ class SchedulingQueue:
                 heapq.heapify(self._backoff)
                 self._backoff_live -= len(in_backoff)
                 members.extend(e[2] for e in in_backoff)
+            if in_shed:
+                self._shed = [e for e in self._shed
+                              if e[2].gone or e[2].where != "shed"
+                              or gang_key(e[2].pod) != group]
+                heapq.heapify(self._shed)
+                self._shed_live -= len(in_shed)
+                members.extend(e[2] for e in in_shed)
             for qpi in members:
                 self._mark_popped(qpi)
             return members
@@ -425,7 +508,11 @@ class SchedulingQueue:
                     "backoff": self._backoff_live,
                     "unschedulable": len(self._unschedulable),
                     "moves": self._moves,
-                    "move_skips": self._move_skips}
+                    "move_skips": self._move_skips,
+                    "shed": self._shed_live,
+                    "shed_total": self._shed_total,
+                    "shed_pods": self._shed_pods,
+                    "shed_readmitted": self._shed_readmitted}
 
     def unschedulable_keys(self) -> Set[str]:
         with self._cond:
@@ -455,6 +542,49 @@ class SchedulingQueue:
         # so "seq unchanged across a grace period" means the queue is
         # genuinely quiescent, not merely between condvar wakeups.
         self._arrival_seq += 1
+
+    def _push_shed(self, qpi: QueuedPodInfo) -> None:
+        """Park a declined arrival in the shed lane (caller holds the
+        lock): counted, indexed, backoff doubling per re-shed up to the
+        ceiling. The flusher re-offers due entries to the gate, so a
+        shed pod ALWAYS re-enters scheduling once the overload clears
+        (or at the ceiling cadence while it persists)."""
+        qpi.where, qpi.gone = "shed", False
+        self._index[qpi.key] = qpi
+        initial, ceiling = 0.5, 5.0
+        if self._shed_backoff_fn is not None:
+            try:
+                initial, ceiling = self._shed_backoff_fn()
+            except Exception:
+                pass  # a broken knob source must not drop the park
+        ready = time.monotonic() + min(
+            initial * (2 ** min(qpi.shed_count, 30)), ceiling)
+        if qpi.shed_count == 0:
+            self._shed_pods += 1
+        qpi.shed_count += 1
+        self._shed_total += 1
+        heapq.heappush(self._shed, (ready, next(self._seq), qpi))
+        self._shed_live += 1
+
+    def release_shed(self) -> int:
+        """Overload cleared below the shedding rung: re-admit EVERY shed
+        pod to activeQ now instead of waiting out each backoff. Returns
+        the count."""
+        with self._cond:
+            moved = 0
+            now = time.monotonic()
+            for _ready, _seq, qpi in self._shed:
+                if qpi.gone or qpi.where != "shed":
+                    continue
+                qpi.added_at = now  # queue wait restarts at readmission
+                self._push_active(qpi)
+                moved += 1
+            self._shed = []
+            self._shed_live = 0
+            self._shed_readmitted += moved
+            if moved:
+                self._cond.notify_all()
+            return moved
 
     def _push_backoff(self, qpi: QueuedPodInfo,
                       ready: Optional[float] = None) -> None:
@@ -506,6 +636,42 @@ class SchedulingQueue:
                     self._backoff_live -= 1
                     self._push_active(qpi)
                     fired = True
+                # Shed lane: each due entry is RE-OFFERED to the
+                # admission gate — recovered ⇒ activeQ (counted
+                # readmission); still shedding ⇒ re-park with doubled
+                # backoff. This is the never-dropped guarantee: a shed
+                # pod keeps knocking at the ceiling cadence forever.
+                # A DRAINED activeQ overrides a shedding verdict: the
+                # overload controller only observes windows while
+                # batches resolve, so an engine that went idle with
+                # shed work parked would otherwise hold its last level
+                # forever — and an idle engine is, by definition, not
+                # overloaded (re-admitted pods then produce the clean
+                # windows that walk the controller back down).
+                # Snapshotted BEFORE the drain: the first readmission
+                # makes activeQ non-empty, and re-testing live would
+                # dribble one pod per flush pass out of a lane the
+                # idle override means to release wholesale.
+                idle = self._active_live == 0
+                while self._shed and self._shed[0][0] <= now:
+                    _, _, qpi = heapq.heappop(self._shed)
+                    if qpi.gone or qpi.where != "shed":
+                        continue
+                    self._shed_live -= 1
+                    if idle or self._admits(qpi.pod):
+                        # Queue-wait restarts at readmission: the shed
+                        # park is ADMISSION latency (counted here and
+                        # visible in create→bound), not active-queue
+                        # residency — without the re-stamp, every
+                        # readmitted pod's bind would re-burn the
+                        # queue-wait SLO with the PAST overload's wait
+                        # and hold the controller engaged forever.
+                        qpi.added_at = now
+                        self._push_active(qpi)
+                        self._shed_readmitted += 1
+                        fired = True
+                    else:
+                        self._push_shed(qpi)
                 if fired:
                     self._cond.notify_all()
             time.sleep(interval)

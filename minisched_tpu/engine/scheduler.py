@@ -43,6 +43,7 @@ from ..ops.residency import (I16_SAT, apply_rows, apply_rows_bytes,
 from ..plugins.base import PluginSet
 from ..state.events import ActionType, ClusterEvent, EventBroadcaster, GVK
 from ..state.objects import Pod, claim_keys, gang_key
+from . import overload as overload_mod
 from .queue import (BATCH_CAPACITY, COSCHEDULING, QueuedPodInfo,
                     SchedulingQueue)
 from .waitingpod import WaitingPod
@@ -1172,6 +1173,23 @@ class Scheduler:
         # config at first armed tick (tests re-arm between runs).
         self._slo_sentinel: Optional[slo_mod.SLOSentinel] = None
         self._slo_epoch = -1
+        # Adaptive overload controller (engine/overload.py,
+        # MINISCHED_OVERLOAD): SLO-actuated admission control,
+        # adaptive batch/shortlist tuning, and the brownout ladder.
+        # Always constructed (cheap ints); every hook gates on the
+        # process-wide enabled flag or the controller's level, so the
+        # disarmed hot-path cost is one attribute/int test and
+        # decisions stay bit-identical (tests/test_overload.py).
+        self._overload = overload_mod.OverloadController()
+        # Base shortlist width the tuner retunes around; a permanent
+        # certification revert (_disable_shortlist → None) wins over
+        # any tuner target. Revisited widths cost no recompile:
+        # ops/pipeline's process-wide _STEP_CACHE keys on ``shortlist``.
+        self._sl_base = self._shortlist_k
+        self.queue.set_admission(
+            self._overload.admits,
+            backoff_fn=lambda: (overload_mod.OVERLOAD.shed_backoff,
+                                overload_mod.OVERLOAD.shed_backoff_max))
 
     def _sup_count(self, key: str, n: int = 1) -> None:
         # get-based: per-objective SLO alert counters are created on
@@ -1475,10 +1493,10 @@ class Scheduler:
             return
         last_done = None
         while not self._stop.is_set():
+            max_n, window, idle = self._pop_params()
             batch = self.queue.pop_batch(
-                self.config.max_batch_size, timeout=0.2,
-                gather_window=self.config.batch_window_s,
-                gather_idle=self.config.batch_idle_s)
+                max_n, timeout=0.2, gather_window=window,
+                gather_idle=idle)
             if not batch:
                 # Genuine idle (no pending pods) is not inter-batch
                 # overhead; only back-to-back batches feed the gap metric.
@@ -1524,10 +1542,10 @@ class Scheduler:
         last_done = None
 
         def pop():
+            max_n, window, idle = self._pop_params()
             return self.queue.pop_batch(
-                self.config.max_batch_size, timeout=0.2,
-                gather_window=self.config.batch_window_s,
-                gather_idle=self.config.batch_idle_s)
+                max_n, timeout=0.2, gather_window=window,
+                gather_idle=idle)
 
         try:
             while not self._stop.is_set():
@@ -1610,6 +1628,21 @@ class Scheduler:
                 for qpi in gather_fut.result():
                     self.queue.requeue_backoff(qpi)
             self._await_commit(pending)
+
+    def _pop_params(self):
+        """(max_n, gather_window, gather_idle) for the next queue pop:
+        the config bases, unless the overload tuner is engaged — then
+        the effective knobs (batch stepped down toward ``min_batch``,
+        formation window stepped up) apply. At tune depth 0 (the
+        disarmed/normal state) the bases pass through untouched, so
+        decision streams are bit-identical to an untuned engine."""
+        cfg = self.config
+        ov = self._overload
+        if ov.tune_steps == 0:
+            return cfg.max_batch_size, cfg.batch_window_s, cfg.batch_idle_s
+        return (ov.effective_max_batch(cfg.max_batch_size),
+                ov.effective_window(cfg.batch_window_s),
+                ov.effective_idle(cfg.batch_idle_s))
 
     def _take_gather(self, gather_fut):
         """Consume an overlapped pop, booking the BLOCKING portion of a
@@ -2185,6 +2218,7 @@ class Scheduler:
             return
         cfg = slo_mod.SLO
         if not cfg.enabled:
+            self._overload_disarm_check()
             return
         if self._slo_sentinel is None or self._slo_epoch != cfg.epoch:
             self._slo_sentinel = slo_mod.SLOSentinel.from_config(cfg)
@@ -2198,6 +2232,78 @@ class Scheduler:
             self._sup.early_warning(f"slo:{alert['slo']}")
         for name in self._slo_sentinel.last_cleared:
             instant("slo.clear", slo=name)
+        if overload_mod.OVERLOAD.enabled:
+            self._drive_overload(entry)
+        else:
+            self._overload_disarm_check()
+
+    def _overload_disarm_check(self) -> None:
+        """A runtime disarm (overload.configure("")) must not leave the
+        controller's latched actuation applied: every cross-thread hook
+        already gates on the enabled flag, and this snapshot-cadence
+        check neutralizes the stateful residue — the controller's level
+        machine, the timeline stretch, a retuned shortlist width, and
+        any parked shed pods. (After a FULL telemetry disarm no ticks
+        run at all, but then the enabled-gated hooks alone restore every
+        effective knob, the flusher re-admits shed pods via the open
+        gate, and a tuner-moved shortlist width — exact at any K —
+        persists only until restart or re-arm.)"""
+        if not self._overload.note_window(set()):
+            return
+        self._timeline.stretch = 1
+        want = self._sl_base
+        if (self._shortlist_k is not None and want is not None
+                and self._shortlist_k != want and self._mesh is None):
+            self._shortlist_k = want
+            self._step = build_step(self.plugin_set,
+                                    explain=self.config.explain,
+                                    assignment=self.config.assignment,
+                                    shortlist=want)
+        n = self.queue.release_shed()
+        log.info("overload controller disarmed at runtime; actuation "
+                 "neutralized (%d shed pod(s) released)", n)
+
+    def _drive_overload(self, entry: dict) -> None:
+        """Feed the overload controller one snapshot window (scheduling
+        thread, at sentinel cadence) and apply whatever actuation
+        changed. The controller sees only the sentinel's SYMPTOM burn
+        verdicts — the degraded-posture objective is excluded for the
+        same livelock reason the supervisor's probation gate excludes
+        it (load shedding must not hold itself engaged just because the
+        fault ladder is off the fast path)."""
+        sent = self._slo_sentinel
+        burning = {s.name for s in sent.specs
+                   if s.kind != "degraded" and sent.burning.get(s.name)}
+        ov = self._overload
+        prev_shedding = ov.shedding
+        if not ov.note_window(burning,
+                              entry.get("d_shortlist_repairs", 0.0)):
+            return
+        # Shortlist retune: always within the certified machinery (any
+        # K is exact — repairs absorb a narrow one); a permanent
+        # certification revert (_shortlist_k = None) wins forever.
+        want = ov.shortlist_target(self._sl_base)
+        if (self._shortlist_k is not None and want is not None
+                and want != self._shortlist_k and self._mesh is None):
+            log.warning("overload tuner: shortlist K %d -> %d",
+                        self._shortlist_k, want)
+            self._shortlist_k = want
+            # build_step memoizes process-wide on the shortlist width,
+            # so ladder revisits reuse the compiled step
+            self._step = build_step(self.plugin_set,
+                                    explain=self.config.explain,
+                                    assignment=self.config.assignment,
+                                    shortlist=want)
+        # Brownout quality shed: stretch the timeline cadence while
+        # level 3 holds (restored on recovery).
+        self._timeline.stretch = ov.timeline_stretch
+        # Recovery below the shedding rung: re-admit every parked pod
+        # now rather than waiting out each shed backoff.
+        if prev_shedding and not ov.shedding:
+            n = self.queue.release_shed()
+            if n:
+                log.info("overload recovered below shedding; re-admitted "
+                         "%d shed pod(s)", n)
 
     def _slo_burning_any(self) -> bool:
         """Is any SYMPTOM objective of the CURRENT sentinel burning?
@@ -2221,6 +2327,13 @@ class Scheduler:
         attribution tags) and the SLO alert log. Empty-but-valid when
         MINISCHED_TIMELINE is unset."""
         return self._timeline.to_doc()
+
+    def overload_reject_reason(self) -> Optional[str]:
+        """The apiserver admission provider's per-engine verdict: a
+        reason string while this engine's overload controller is at or
+        past its HTTP-reject rung (counted in admission_rejects_total),
+        else None. Any-thread safe (int reads)."""
+        return self._overload.http_reject_reason()
 
     def _rollback_assumed(self, inf: "_InflightBatch") -> None:
         if not inf.assumed:
@@ -2375,7 +2488,11 @@ class Scheduler:
         for qpi in batch:
             qpi.decided_at = now_mono
 
-        if self.recorder is not None:
+        if self.recorder is not None and not self._overload.explain_skip():
+            # Brownout (overload level 3) pauses explain ingestion —
+            # optional quality shed before latency; the skip is counted
+            # (overload_explain_skipped) so the result-store gap stays
+            # attributable.
             self.recorder.record_batch(pods, names, decision, self.plugin_set)
 
         revoked, parked_gangs = (
@@ -2953,7 +3070,11 @@ class Scheduler:
         cfg = self.config
         if cfg.explain or full_axis:
             return None, None
-        pct = cfg.percentage_of_nodes_to_score
+        # Brownout (overload level 3) pulls the dial down to
+        # ``brownout_pct`` — the percentageOfNodesToScore knob engaged
+        # as a load-shed actuation instead of a static setting.
+        pct = self._overload.effective_pct_nodes(
+            cfg.percentage_of_nodes_to_score)
         if pct >= 100:
             return None, None
         n_real = self.cache.node_count()
@@ -3666,6 +3787,28 @@ class Scheduler:
         # name for humans/tests (non-numeric — dropped from exposition).
         out["degradation_level"] = self._sup.level
         out["degradation_state"] = DEGRADATION_LADDER[self._sup.level]
+        # Overload-controller state (engine/overload.py): the actuation
+        # rung, transition/tuner counters, brownout flag, admission
+        # rejects, and the live effective knobs — with the flat
+        # ``shed_total`` alias beside the queue_-prefixed stats so the
+        # shed ledger has one canonical scrape name. All zeros / bases
+        # with MINISCHED_OVERLOAD unset.
+        out.update(self._overload.metrics())
+        out["shed_total"] = out.get("queue_shed_total", 0)
+        out["overload_max_batch"] = self._overload.effective_max_batch(
+            self.config.max_batch_size)
+        out["overload_window_s"] = self._overload.effective_window(
+            self.config.batch_window_s)
+        out["overload_shortlist_k"] = int(self._shortlist_k or 0)
+        # RemoteStore circuit-breaker state (utils/breaker.py) when this
+        # engine runs as a pure network client: closed→open→half-open
+        # gauge + transition/fast-fail/probe counters, so one scrape of
+        # a co-located /metrics shows whether the client is probing a
+        # down apiserver instead of hammering it.
+        breaker_stats = getattr(self.store, "breaker_stats", None)
+        if callable(breaker_stats):
+            for k, v in breaker_stats().items():
+                out[f"store_{k}"] = v
         # Temporal telemetry: snapshot/drop counts for the timeline
         # ring and the per-objective burning gauges (1 while an SLO's
         # burn windows are both over threshold — the sentinel clears

@@ -31,6 +31,13 @@ production scheduler's failure domain spans:
                 orchestrator tick), ``corrupt`` burns one PRNG draw
                 (deterministic schedule perturbation), ``stall``
                 delays the step.
+    admission   queue-ingress admission gate (engine/queue.py) —
+                ``corrupt`` force-sheds the transaction's pods into the
+                overload shed lane (exercising the shed/readmit path
+                even with the controller off — nothing is lost, the
+                flusher re-admits), ``err`` models the verdict
+                machinery failing and the ingress FAILS OPEN (admit),
+                ``stall`` delays the ingress transaction.
 
 Configured once per process from ``MINISCHED_FAULTS`` (tests reconfigure
 via :func:`configure`), a comma-separated list of ``gate:action@trigger``
@@ -90,8 +97,12 @@ log = logging.getLogger(__name__)
 
 #: The gate catalog; hit() rejects unknown names so a typo in a rule or a
 #: call site cannot silently never fire.
+# "admission" appends LAST: per-gate PRNG streams seed by catalog index,
+# so appending (never inserting) keeps every existing gate's firing
+# pattern stable under a fixed seed.
 GATES = ("step", "fetch", "residency", "shortlist_repair", "commit",
-         "bind", "informer", "http", "checkpoint", "lifecycle")
+         "bind", "informer", "http", "checkpoint", "lifecycle",
+         "admission")
 
 _ACTIONS = ("err", "die", "corrupt", "stall")
 

@@ -174,6 +174,17 @@ class LifecycleView:
         self._d.record("create_pod", f"{pod.key} {_kw_detail(kw)}")
         return pod
 
+    def create_pods(self, pods: List[obj.Pod]) -> List[obj.Pod]:
+        """Bulk ledgered submission: ONE store transaction for a whole
+        arrival wave (the overload bench's open-loop saturator — per-pod
+        creates cap the achievable arrival rate at the store's per-call
+        overhead, which can undershoot the engine and never saturate)."""
+        created = self.cluster.create_objects(pods)
+        self.expected_pods.update(p.key for p in created)
+        self.count("pods_created", len(created))
+        self._d.record("create_pods", f"x{len(created)}")
+        return created
+
     def delete_pod(self, key: str) -> None:
         """Deliberate removal (a job finishing, a client cancel) — the
         ledger forgets it; only SILENT loss is a violation."""

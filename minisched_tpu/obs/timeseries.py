@@ -68,12 +68,14 @@ __all__ = ["TIMELINE", "TimelineConfig", "TimelineTracker", "configure",
 DELTA_KEYS = ("pods_bound", "pods_failed", "batch_faults",
               "quarantined_batches", "supervisor_escalations",
               "bind_conflicts", "watchdog_trips",
-              "supervisor_early_warnings")
+              "supervisor_early_warnings", "shortlist_repairs",
+              "queue_shed_total")
 
 #: Gauges copied verbatim into every snapshot.
 GAUGE_KEYS = ("batches", "pods_bound", "pods_failed", "degradation_level",
               "queue_active", "queue_backoff", "queue_unschedulable",
-              "shortlist_width", "waiting_pods")
+              "shortlist_width", "waiting_pods", "overload_level",
+              "queue_shed")
 
 
 def parse_every(tok: str):
@@ -204,6 +206,11 @@ class TimelineTracker:
         self.name = name
         self._lock = threading.Lock()  # ring/alerts reader guard
         self._epoch = -1               # forces reset on first armed tick
+        # Cadence multiplier (overload brownout: quality shed —
+        # telemetry coarsens while level 3 holds). Scheduling-thread
+        # written, read only in tick(); survives config-epoch resets
+        # (the controller, not the config, owns it).
+        self.stretch = 1
         self._reset()
 
     def _reset(self) -> None:
@@ -240,10 +247,11 @@ class TimelineTracker:
             self._last_t = now
             self._batches_since = 0
             return None
+        stretch = max(1, int(self.stretch))
         if cfg.every_batches is not None:
-            if self._batches_since < cfg.every_batches:
+            if self._batches_since < cfg.every_batches * stretch:
                 return None
-        elif now - self._last_t < (cfg.every_s or 0.0):
+        elif now - self._last_t < (cfg.every_s or 0.0) * stretch:
             return None
         return self.snapshot_now()
 

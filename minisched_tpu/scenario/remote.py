@@ -71,6 +71,10 @@ def serve(store_only: bool = False) -> None:
         # snapshot ring + SLO alert log (empty-but-valid when
         # MINISCHED_TIMELINE is unset)
         api.timeline_providers.append(svc.timeline)
+        # overload backpressure: pod creates answer a typed 429 while
+        # a co-located engine sheds (MINISCHED_OVERLOAD; a no-op when
+        # unset)
+        api.admission_providers.append(svc.admission_reject_reason)
     print(f"LISTENING {api.address}", flush=True)
     try:
         sys.stdin.read()  # parent closes the pipe → exit

@@ -117,6 +117,18 @@ class SchedulerService:
                 out[f"{name}_{k}"] = v
         return out
 
+    def admission_reject_reason(self) -> Optional[str]:
+        """The apiserver's overload admission provider
+        (``APIServer.admission_providers``): the first engine whose
+        overload controller is at/past its HTTP-reject rung supplies
+        the typed 429 reason; None admits. With MINISCHED_OVERLOAD
+        unset this is a handful of attribute tests per pod create."""
+        for engine in self._scheds.values():
+            reason = engine.overload_reject_reason()
+            if reason:
+                return reason
+        return None
+
     def timeline(self) -> Dict[str, dict]:
         """Per-profile temporal-telemetry documents (the ``GET
         /timeline`` payload): profile name → ``Scheduler.timeline()``

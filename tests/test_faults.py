@@ -581,6 +581,51 @@ def test_lifecycle_gate_skips_steps_but_loses_nothing(registry):
     d.check_invariants()
 
 
+# ---- admission gate: queue-ingress shed path (PR 10) --------------------
+
+
+def test_admission_gate_corrupt_sheds_and_flusher_readmits(registry):
+    """``admission:corrupt`` force-sheds an ingress transaction into the
+    overload shed lane even with the controller OFF — the chaos handle
+    on the shed path. Nothing is lost: the backoff flusher re-offers the
+    pod to the (absent) gate and re-admits it; ``err`` models the
+    verdict machinery dying and FAILS OPEN (the pod is admitted)."""
+    from minisched_tpu.engine.queue import SchedulingQueue
+
+    q = SchedulingQueue({}, backoff_initial=0.05, backoff_max=0.2)
+    q.set_admission(None, backoff_fn=lambda: (0.1, 0.5))
+    try:
+        _configure("admission:corrupt@1,admission:err@2")
+        p1 = obj.Pod(metadata=obj.ObjectMeta(name="shed-me",
+                                             namespace="default"),
+                     spec=obj.PodSpec(requests={"cpu": 10}))
+        q.add(p1)  # corrupt fires: force-shed
+        st = q.stats()
+        assert st["shed"] == 1 and st["shed_total"] == 1
+        assert st["active"] == 0
+        p2 = obj.Pod(metadata=obj.ObjectMeta(name="fail-open",
+                                             namespace="default"),
+                     spec=obj.PodSpec(requests={"cpu": 10}))
+        q.add(p2)  # err fires: ingress fails open, pod is admitted
+        assert q.stats()["active"] == 1
+        assert registry.counts()["admission"] == 2
+        # never dropped: the flusher re-admits the shed pod
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            st = q.stats()
+            if st["shed"] == 0 and st["active"] == 2:
+                break
+            time.sleep(0.02)
+        st = q.stats()
+        assert st["shed"] == 0 and st["active"] == 2, st
+        assert st["shed_readmitted"] == 1
+        batch = q.pop_batch(4, timeout=1.0)
+        assert {b.pod.metadata.name for b in batch} == {"shed-me",
+                                                        "fail-open"}
+    finally:
+        q.close()
+
+
 # ---- whole-suite coverage ------------------------------------------------
 
 

@@ -1,0 +1,215 @@
+"""Overload-control contract bench at CPU shapes (BENCH_OVERLOAD.json).
+
+Interleaved controller-off/on rounds of the SAME saturating
+priority-mixed churn phase (bench.overload_bench: open-loop arrivals
+over a deliberately throttled engine, lifecycle invariants enforced
+after every event), proving the acceptance claims of the overload
+layer:
+
+  * with the controller OFF, ingress is unbounded: the high-priority
+    class's create→bound p99 grows with the burst (the unprotected
+    baseline the artifact records);
+  * with it ON, the ladder climbs, LOW-priority arrivals shed into the
+    counted lane (nonzero shed fraction) and the high-priority p99
+    stays bounded — reported as the off/on ratio;
+  * zero invariant violations either way, every shed pod re-admitted
+    after the burst (shed lane drains to 0 — no pod lost);
+  * at least one full brownout engage→recover cycle with hysteresis:
+    recoveries walk the ladder back to level 0 and the timeline-derived
+    flap check shows no engage/disengage in adjacent snapshot windows.
+
+Ledger wiring: the armed round's key series appends to
+BENCH_LEDGER.json under source ``bench-overload``; ``--check`` runs a
+one-round capture and exits nonzero iff any CLAIM fails (the
+host-speed-robust contract — latency/throughput keys scale
+several-fold with CI host load, so bench_compare's per-key diff
+against the newest committed entry is reported as ADVISORY context
+beside the claim verdicts). This is the `make bench-check` hook.
+Tools of record commit the full document:
+
+    JAX_PLATFORMS=cpu python tools/bench_overload.py [> BENCH_OVERLOAD.json]
+    JAX_PLATFORMS=cpu python tools/bench_overload.py --check [--update]
+
+MINISCHED_OVERLOAD_RATE overrides the arrival rate (pods/s);
+MINISCHED_BENCH_ROUNDS the interleave count; MINISCHED_BENCH_DURATION
+the burst seconds.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: Keys (per armed round) stable enough for the regression ledger —
+#: the off round's latencies are DESIGNED to be unbounded/noisy and
+#: never gate.
+LEDGER_KEYS = ("ovl_on_high_p99_s", "ovl_on_pods_per_sec",
+               "ovl_on_pods_bound")
+
+
+def run_rounds(rounds: int, duration_s: float) -> dict:
+    import bench
+
+    runs = {"ovl_off": [], "ovl_on": []}
+    for r in range(rounds):
+        for label, armed in (("ovl_off", False), ("ovl_on", True)):
+            runs[label].append(bench.overload_bench(
+                duration_s=duration_s, seed=100 + r, armed=armed,
+                prefix=label))
+    # Cross-round merge picks the WORST side for every claim-bearing
+    # key, so a multi-round capture can never report a claim that only
+    # one round exhibited: booleans AND together, ≥-threshold inputs
+    # take min, must-be-zero inputs take max, the protected-class p99
+    # takes max and the off tail min (both worst for their claims).
+    merged = {}
+    for label, reps in runs.items():
+        out = dict(reps[0])
+        for rep in reps[1:]:
+            for k, v in rep.items():
+                if isinstance(v, bool):
+                    if k.endswith("_flap_free"):
+                        out[k] = bool(out.get(k, True)) and v
+                    continue
+                if not isinstance(v, (int, float)):
+                    continue
+                if k.endswith(("_shed_left", "_unbound", "_violations",
+                               "_level_final", "_high_p99_s")):
+                    out[k] = max(out.get(k, 0), v)
+                elif k.endswith(("_shed_total", "_shed_pods",
+                                 "_shed_frac", "_escalations",
+                                 "_recoveries", "_brownouts",
+                                 "_slo_alerts")):
+                    out[k] = min(out.get(k, v), v)
+                elif k.endswith(("_p50_s", "_p99_s", "_p95_s",
+                                 "_wall_s")):
+                    out[k] = min(out.get(k, v), v)
+                elif k.endswith("_pods_per_sec"):
+                    out[k] = max(out.get(k, 0), v)
+        merged.update(out)
+    return merged
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="single-round capture diffed against the "
+                         "committed ledger baseline (exit 1 on "
+                         "regression) — the bench-check hook")
+    ap.add_argument("--update", action="store_true",
+                    help="append this capture to the ledger as the new "
+                         "bench-overload baseline")
+    ap.add_argument("--ledger",
+                    default=os.path.join(REPO, "BENCH_LEDGER.json"))
+    args = ap.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    rounds = 1 if args.check else int(
+        os.environ.get("MINISCHED_BENCH_ROUNDS", "2"))
+    duration_s = float(os.environ.get("MINISCHED_BENCH_DURATION", "6.0"))
+
+    import bench
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    doc = {"platform": platform, "rounds": rounds,
+           "duration_s": duration_s,
+           "methodology": "interleaved controller-off/on rounds of the "
+                          "same saturating priority-mixed churn phase "
+                          "(open-loop arrivals over a 2-pod-batch "
+                          "engine, lifecycle invariants after every "
+                          "event); latency keys min-of-rounds, "
+                          "actuation counters max-of-rounds; per-class "
+                          "p99 from store truth (scheduled_time - "
+                          "creation_timestamp)"}
+    doc.update(run_rounds(rounds, duration_s))
+
+    # The decisive contrast: strict-priority popping already protects
+    # the high class from REORDERING, so what the controller buys is
+    # (a) the aggregate tail (the off round's run-wide histogram p99
+    # grows with the burst length — every unshed low-priority pod ages
+    # in the backlog) vs (b) the protected class's p99 staying near
+    # batch latency because shedding keeps the admitted load inside the
+    # tuned engine's capacity.
+    off_tail = doc.get("ovl_off_hist_p99_s")
+    on_hi = doc.get("ovl_on_high_p99_s")
+    if off_tail and on_hi:
+        doc["off_tail_over_on_protected"] = round(
+            off_tail / max(on_hi, 1e-9), 2)
+    doc["claims"] = {
+        "shed_engaged": doc.get("ovl_on_shed_pods", 0) > 0,
+        "shed_fully_readmitted": doc.get("ovl_on_shed_left", 1) == 0,
+        "nothing_lost": (doc.get("ovl_on_unbound", 1) == 0
+                         and doc.get("ovl_off_unbound", 1) == 0
+                         and doc.get("ovl_on_violations", 1) == 0
+                         and doc.get("ovl_off_violations", 1) == 0),
+        # The off tail scales with the burst length (every unshed
+        # low-priority pod ages in the backlog); the protected class's
+        # ceiling is informer-pipe lag + batch latency. Host speed
+        # varies several-fold between CI runs, so the bounded claim is
+        # the RELATIVE contrast (observed ~7x at this shape; 2x is the
+        # generous floor), not an absolute number.
+        "unprotected_tail_grows_off": bool(off_tail and off_tail > 5.0),
+        "protected_p99_bounded_on": bool(
+            off_tail and on_hi and on_hi < off_tail / 2),
+        "brownout_cycle_recorded": (
+            doc.get("ovl_on_brownouts", 0) >= 1
+            and doc.get("ovl_on_recoveries", 0) >= 1
+            and doc.get("ovl_on_level_final", 1) == 0),
+        "no_flapping": bool(doc.get("ovl_on_flap_free", False)),
+        "controller_off_untouched": (
+            doc.get("ovl_off_shed_total", 1) == 0
+            and doc.get("ovl_off_escalations", 1) == 0),
+    }
+    doc["claims_all_hold"] = all(doc["claims"].values())
+
+    # ---- ledger + regression gate --------------------------------------
+    entry = {"ts": bench.time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       bench.time.gmtime()),
+             "source": "bench-overload", "platform": platform,
+             "nodes": 8, "pods": int(doc.get("ovl_on_pods_created", 0)),
+             "keys": {k: doc[k] for k in LEDGER_KEYS
+                      if isinstance(doc.get(k), (int, float))
+                      and doc.get(k)}}
+    # The CLAIMS are the gate: every latency/throughput key scales with
+    # host speed (observed several-fold between CI runs of this very
+    # capture), so bench_compare's per-key thresholds would flap — the
+    # cross-run diff is recorded as ADVISORY context beside the
+    # host-robust claim verdicts.
+    rc = 0 if doc["claims_all_hold"] else 1
+    if args.check or args.update:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import bench_compare
+
+        try:
+            with open(args.ledger, encoding="utf-8") as f:
+                ledger = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            ledger = {"schema": 1, "runs": []}
+        # shape-match on platform+source only: pod counts vary with the
+        # adaptive arrival curve, so they are recorded, not matched
+        base = None
+        for run in reversed(ledger.get("runs") or []):
+            if (run.get("source") == "bench-overload"
+                    and run.get("platform") == platform):
+                base = run
+                break
+        if args.update:
+            bench.append_ledger(entry, args.ledger)
+        if base is None:
+            doc["ledger"] = {"note": "no bench-overload baseline"
+                                     + ("; appended" if args.update
+                                        else " (run with --update)")}
+        else:
+            report = bench_compare.compare(entry["keys"], base["keys"])
+            doc["ledger"] = {"baseline_ts": base.get("ts"),
+                             "advisory": True,
+                             "ok": report["ok"],
+                             "regressions": report["regressions"]}
+    print(json.dumps(doc))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

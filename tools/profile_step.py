@@ -103,6 +103,29 @@ def main() -> None:
           f"{min(sl_k, n_pad) if sl_k else n_pad} per step; "
           "MINISCHED_SHORTLIST / MINISCHED_SHORTLIST_K)", flush=True)
 
+    # Overload-control posture (MINISCHED_OVERLOAD, engine/overload.py):
+    # the actuation each ladder rung would apply AT THIS SHAPE — the
+    # attribution row for a run whose /metrics shows overload_level > 0.
+    from minisched_tpu.engine.overload import (OVERLOAD, OVERLOAD_LADDER,
+                                               OverloadController)
+    if OVERLOAD.enabled:
+        probe = OverloadController()
+        base_batch = cfg_env.max_batch_size
+        print("overload actuation ladder (armed):", flush=True)
+        for lvl, state in enumerate(OVERLOAD_LADDER):
+            probe.level = lvl
+            probe.tune_steps = min(OVERLOAD.tune_max, lvl)
+            print(f"  level {lvl} {state:<9s} max_batch="
+                  f"{probe.effective_max_batch(base_batch):<6d} "
+                  f"window={probe.effective_window(cfg_env.batch_window_s):.3f}s "
+                  f"shed={'y' if probe.shedding else 'n'}"
+                  f"(prio<{OVERLOAD.shed_priority}) "
+                  f"pct_nodes={probe.effective_pct_nodes(cfg_env.percentage_of_nodes_to_score)}",
+                  flush=True)
+    else:
+        print("overload: disarmed (MINISCHED_OVERLOAD unset — ingress "
+              "unbounded, no brownout ladder)", flush=True)
+
     stages = {}  # label → seconds, for the per-stage table below
 
     def timed(label, fn):
