@@ -13,11 +13,12 @@ CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 SHELL := /bin/bash
 
 .PHONY: test tier1 fault-smoke shortlist-smoke trace-smoke slo-smoke \
-        churn-smoke overload-smoke profile-smoke start start-remote \
-        start-client-engine demo docs bench bench_sharded bench-cpu \
-        bench-pipeline bench-residency bench-shortlist bench-trace \
-        bench-slo bench-churn bench-overload bench-check dryrun \
-        dryrun-dcn soak soak-faults soak-churn soak-overload
+        churn-smoke overload-smoke loop-smoke profile-smoke start \
+        start-remote start-client-engine demo docs bench bench_sharded \
+        bench-cpu bench-pipeline bench-residency bench-shortlist \
+        bench-trace bench-slo bench-churn bench-overload \
+        bench-deviceloop bench-check dryrun dryrun-dcn soak soak-faults \
+        soak-churn soak-overload
 
 # Unit + integration suite on a virtual 8-device CPU mesh.
 test:
@@ -76,14 +77,29 @@ overload-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_overload.py -x -q \
 	  -p no:cacheprovider -p no:randomly
 
+# Fast deterministic device-loop suite (~25 s): bit-identity of the
+# fused multi-batch loop vs per-batch dispatch in every engine mode
+# (sync/pipelined/resident/upload/shortlist-off) incl. ragged final
+# tranches, fused-dispatch + one-readback-per-tranche ledgers,
+# crash-consistent fault break-outs, overload-tuner depth composition,
+# depth-scaled watchdog, timeline cadence, the compile-cache bootstrap,
+# and the raw-op loop-vs-chained-step equality. A tier-1 prerequisite
+# after overload-smoke: the ring must never change a decision.
+loop-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_device_loop.py -x -q \
+	  -p no:cacheprovider -p no:randomly
+
 # The EXACT ROADMAP tier-1 verify command (dots count + exit code
 # preserved) — what the driver runs after every PR; run it locally
 # before shipping. shortlist-smoke runs first: the arbitration
 # exactness contract gates the rest of the suite; trace-smoke next: the
 # measurement layer must not perturb decisions; overload-smoke after
 # slo-smoke (the actuator rides the sentinel); churn-smoke last: the
-# lifecycle oracle rides on all of them.
-tier1: shortlist-smoke trace-smoke slo-smoke overload-smoke churn-smoke
+# lifecycle oracle rides on all of them; loop-smoke after
+# overload-smoke (the ring composes with the tuner's dials and must
+# never change a decision).
+tier1: shortlist-smoke trace-smoke slo-smoke overload-smoke loop-smoke \
+       churn-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -220,6 +236,19 @@ bench-overload:
 bench-check:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_compare.py --capture
 	JAX_PLATFORMS=cpu $(PY) tools/bench_overload.py --check
+	JAX_PLATFORMS=cpu $(PY) tools/bench_deviceloop.py --check
+
+# Persistent device-loop before/after (the committed
+# BENCH_DEVICELOOP.json): interleaved off/on min-of-4 rounds of the
+# streaming phase at depth 8 — steps_dispatched per bound pod down
+# ≥4×, one stacked decision readback per tranche
+# (decision_fetches == steps_dispatched), a paired identical-workload
+# run diffing every placement, and a fault-injected round proving the
+# mid-tranche break-out replays per-batch with nothing lost and
+# placements unchanged. Stable stream keys append to BENCH_LEDGER.json
+# (source bench-deviceloop) so `make bench-check` gates them.
+bench-deviceloop:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_deviceloop.py
 
 # p99-under-churn bench (the committed BENCH_CHURN.json): interleaved
 # clean/faulted lifecycle-churn rounds through bench.churn_bench —

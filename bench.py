@@ -1082,7 +1082,15 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                               shortlist=os.environ.get(
                                   "MINISCHED_SHORTLIST", "1") != "0",
                               shortlist_k=int(os.environ.get(
-                                  "MINISCHED_SHORTLIST_K", "128")))
+                                  "MINISCHED_SHORTLIST_K", "128")),
+                              # persistent device-loop knobs likewise
+                              # (tools/bench_deviceloop.py toggles them)
+                              device_loop=os.environ.get(
+                                  "MINISCHED_DEVICE_LOOP", "0") == "1",
+                              loop_depth=int(os.environ.get(
+                                  "MINISCHED_LOOP_DEPTH", "8")),
+                              compile_cache=os.environ.get(
+                                  "MINISCHED_COMPILE_CACHE", ""))
         if backoff_s is not None:
             # Skew-style convergence workloads retry revoked pods across
             # cycles; the reference's 1 s initial backoff would dominate
@@ -1174,6 +1182,13 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                         break
                     time.sleep(0.01)
         total_s = time.perf_counter() - t0
+        if attempt == "warmup":
+            # Cold-start ledger (ROADMAP cold-start item): the warmup
+            # pass is where XLA compiles land — its wall clock minus the
+            # warmed measured pass approximates compile seconds, which
+            # is what MINISCHED_COMPILE_CACHE exists to eliminate across
+            # process restarts.
+            warmup_total_s = total_s
         m = sched.metrics()
         svc.shutdown_scheduler()
         if api is not None:
@@ -1205,6 +1220,16 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                    if short[0] else {}),
                 f"{prefix}_bound": bound_r0,
                 f"{prefix}_total_s": round(total_s, 4),
+                # Warmup/compile visibility (MINISCHED_COMPILE_CACHE):
+                # the warmup pass's wall clock and its excess over the
+                # warmed measured pass (≈ XLA compile seconds this
+                # process paid — near zero when the persistent cache
+                # already held the executables).
+                f"{prefix}_warmup_s": round(warmup_total_s, 4),
+                f"{prefix}_warmup_compile_s":
+                    round(max(0.0, warmup_total_s - total_s), 4),
+                f"{prefix}_compile_cache_on":
+                    int(m.get("compile_cache_on", 0)),
                 f"{prefix}_sync_s": round(sync_s, 4),
                 f"{prefix}_sched_s": round(sched_s, 4),
                 f"{prefix}_pods_per_sec":
@@ -1290,6 +1315,22 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                     int(m.get("shortlist_certified", 0)),
                 f"{prefix}_shortlist_desyncs":
                     int(m.get("shortlist_desyncs", 0)),
+                # Persistent device loop (MINISCHED_DEVICE_LOOP): main-
+                # step device dispatches vs batches (the fused-dispatch
+                # claim is steps_dispatched/batches < 1), fused tranche
+                # /iteration/break counts, and blocking decision-fetch
+                # TRANSFERS (one per tranche fused — the one-readback
+                # byte-ledger claim rides decision_fetches).
+                f"{prefix}_steps_dispatched":
+                    int(m.get("steps_dispatched", 0)),
+                f"{prefix}_loop_tranches": int(m.get("loop_tranches", 0)),
+                f"{prefix}_loop_iterations":
+                    int(m.get("loop_iterations", 0)),
+                f"{prefix}_loop_breaks": int(m.get("loop_breaks", 0)),
+                f"{prefix}_decision_fetches":
+                    int(m.get("decision_fetches", 0)),
+                f"{prefix}_loop_depth_effective":
+                    int(m.get("loop_depth_effective", 0)),
                 f"{prefix}_bind_conflicts": int(m["bind_conflicts"]),
                 # revocations + terminal failures summed over cycles —
                 # the skew-convergence diagnostic (how much work the

@@ -148,6 +148,34 @@ class SchedulerConfig:
     # backend gather — and is what the shortlist_repair:corrupt fault
     # gate exercises).
     shortlist_check_every: int = 0
+    # Persistent on-device engine loop (engine/scheduler.py tranche
+    # machinery + ops/pipeline.build_loop_step, MINISCHED_DEVICE_LOOP):
+    # when the queue holds multiple ready batches of loop-safe pods
+    # (no gangs/pod-affinity/spread constraints/volumes/ports — the
+    # workloads whose decisions are provably independent of the host
+    # state the ring cannot carry), the engine stages up to
+    # ``loop_depth`` pre-encoded fixed-shape batches into a device-side
+    # work ring and dispatches ONE fused lax.scan that carries ``free``
+    # across iterations and emits one stacked decision buffer fetched
+    # in a single d2h transfer — dispatches-per-batch drops below 1.
+    # Between slots the engine validates host truth against the carried
+    # chain (cache.drain_dyn_rows) and BREAKS back to per-batch
+    # dispatch on any divergence (revocation, failed bind, informer
+    # churn, nominations), replaying the un-consumed slots through the
+    # normal path with their original PRNG draws — decisions are
+    # bit-identical loop on/off (tests/test_device_loop.py). False
+    # (the default, MINISCHED_DEVICE_LOOP=0) keeps per-batch dispatch
+    # exactly; opt-in until the TPU capture validates the win.
+    device_loop: bool = False
+    # Work-ring depth: max batches fused per device dispatch
+    # (MINISCHED_LOOP_DEPTH). The overload tuner steps the effective
+    # depth down (halved per tune step) under the ``tuned`` rung.
+    loop_depth: int = 8
+    # Persistent XLA compilation cache directory
+    # (MINISCHED_COMPILE_CACHE; ops/pipeline.enable_compile_cache):
+    # compiled step/loop executables survive process restarts — the
+    # first slice of the ROADMAP cold-start item. "" = off.
+    compile_cache: str = ""
     # Residency carry cross-check (ROADMAP follow-up (b)): every N
     # device-resident batches, fetch the device-carried free array and
     # compare it to the host mirror BEFORE the step consumes it; a
@@ -202,6 +230,9 @@ def config_from_env() -> SchedulerConfig:
         shortlist_k=int(_req("MINISCHED_SHORTLIST_K", "128")),
         shortlist_check_every=int(
             _req("MINISCHED_SHORTLIST_CHECK_EVERY", "0")),
+        device_loop=_req("MINISCHED_DEVICE_LOOP", "0") == "1",
+        loop_depth=int(_req("MINISCHED_LOOP_DEPTH", "8")),
+        compile_cache=os.environ.get("MINISCHED_COMPILE_CACHE", ""),
         watchdog_s=float(_req("MINISCHED_WATCHDOG", "0.0")),
         probation_batches=int(_req("MINISCHED_PROBATION_BATCHES", "8")),
         resident_check_every=int(

@@ -250,6 +250,34 @@ class NodeFeatureCache:
         for lst in self._dyn_listeners:
             lst.rows.update(rows)
 
+    def drain_dyn_rows(self, lst: DynDeltaListener):
+        """Drain a listener's marked rows WITHOUT advancing its epoch or
+        touching its base: returns (rows sorted, authoritative free
+        copies, authoritative used_ports copies) for EVERY marked row —
+        no filtering, so a node allocated beyond the caller's pad still
+        surfaces. The device-loop tranche validator
+        (engine/scheduler.py) uses this between loop iterations to ask
+        "did host truth move off the carried chain since the last
+        slot?" — a mutation whose truth still equals the tranche's
+        replay mirror (the steady-state assume) keeps the fused loop
+        running; anything else — including a row the tranche's pad
+        cannot even represent — breaks it back to per-batch dispatch.
+        The listener passed here is loop-private and never fed to
+        snapshot_resident, so the epoch protocol is untouched."""
+        with self._lock:
+            if not lst.rows:
+                return (np.zeros(0, dtype=np.int32),
+                        np.zeros((0, self._feats.free.shape[1]),
+                                 dtype=self._feats.free.dtype),
+                        np.zeros((0, self._feats.used_ports.shape[1]),
+                                 dtype=self._feats.used_ports.dtype))
+            rows = np.fromiter(lst.rows, dtype=np.int32,
+                               count=len(lst.rows))
+            lst.rows.clear()
+            rows.sort()
+            return (rows, self._feats.free[rows].copy(),
+                    self._feats.used_ports[rows].copy())
+
     def enable_owner_pairs(self) -> None:
         """Record controller-owner spread pairs in assigned label rows
         (SelectorSpread's population signal). Call before the first bind

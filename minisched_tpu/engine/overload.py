@@ -418,6 +418,18 @@ class OverloadController:
             return base
         return OVERLOAD.window_step / 2.0
 
+    def effective_loop_depth(self, base: int) -> int:
+        """The device-loop work-ring depth under tuning: halved per tune
+        step (floor 1 — depth 1 disengages the fused loop entirely, the
+        latency-first posture), untouched at tune depth 0 so loop-mode
+        decision/batch streams are bit-identical to an untuned engine.
+        Composes with the batch/K dials: a tuned engine runs smaller
+        batches through a shallower ring, trading fused-dispatch
+        amortization back for per-batch latency and break granularity."""
+        if self.tune_steps == 0 or not OVERLOAD.enabled:
+            return base
+        return max(1, base >> self.tune_steps)
+
     def shortlist_target(self, base_k: Optional[int]) -> Optional[int]:
         """The tuner's shortlist width for a configured base K — always
         within the certified machinery (any K is exact; repairs absorb a

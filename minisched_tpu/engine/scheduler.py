@@ -37,9 +37,11 @@ from ..faults import FAULTS, FaultWorkerDeath
 from ..obs import Histogram, instant, span
 from ..obs import slo as slo_mod
 from ..obs.timeseries import TIMELINE, TimelineTracker
-from ..ops.pipeline import Decision, build_step
+from ..ops.pipeline import (Decision, build_loop_step, build_step,
+                            enable_compile_cache)
 from ..ops.residency import (I16_SAT, apply_rows, apply_rows_bytes,
-                             pack_decision_slim, unpack_decision_slim)
+                             pack_decision_i32, pack_decision_slim,
+                             unpack_decision_i32, unpack_decision_slim)
 from ..plugins.base import PluginSet
 from ..state.events import ActionType, ClusterEvent, EventBroadcaster, GVK
 from ..state.objects import Pod, claim_keys, gang_key
@@ -188,7 +190,8 @@ class _InflightBatch:
                  "shapes", "seq", "t0", "t_encode", "t_dispatch",
                  "t_fetch_start", "t_step", "t_resolved", "commit_t0",
                  "commit_t1", "res_carried", "assumed", "detached",
-                 "h2d0", "fetch0", "h2d1", "fetch1", "sl_repairs", "gap")
+                 "h2d0", "fetch0", "h2d1", "fetch1", "sl_repairs", "gap",
+                 "step_share")
 
     def __init__(self):
         self.failures: List[tuple] = []  # (qpi, plugins, message, retryable)
@@ -225,25 +228,21 @@ class _InflightBatch:
         # chain (_DeviceResidency) — its free_after must be carried and
         # its debits replayed into the host mirror at resolve time.
         self.res_carried = False
+        # Loop-mode slot: this batch's share of its tranche's fused
+        # device window (tranche window / slots). Non-None overrides
+        # the dispatch→fetch stamps in the watchdog and step_s
+        # accounting — a depth-8 tranche must not book (or trip) an
+        # 8-batch window against one batch's deadline.
+        self.step_share: Optional[float] = None
 
 
-@jax.jit
-def _pack_decision(chosen, assigned, gang_rejected, feasible,
-                   feasible_static, rejects, repaired):
-    """Fuse the per-pod step outputs into one (6+F, P) i32 array so the
-    host fetches ONE buffer per batch. On a remote-TPU tunnel every
-    separate np.asarray is a device round trip; six fetches of small
-    arrays cost ~5 extra latencies — measured ~0.27 s/batch at 10k pods,
-    on par with the entire device compute."""
-    import jax.numpy as jnp
-
-    head = jnp.stack([chosen.astype(jnp.int32),
-                      assigned.astype(jnp.int32),
-                      gang_rejected.astype(jnp.int32),
-                      feasible.astype(jnp.int32),
-                      feasible_static.astype(jnp.int32),
-                      repaired.astype(jnp.int32)])
-    return jnp.concatenate([head, rejects.astype(jnp.int32)], axis=0)
+# Fuse the per-pod step outputs into one (6+F, P) i32 array so the
+# host fetches ONE buffer per batch. On a remote-TPU tunnel every
+# separate np.asarray is a device round trip; six fetches of small
+# arrays cost ~5 extra latencies — measured ~0.27 s/batch at 10k pods,
+# on par with the entire device compute. The jitted pack itself lives
+# in ops/residency.py since the device loop stacks the same layout.
+_pack_decision = pack_decision_i32
 
 
 @jax.jit
@@ -1043,6 +1042,31 @@ class Scheduler:
         if self.config.device_resident and self.config.assignment == "greedy":
             self._residency = _DeviceResidency(
                 self.cache.register_dyn_listener())
+        # Persistent on-device engine loop (MINISCHED_DEVICE_LOOP): the
+        # multi-batch fused-dispatch tranche machinery
+        # (_maybe_run_tranche). Gated to the greedy single-device
+        # non-explain engine — the same family as residency, for the
+        # same carry-replay reason. The loop-private dyn listener feeds
+        # the between-slot divergence validator (cache.drain_dyn_rows);
+        # it is never handed to snapshot_resident, so the residency
+        # epoch protocol is untouched. _loop_cooldown is the ladder's
+        # loop→pipelined rung: a tranche-machinery fault disables loop
+        # engagement for probation_batches considerations (slot-level
+        # batch faults ride the existing degradation ladder unchanged).
+        self._loop_enabled = (self.config.device_loop
+                              and self.config.assignment == "greedy"
+                              and self._mesh is None
+                              and not self.config.explain)
+        self._loop_listener = (self.cache.register_dyn_listener()
+                               if self._loop_enabled else None)
+        self._loop_cooldown = 0
+        # Compile-cache bootstrap (MINISCHED_COMPILE_CACHE; ROADMAP
+        # cold-start item, first slice): arm jax's persistent
+        # compilation cache BEFORE the first step compile so restarts
+        # reuse executables. Process-wide latch; failure degrades to a
+        # no-op, never blocks engine start.
+        self._compile_cache_on = enable_compile_cache(
+            self.config.compile_cache)
         # Engine supervisor: watchdog + fault/NaN/desync detection +
         # the counted degradation ladder (see _Supervisor). Level state
         # is scheduling-thread-only; counters ride _metrics.
@@ -1162,6 +1186,20 @@ class Scheduler:
             # keys created on first fire) and the supervisor's counted
             # early-warning reactions.
             "slo_alerts_total": 0, "supervisor_early_warnings": 0,
+            # Persistent device loop (MINISCHED_DEVICE_LOOP):
+            # steps_dispatched counts MAIN-step device dispatches (one
+            # per batch on the per-batch path, one per TRANCHE in loop
+            # mode — steps_dispatched/batches < 1 is the fused-dispatch
+            # claim); loop_iterations counts slots consumed through
+            # fused loops, loop_tranches the fused dispatches,
+            # loop_breaks the mid-tranche divergence/fault break-outs
+            # back to per-batch dispatch; decision_fetches counts
+            # blocking decision readback TRANSFERS (one per batch
+            # per-batch, one per tranche fused — the one-readback-per-
+            # tranche byte-ledger claim).
+            "steps_dispatched": 0, "loop_tranches": 0,
+            "loop_iterations": 0, "loop_breaks": 0,
+            "decision_fetches": 0,
         }
         # Rolling time-series ring of metrics() snapshots
         # (MINISCHED_TIMELINE; obs/timeseries.py). The tracker always
@@ -1378,11 +1416,20 @@ class Scheduler:
         when ``decision`` is supplied; a mismatch (exotic backend byte
         order) logs, permanently reverts to the i32 layout, and refetches
         this batch through it — decisions are never at risk."""
+        if type(packed_dev) is tuple:
+            # Loop-mode slot: the tranche resolver already fetched the
+            # whole stacked buffer in ONE transfer (counted there, fetch
+            # fault gate applied there) and pre-unpacked this slot's
+            # planes — nothing left to move or count here. Exact-type
+            # check: a mesh batch passes the Decision NAMEDTUPLE, which
+            # must keep taking the per-leaf fetch below.
+            return packed_dev
         # Fault gate: slim decision fetch. ``corrupt`` scribbles the
         # chosen plane with absurd node rows — exercising the sanity
         # DETECTOR downstream (resolve range check / names indexing),
         # not just the exception path.
         act = FAULTS.hit("fetch")
+        self._sup_count("decision_fetches")
         if isinstance(packed_dev, Decision):
             d = packed_dev
             out = (np.array(d.chosen), np.array(d.assigned),
@@ -1508,11 +1555,12 @@ class Scheduler:
             # inside pop_batch — gather glue.
             if last_done is not None:
                 self._book_gap("gather", time.perf_counter() - last_done)
-            try:
-                self.schedule_batch(batch)
-            except Exception:
-                log.exception("schedule_batch failed; engaging supervisor")
-                self._supervised_retry(batch)
+            if self._maybe_run_tranche(batch):
+                # Fused device-loop tranche consumed the batch (plus any
+                # further ready batches) in one dispatch.
+                last_done = time.perf_counter()
+                continue
+            self._schedule_guarded(batch)
             last_done = time.perf_counter()
 
     def _run_pipelined(self) -> None:
@@ -1674,12 +1722,17 @@ class Scheduler:
             # engine deliberately runs one batch at a time. All drain
             # the pipeline and run this batch synchronously.
             pending = self._await_commit(pending)
-            try:
-                self.schedule_batch(batch)
-            except Exception:
-                log.exception("schedule_batch failed; engaging supervisor")
-                self._supervised_retry(batch)
+            self._schedule_guarded(batch)
             return None, pending
+        if self._loop_gates_open() and self._loop_safe(batch):
+            # Fused device-loop tranche: its commits run inline on the
+            # scheduling thread, so the previous batch's worker flush
+            # must land first (commit order). A decline (no second
+            # ready batch) falls through to the normal prepare with the
+            # pipeline merely drained one slot early.
+            pending = self._await_commit(pending)
+            if self._maybe_run_tranche(batch, checked=True):
+                return None, pending
         try:
             return self._prepare_batch(batch), pending
         except Exception:
@@ -1821,6 +1874,506 @@ class Scheduler:
         for qpi, _plugins, _msg, _retry in done.failures:
             self.queue.requeue_backoff(qpi)
 
+    # ---- persistent on-device engine loop (MINISCHED_DEVICE_LOOP) -------
+
+    def _schedule_guarded(self, batch: List[QueuedPodInfo]) -> None:
+        """One guarded per-batch cycle — the run loops' try/supervise
+        pattern as a callable (loop break-outs and held batches replay
+        through it)."""
+        try:
+            self.schedule_batch(batch)
+        except Exception:
+            log.exception("schedule_batch failed; engaging supervisor")
+            self._supervised_retry(batch)
+
+    def _effective_loop_depth(self) -> int:
+        """Work-ring depth for the next tranche: the configured depth,
+        stepped down by the overload tuner (halved per tune step, floor
+        1 = loop disengaged) — the batch/K dials and the ring compose."""
+        return self._overload.effective_loop_depth(self.config.loop_depth)
+
+    def _loop_gates_open(self) -> bool:
+        """Cheap engagement gates for the fused device loop — everything
+        that must hold REGARDLESS of the batch's pods. The loop is the
+        fastest rung of the ladder: any degradation, outstanding
+        nomination reservation, permit profile, explain recorder, armed
+        shortlist cross-check (its full-scan replay needs the per-batch
+        nf the ring doesn't materialize), unverified slim layout (the
+        first-batch byte-order insurance runs per-batch), or active
+        cooldown (the loop→pipelined rung) keeps per-batch dispatch."""
+        if not self._loop_enabled or self._loop_cooldown > 0:
+            return False
+        if (self.recorder is not None or self.plugin_set.permit_plugins
+                or self._nominations or self._sup.level != 0
+                or self.config.shortlist_check_every):
+            return False
+        # An armed profiler trace must capture a whole per-batch cycle
+        # (schedule_batch is the only consumer of _trace_dir), and an
+        # instance-patched schedule_batch (test instrumentation) must
+        # keep seeing every batch — the pipelined loop drains for these
+        # before considering a tranche; this gate covers sync mode too.
+        with self._trace_lock:
+            if self._trace_dir is not None:
+                return False
+        if "schedule_batch" in self.__dict__:
+            return False
+        if self._slim and not self._slim_verified:
+            return False
+        return self._effective_loop_depth() >= 2
+
+    def _loop_safe(self, batch: List[QueuedPodInfo]) -> bool:
+        """May this batch ride the work ring? True only when every pod's
+        decision is provably independent of the host state the ring
+        cannot carry: no gangs (quorum accounting spans batches), no
+        pod-affinity/anti-affinity terms and no spread constraints
+        (their scores/filters read the assigned corpus, which the ring
+        shares tranche-wide), no volumes (RWO arbitration + claim-table
+        accounting are host-side), no host ports (the cache's bulk
+        assume debits port pods out of pod order, which would break the
+        bitwise mirror-vs-truth validation), and no owner references
+        when SelectorSpread runs (owner groups read the corpus too).
+        A batch the per-batch path would node-SAMPLE is unsafe as well —
+        the ring runs the full axis and sampling draws a different key
+        path, so fusing it would change decisions."""
+        for q in batch:
+            pod = q.pod
+            s = pod.spec
+            if (s.pod_group or s.topology_spread_constraints or s.volumes
+                    or s.ports):
+                return False
+            a = s.affinity
+            if a is not None and (a.pod_affinity is not None
+                                  or a.pod_anti_affinity is not None):
+                return False
+            if self._selspread_enabled and pod.metadata.owner_references:
+                return False
+        n_pad = self._node_pad(self.cache.rows_high_water())
+        if self._sampled_step(n_pad, len(batch), False)[0] is not None:
+            return False
+        return True
+
+    def _maybe_run_tranche(self, batch: List[QueuedPodInfo], *,
+                           checked: bool = False) -> bool:
+        """Try to consume ``batch`` — plus up to depth-1 further READY
+        queue batches — as ONE fused device-loop tranche. Returns True
+        when the pods were consumed (fused, or replayed per-batch after
+        a break); False = caller schedules ``batch`` itself. Ring
+        filling pops with timeout 0: only immediately-available pods
+        join a tranche, so a shallow stream degenerates to per-batch
+        dispatch with zero added latency. ``checked=True`` = the caller
+        already ran the per-pod safety walk (the pipelined loop runs it
+        before draining its commit slot) — skip repeating it on the hot
+        path; the cheap gate flags ALWAYS re-check, because the commit
+        drain between the caller's check and this call can escalate the
+        supervisor, and a degraded engine must not open a tranche."""
+        if not (self._loop_gates_open()
+                and (checked or self._loop_safe(batch))):
+            return False
+        depth = self._effective_loop_depth()
+        max_n, _window, _idle = self._pop_params()
+        slots: List[List[QueuedPodInfo]] = [batch]
+        held: Optional[List[QueuedPodInfo]] = None
+        while len(slots) < depth:
+            nxt = self.queue.pop_batch(max_n, timeout=0.0)
+            if not nxt:
+                break
+            if not self._loop_safe(nxt):
+                held = nxt
+                break
+            slots.append(nxt)
+        if len(slots) < 2:
+            if held is None:
+                return False
+            # A second batch was popped but cannot ride the ring: run
+            # both through the guarded per-batch path in pop order.
+            self._schedule_guarded(batch)
+            self._schedule_guarded(held)
+            return True
+        self._run_tranche(slots)
+        if held is not None:
+            self._schedule_guarded(held)
+        return True
+
+    def _loop_break(self, reason: str, *, slot: int) -> None:
+        """Break the ring back to per-batch dispatch: counted, traced,
+        the carried residency chain dropped (the device free_final
+        reflects every staged slot's debits, including ones the break
+        just invalidated)."""
+        self._sup_count("loop_breaks")
+        instant("loop.break", reason=reason, slot=slot)
+        res = self._residency
+        if res is not None:
+            res.drop(f"device-loop break: {reason}")
+
+    def _loop_probation(self) -> None:
+        """Engage the ladder's loop→pipelined rung AFTER a fault's
+        containment finished: set here (not inside the break) because
+        every resolved batch — including the break's own per-batch
+        replay tail — pays one cooldown tick, and a depth-sized replay
+        would otherwise consume the whole probation before any NEW
+        traffic ran at the per-batch rung."""
+        self._loop_cooldown = max(1, self.config.probation_batches)
+
+    def _replay_tail(self, slot_batches, start: int,
+                     anchor: Optional[int]) -> None:
+        """Replay the un-consumed slots through the guarded per-batch
+        path with their ORIGINAL PRNG draws. With ``anchor`` the step
+        counter rewinds to the first unconsumed slot's draw (staging
+        advanced it past every staged slot); the slot-fault path passes
+        None — _supervised_retry already left the counter exactly where
+        a never-fused run would have it (consumed on a successful
+        degraded retry, rewound on quarantine), and forcing it forward
+        here would shift every tail batch's tie-break stream."""
+        if anchor is not None:
+            self._step_counter = anchor + start
+        for b in slot_batches[start:]:
+            self._schedule_guarded(b)
+
+    def _run_tranche(self, slot_batches: List[List[QueuedPodInfo]]) -> None:
+        """One fused device-loop tranche end to end, with the
+        containment contract: a machinery fault (staging, dispatch,
+        stacked fetch, validator) never loses a pod — every slot that
+        did not consume its decision replays per-batch."""
+        progress = {"done": 0}
+        anchor = self._step_counter
+        try:
+            self._run_tranche_impl(slot_batches, progress, anchor)
+        except Exception:
+            log.exception("device-loop tranche failed; replaying the "
+                          "remaining slots per-batch")
+            self._loop_break("tranche machinery fault",
+                             slot=progress["done"])
+            self._replay_tail(slot_batches, progress["done"], anchor)
+            self._loop_probation()
+
+    def _run_tranche_impl(self, slot_batches, progress, anchor) -> None:
+        cfg = self.config
+        n_slots = len(slot_batches)
+        res = self._residency
+
+        # Baseline-drain the loop listener BEFORE the snapshot: marks
+        # landing in the window between drain and snapshot are already
+        # inside the snapshot's truth, so re-seeing them at slot-0
+        # validation costs at worst a false (conservative) break —
+        # draining after the snapshot could instead DISCARD a
+        # post-snapshot mutation and miss a real divergence.
+        self.cache.drain_dyn_rows(self._loop_listener)
+
+        # ---- one snapshot + carry attach for the whole tranche --------
+        cached = self._nf_static_device
+        res_live = (res is not None and not self._nominations
+                    and self._sup.allows_residency())
+        if res_live:
+            nf, names, static_v, row_incs, dyn_delta = (
+                self.cache.snapshot_resident(
+                    pad=self._node_pad,
+                    known_static=cached[0] if cached else None,
+                    dyn=res.listener))
+        else:
+            nf, names, static_v, row_incs = self.cache.snapshot_versioned(
+                pad=self._node_pad,
+                known_static=cached[0] if cached else None)
+            dyn_delta = None
+        nf = self._with_device_static(nf, static_v, row_incs.shape[0])
+        carried = False
+        if res_live:
+            # Same residency fault-gate semantics as the per-batch
+            # prepare; any attach/cross-check failure propagates to the
+            # tranche containment (replay per-batch re-snapshots).
+            act = FAULTS.hit("residency")
+            with span("h2d.dyn"):
+                nf = res.attach(self, nf, dyn_delta)
+            carried = True
+            if act == "corrupt" and res.mirror_free is not None:
+                res.mirror_free[0, :] += 1.0
+            if cfg.resident_check_every:
+                self._check_resident_carry(res, nf)
+
+        # Tranche-local mirrors (host twins of the carried chain): each
+        # slot's debits replay into ``mirror`` in pod order — the same
+        # IEEE op sequence as the scan's carry and the cache's bulk
+        # assume — and the between-slot validator compares marked rows'
+        # host truth against it. ``pmirror`` is compared only (the ring
+        # stages no port pods, so used_ports is tranche-invariant).
+        if carried:
+            mirror = res.mirror_free.copy()
+            pmirror = res.mirror_ports
+        else:
+            mirror = np.array(nf.free, copy=True)
+            pmirror = np.asarray(nf.used_ports)
+            # Upload-mode ledger: ONE full dynamic upload per tranche
+            # (the fused win over per-batch's per-dispatch upload).
+            self._count_h2d(nf.free.nbytes + pmirror.nbytes)
+        af = self.cache.snapshot_assigned(pad=self._af_pad)
+
+        # ---- stage the ring: encode every slot at ONE fixed pod pad ---
+        P_ring = step_bucket(max(len(b) for b in slot_batches),
+                             cfg.pod_bucket_min)
+        infs: List[_InflightBatch] = []
+        counters: List[int] = []
+        for b in slot_batches:
+            # Per-slot dispatch-seam fault gate: the ring consumes one
+            # gate hit per batch, like the per-batch path — an ``err``
+            # here aborts into containment (everything replays
+            # per-batch down the ladder).
+            FAULTS.hit("step")
+            inf = self._stage_slot(b, P_ring, nf, names, af, row_incs)
+            infs.append(inf)
+            counters.append(self._step_counter)
+
+        # ---- ONE fused dispatch + ONE stacked fetch -------------------
+        loop_fn = build_loop_step(self.plugin_set,
+                                  assignment=cfg.assignment,
+                                  shortlist=self._shortlist_k,
+                                  slim=self._slim)
+        # The scan's program shape includes the depth axis: pad ragged
+        # tranches to the power-of-two bucket with masked no-op slots
+        # (all rows invalid — they assign nothing and carry ``free``
+        # through bit-exactly, like a ragged slot's pad rows), so the
+        # compile set per pod bucket stays {2, 4, 8, ...} instead of
+        # one synchronous retrace for every depth the queue fill
+        # happens to produce.
+        d_ring = bucket_for(n_slots, 2)
+        slot_ebs = [i.eb for i in infs]
+        if d_ring > n_slots:
+            eb_noop = jax.tree_util.tree_map(np.zeros_like, infs[0].eb)
+            slot_ebs += [eb_noop] * (d_ring - n_slots)
+            counters = counters + [0] * (d_ring - n_slots)
+        eb_stack = jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs), *slot_ebs)
+        ctr = np.asarray(counters, dtype=np.uint32)
+        t_disp0 = time.perf_counter()
+        with span("loop.dispatch", slots=n_slots, ring=d_ring):
+            packed_dev, free_final = loop_fn(eb_stack, nf, af, ctr,
+                                             self._key)
+        self._sup_count("steps_dispatched")
+        self._sup_count("loop_tranches")
+        self._sup_count("loop_iterations", n_slots)
+        with span("fetch.loop", slots=n_slots):
+            # ONE blocking d2h transfer; pad slots' buffers stay on
+            # device (they hold no decisions).
+            stack = np.array(packed_dev[:n_slots])
+        t_fetched = time.perf_counter()
+        self._count_fetch(stack.nbytes)
+        self._sup_count("decision_fetches")
+        if FAULTS.hit("fetch") == "corrupt":
+            # Scribble every slot's chosen plane — the per-batch
+            # fetch:corrupt semantics applied to the stacked buffer; the
+            # resolve sanity detector must catch slot 0 and the
+            # containment must replay the rest without losing a pod.
+            if self._slim:
+                stack[:, :4 * P_ring] = 0x7F
+            else:
+                stack[:, 0, :] = 0x7F7F7F7F
+        share = max(0.0, t_fetched - t_disp0) / n_slots
+
+        # ---- per-slot resolve + commit + between-slot validation ------
+        n_filters = len(self.filter_names)
+        for j, inf in enumerate(infs):
+            buf = stack[j]
+            tup = (unpack_decision_slim(buf, P_ring, n_filters)
+                   if self._slim else unpack_decision_i32(buf))
+            inf.packed_dev = tup
+            inf.step_share = share
+            inf.t_dispatch = t_disp0
+            self._prep_step0 = int(counters[j]) - 1
+            try:
+                self._resolve_batch(inf)
+            except Exception:
+                log.exception("device-loop slot resolve failed; "
+                              "engaging supervisor")
+                self._supervised_retry(inf.batch, inf)
+                progress["done"] = j + 1
+                self._loop_break("slot fault", slot=j)
+                # anchor=None: _supervised_retry left the counter where
+                # a never-fused run would (consumed on success, rewound
+                # on quarantine) — forcing it would shift the tail's
+                # tie-break streams.
+                self._replay_tail(slot_batches, j + 1, None)
+                self._loop_probation()
+                return
+            # The slot is CONSUMED once resolve returns (assumes made,
+            # binds submitted): containment past this point must never
+            # re-schedule it, whatever the commit below does.
+            progress["done"] = j + 1
+            try:
+                self._commit_batch(inf)
+            except FaultWorkerDeath:
+                # Inline commit — the synchronous-cycle contract:
+                # requeue the tranche, degrade, keep going.
+                log.error("commit flush died in a device-loop slot; "
+                          "requeueing its %d-pod tranche",
+                          len(inf.failures))
+                self._sup_count("worker_deaths")
+                self._sup.escalate("commit flush death")
+                for qpi, _plugins, _msg, _retry in inf.failures:
+                    self.queue.requeue_backoff(qpi)
+
+            # Validation: did host truth move off the carried chain?
+            # Replay this slot's device debits into the mirror (pod
+            # order — bitwise the scan's op sequence AND the cache's
+            # bulk-assume subtract), then compare every row the cache
+            # mutated since the last slot against it. Any mismatch —
+            # assume miss, failed bind, informer churn, revocation —
+            # means slot j+1's decisions were computed against inputs
+            # per-batch dispatch would not have fed it: break and
+            # replay the tail bit-identically.
+            ch, asg = tup[0], tup[1]
+            rows_deb = ch[asg].astype(np.int64)
+            if rows_deb.size:
+                np.subtract.at(mirror, rows_deb,
+                               inf.eb.pf.requests[asg])
+            diverged = bool(
+                rows_deb.size
+                and not np.isfinite(mirror[np.unique(rows_deb)]).all())
+            rows, fvals, pvals = self.cache.drain_dyn_rows(
+                self._loop_listener)
+            if not diverged and rows.size:
+                # A row the tranche's pad cannot represent (node add
+                # that grew the cache mid-tranche) is divergence by
+                # definition — per-batch dispatch would re-snapshot at
+                # the bigger pad and could place pods there.
+                if int(rows[-1]) >= mirror.shape[0]:
+                    diverged = True
+                else:
+                    diverged = (not np.array_equal(fvals, mirror[rows])
+                                or not np.array_equal(pvals,
+                                                      pmirror[rows]))
+            if not diverged and self._nominations:
+                # A preemption nomination reserves capacity the carried
+                # chain cannot represent (same stand-down as residency).
+                diverged = True
+            if diverged:
+                if j < n_slots - 1:
+                    self._loop_break("carry divergence", slot=j)
+                    self._replay_tail(slot_batches, j + 1, anchor)
+                else:
+                    # Tail divergence: every decision is consumed, only
+                    # the carry adoption is off — drop it (next batch
+                    # re-uploads) without a per-batch replay.
+                    self._loop_break("tail divergence", slot=j)
+                return
+
+        # ---- clean completion: adopt the fused carry ------------------
+        if carried:
+            res.free_dev = free_final
+            res.mirror_free = mirror
+            res.pending_rows = res.pending_pre = None
+            res.pending_prows = res.pending_ppre = None
+
+    def _encode_batch(self, batch: List[QueuedPodInfo], pods: List[Pod],
+                      P_pad: int, *, loop_slot: bool = False):
+        """The encode block shared by per-batch prepare and ring-slot
+        staging (``batch`` already priority-sorted, ``pods`` its pod
+        list). One store pass per pod resolves every volume-derived
+        input (readiness, claim mount rows, zone requirement); both
+        encode callbacks share it via the returned per-batch memo.
+        ``fail_closed`` maps pod key → (plugin, reason) for pods whose
+        required anti-affinity/affinity term or DoNotSchedule spread
+        constraint cannot fit the encoding slots (or whose forbidden
+        domains exceed the anti_forbid slots) — they must be rejected
+        after the step rather than scheduled against a silently
+        weakened constraint. Only constraints this profile's plugin set
+        actually ENFORCES fail closed: a profile without
+        InterPodAffinity ignores affinity terms entirely (encode always
+        records them; only the filter enforces), so an unrepresentable
+        term must not park the pod under a plugin that can never regate
+        it. Returns (vol_memo, fail_closed, eb)."""
+        vol_memo: Dict[str, tuple] = {}
+
+        def vol_state(pod: Pod) -> tuple:
+            st = vol_memo.get(pod.key)
+            if st is None:
+                st = vol_memo[pod.key] = self._volume_state(pod)
+            return st
+
+        fail_closed: Dict[str, tuple] = {}  # pod key → (plugin, reason)
+        anti_fn = None
+        if self._anti_enabled:
+            max_forbid = self.cache.cfg.max_anti_forbid
+
+            def anti_fn(pod: Pod) -> List[tuple]:
+                pairs = self.cache.anti_forbidden_for(pod)
+                if any(entry[0] < 0 for entry in pairs):
+                    # (-1, -1) sentinel: a running pod's matching anti
+                    # term has an unregistrable topology key — permanent
+                    # until that pod leaves, not a domain-count problem.
+                    fail_closed.setdefault(pod.key, (
+                        "InterPodAffinity",
+                        "a running pod's matching anti-affinity term "
+                        "has an unrepresentable topology key (registry "
+                        "full); failing closed"))
+                elif len(pairs) > max_forbid:
+                    fail_closed.setdefault(pod.key, (
+                        "InterPodAffinity",
+                        f"pod is repelled by more than {max_forbid} "
+                        "distinct anti-affinity domains; failing closed "
+                        "rather than evaluating a truncated constraint"))
+                return pairs
+
+        encode_hard: Dict[int, tuple] = {}
+        with span("encode.pods", pods=len(pods),
+                  **({"loop_slot": 1} if loop_slot else {})):
+            eb = encode_pods(pods, P_pad, cfg=self.cache.cfg,
+                             registry=self.cache.registry,
+                             overflow=self.cache.overflow,
+                             volumes_ready_fn=lambda p: vol_state(p)[0],
+                             gang_bound_fn=self.cache.gang_bound_count,
+                             volume_info_fn=lambda p: vol_state(p)[1:],
+                             anti_forbidden_fn=anti_fn,
+                             hard_failed=encode_hard,
+                             selector_spread=self._selspread_enabled)
+        for idx, infos in encode_hard.items():
+            for info in infos:
+                if self._fail_closed_plugins.get(info[0], True):
+                    fail_closed.setdefault(batch[idx].pod.key, info)
+                    break
+        return vol_memo, fail_closed, eb
+
+    def _stage_slot(self, batch: List[QueuedPodInfo], P_ring: int,
+                    nf, names, af, row_incs) -> "_InflightBatch":
+        """Encode one ring slot at the tranche's fixed pod pad — the
+        prepare phase minus snapshot and dispatch. Ragged slots pad with
+        masked (invalid) rows; the shortlist/greedy bodies mask them, so
+        decisions for the real rows are bit-identical to the slot's
+        natural bucket (pinned by tests/test_device_loop.py)."""
+        t_in = time.perf_counter()
+        self._prep_step0 = self._step_counter
+        self._step_counter += 1
+        inf = _InflightBatch()
+        with self._metrics_lock:
+            inf.h2d0 = self._metrics["h2d_bytes_total"]
+            inf.fetch0 = self._metrics["fetch_bytes_total"]
+        batch = sorted(batch, key=lambda q: -q.pod.spec.priority)
+        pods = [q.pod for q in batch]
+        t0 = time.perf_counter()
+        self._book_gap("encode", t0 - t_in)
+        inf.gap, self._gap_pending = self._gap_pending, {}
+        vol_memo, fail_closed, eb = self._encode_batch(
+            batch, pods, P_ring, loop_slot=True)
+        if fail_closed:
+            # Loop-safe pods cannot trip slot constraints by
+            # construction; a symmetric anti-affinity overflow from
+            # RUNNING pods still can. Containment replays everything
+            # per-batch, where the fail-closed machinery applies.
+            raise EngineDesync(
+                "loop slot hit a fail-closed encode verdict")
+        inf.batch, inf.pods = batch, pods
+        inf.vol_memo, inf.fail_closed = vol_memo, {}
+        inf.eb, inf.names, inf.row_incs = eb, names, row_incs
+        inf.nf, inf.af = nf, af
+        inf.key = jax.random.fold_in(self._key, self._step_counter)
+        inf.sample_k = None
+        inf.decision = None
+        inf.spread_dev = None
+        inf.t0, inf.t_encode = t0, time.perf_counter()
+        inf.t_dispatch = inf.t_encode
+        self._batch_seq += 1
+        inf.seq = self._batch_seq
+        with self._metrics_lock:
+            self._prep_window = (t0, inf.t_dispatch)
+        return inf
+
     # ---- one batched scheduling cycle ----------------------------------
 
     def trace_next_batch(self, trace_dir: str) -> None:
@@ -1917,19 +2470,6 @@ class Scheduler:
         batch = sorted(batch, key=lambda q: -q.pod.spec.priority)
         pods = [q.pod for q in batch]
 
-        # Encode pods FIRST: constraints may register new topology keys,
-        # which the node snapshot's domain tables must reflect.
-        # One store pass per pod resolves every volume-derived input
-        # (readiness, claim mount rows, zone requirement); both encode
-        # callbacks share it via a per-batch memo.
-        vol_memo: Dict[str, tuple] = {}
-
-        def vol_state(pod: Pod) -> tuple:
-            st = vol_memo.get(pod.key)
-            if st is None:
-                st = vol_memo[pod.key] = self._volume_state(pod)
-            return st
-
         t0 = time.perf_counter()
         # Batch-formation glue (gang pull + priority sort + per-batch
         # setup) between the pop and the metered encode window — the
@@ -1943,59 +2483,10 @@ class Scheduler:
             # end = still encoding — the commit worker's encode-overlap
             # booking clips such a window at its own flush end)
             self._prep_window = (t0, None)
-        # Fail closed on unrepresentable hard constraints: a pod whose
-        # required anti-affinity/affinity term or DoNotSchedule spread
-        # constraint cannot fit the encoding slots (or whose forbidden
-        # domains exceed the anti_forbid slots) would otherwise be
-        # scheduled against a silently weakened constraint — record the
-        # pod with its reason and reject it after the step instead.
-        fail_closed: Dict[str, tuple] = {}  # pod key → (plugin, reason)
-        anti_fn = None
-        if self._anti_enabled:
-            max_forbid = self.cache.cfg.max_anti_forbid
-
-            def anti_fn(pod: Pod) -> List[tuple]:
-                pairs = self.cache.anti_forbidden_for(pod)
-                if any(entry[0] < 0 for entry in pairs):
-                    # (-1, -1) sentinel: a running pod's matching anti term
-                    # has an unregistrable topology key — permanent until
-                    # that pod leaves, not a domain-count problem.
-                    fail_closed.setdefault(pod.key, (
-                        "InterPodAffinity",
-                        "a running pod's matching anti-affinity term has "
-                        "an unrepresentable topology key (registry full); "
-                        "failing closed"))
-                elif len(pairs) > max_forbid:
-                    fail_closed.setdefault(pod.key, (
-                        "InterPodAffinity",
-                        f"pod is repelled by more than {max_forbid} "
-                        "distinct anti-affinity domains; failing closed "
-                        "rather than evaluating a truncated constraint"))
-                return pairs
-
-        encode_hard: Dict[int, tuple] = {}
-        with span("encode.pods", pods=len(pods)):
-            eb = encode_pods(pods,
-                             step_bucket(len(pods), cfg.pod_bucket_min),
-                             cfg=self.cache.cfg,
-                             registry=self.cache.registry,
-                             overflow=self.cache.overflow,
-                             volumes_ready_fn=lambda p: vol_state(p)[0],
-                             gang_bound_fn=self.cache.gang_bound_count,
-                             volume_info_fn=lambda p: vol_state(p)[1:],
-                             anti_forbidden_fn=anti_fn,
-                             hard_failed=encode_hard,
-                             selector_spread=self._selspread_enabled)
-        # Only fail closed for constraints this profile's plugin set
-        # actually ENFORCES: a profile without InterPodAffinity ignores
-        # affinity terms entirely (encode always records them; only the
-        # filter enforces), so an unrepresentable term must not park the
-        # pod under a plugin that can never regate it.
-        for idx, infos in encode_hard.items():
-            for info in infos:
-                if self._fail_closed_plugins.get(info[0], True):
-                    fail_closed.setdefault(batch[idx].pod.key, info)
-                    break
+        # Encode pods FIRST: constraints may register new topology keys,
+        # which the node snapshot's domain tables must reflect.
+        vol_memo, fail_closed, eb = self._encode_batch(
+            batch, pods, step_bucket(len(pods), cfg.pod_bucket_min))
         # Versioned snapshot: the static version is observed under the
         # snapshot lock (the snapshot's own topology refresh can bump it),
         # and the cache skips host copies of static leaves we already hold
@@ -2132,6 +2623,7 @@ class Scheduler:
         FAULTS.hit("step")
         with span("step.dispatch"):
             decision = step_fn(eb, nf, af, key)
+        self._sup_count("steps_dispatched")
         # Pack every per-pod output into ONE device buffer before
         # fetching: on a remote-TPU tunnel each np.asarray is a full
         # round trip, and five separate fetches of tiny arrays cost ~4
@@ -2202,6 +2694,10 @@ class Scheduler:
             inf.fetch1 = self._metrics["fetch_bytes_total"]
         self._watchdog_check(inf)
         self._sup.note_clean()
+        if self._loop_cooldown > 0:
+            # The loop→pipelined rung's probation: one clean resolved
+            # batch pays one cooldown tick (scheduling thread only).
+            self._loop_cooldown -= 1
         if TIMELINE.enabled:
             self._timeline_tick()
 
@@ -2366,8 +2862,16 @@ class Scheduler:
                 SLO_PREARM_WATCHDOG_S
         if not wd:
             return
-        gather_gap = max(0.0, inf.t_fetch_start - inf.t_dispatch)
-        step_window = (inf.t_step - inf.t_encode) - gather_gap
+        if inf.step_share is not None:
+            # Loop-mode slot: the deadline is judged against this
+            # batch's SHARE of the tranche's fused device window — the
+            # per-batch deadline thereby scales with loop depth (a
+            # depth-8 tranche compares window/8 per slot, so a deadline
+            # sized for one batch doesn't falsely trip on eight).
+            step_window = inf.step_share
+        else:
+            gather_gap = max(0.0, inf.t_fetch_start - inf.t_dispatch)
+            step_window = (inf.t_step - inf.t_encode) - gather_gap
         if step_window > wd:
             self._sup_count("watchdog_trips")
             instant("watchdog.trip", window_s=round(step_window, 6),
@@ -2403,10 +2907,14 @@ class Scheduler:
         # dispatch and this fetch; stamping the fetch start keeps that
         # host-side gap out of the step metric (it books as gap time).
         inf.t_fetch_start = time.perf_counter()
+        # decision is None for a loop-mode slot (the tranche resolver
+        # pre-unpacked the stacked fetch); the filter count is a static
+        # profile property either way.
+        n_filters = (decision.reject_counts.shape[0]
+                     if decision is not None else len(self.filter_names))
         (chosen, assigned, gang_rejected, feasible, feasible_static,
          rejects, sl_repaired) = self._fetch_decision(
-            inf.packed_dev, eb.pf.valid.shape[0],
-            decision.reject_counts.shape[0], decision)
+            inf.packed_dev, eb.pf.valid.shape[0], n_filters, decision)
         # Supervisor fetch-sanity detector — BEFORE the residency replay
         # trusts ``chosen``: a corrupted readback (defective transport,
         # injected fetch:corrupt) must abort the batch, not poison the
@@ -2874,9 +3382,16 @@ class Scheduler:
         # only. In pipelined mode the next batch's queue gather runs
         # between dispatch and the fetch; that slice is inter-stage gap,
         # not device time (booking it as step_s would corrupt the
-        # sync-vs-pipelined per-stage comparison).
-        gather_gap = max(0.0, inf.t_fetch_start - inf.t_dispatch)
-        step_s = (t_step - inf.t_encode) - gather_gap
+        # sync-vs-pipelined per-stage comparison). A loop-mode slot
+        # books its tranche-window SHARE instead: its own stamps span
+        # the whole fused dispatch, and booking the full window per
+        # slot would count the tranche's device time depth times.
+        if inf.step_share is not None:
+            gather_gap = 0.0
+            step_s = inf.step_share
+        else:
+            gather_gap = max(0.0, inf.t_fetch_start - inf.t_dispatch)
+            step_s = (t_step - inf.t_encode) - gather_gap
         gap = inf.gap
         with self._metrics_lock:
             m = self._metrics
@@ -3782,6 +4297,13 @@ class Scheduler:
         # (0 = off — knob, auction/mesh gate, or a certification desync
         # reverted the engine to the full-width scan).
         out["shortlist_width"] = int(self._shortlist_k or 0)
+        # Persistent device loop gauges: the ring depth the NEXT tranche
+        # would use (0 = loop disabled/ineligible; the overload tuner
+        # steps it down under ``tuned``) and whether the persistent
+        # compilation cache armed at init.
+        out["loop_depth_effective"] = (self._effective_loop_depth()
+                                       if self._loop_enabled else 0)
+        out["compile_cache_on"] = int(self._compile_cache_on)
         # Supervisor state: the ladder rung as a gauge (0 = full fast
         # path; exposed on /metrics via the service provider) plus its
         # name for humans/tests (non-numeric — dropped from exposition).

@@ -661,6 +661,139 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
     return guarded
 
 
+_LOOP_CACHE: dict = {}
+
+
+def build_loop_step(plugin_set: PluginSet, *,
+                    cfg: EncodingConfig = DEFAULT_ENCODING,
+                    assignment: str = "greedy",
+                    shortlist: Optional[int] = None,
+                    slim: bool = True):
+    """Compile the PERSISTENT DEVICE LOOP: one jitted program that
+    consumes a depth-D work ring of pre-encoded, fixed-shape batches and
+    runs the whole tranche without returning to Python between batches.
+
+    Returns ``loop(eb_stack, nf, af, counters, base_key) ->
+    (packed_stack, free_final)`` where every leaf of ``eb_stack`` is the
+    per-batch EncodedBatch leaf stacked along a leading depth axis,
+    ``counters`` is the (D,) u32 step-counter value each slot would have
+    drawn on the per-batch path (the loop folds it into ``base_key``
+    exactly like the engine's per-batch ``fold_in``, so tie-break
+    streams are bit-identical), and ``nf``/``af`` are shared across the
+    tranche. The body is THE SAME compiled step the per-batch path runs
+    (ops/pipeline.build_step — nested jit inlines at trace time, so the
+    op sequence is identical); ``lax.scan`` carries ``free`` across
+    iterations — slot k+1's input IS slot k's ``free_after``, the
+    residency chain fused on device — and emits one packed slim/i32
+    decision buffer per slot, stacked so the host fetches the whole
+    tranche in a SINGLE device→host transfer.
+
+    Sharding-pinning rule (the pjit guidance of SNIPPETS.md [2]/[3]):
+    the carry's output sharding must equal its input sharding or XLA
+    inserts a reshard between iterations. Here the carry is the step's
+    own ``free_after``, produced by the identical program that consumed
+    ``free`` — same shape, same layout, and on one device the identity
+    placement — so nothing moves between slots. The mesh path keeps its
+    per-batch dispatch (the engine gates the loop off there) until the
+    multi-host loop follow-up pins the carry to
+    ``parallel.mesh.leaf_sharding`` explicitly.
+
+    Constraints mirror the engine's loop gates: greedy-only (the carry
+    replay contract), no explain (per-batch matrices would have to stack
+    D-deep), and ``used_ports`` rides along un-carried — the engine
+    stages only port-free batches into the ring, so the tranche's port
+    table is invariant by construction.
+    """
+    if assignment != "greedy":
+        raise ValueError("the device loop carries the greedy scan's "
+                         "free chain; auction keeps per-batch dispatch")
+    if shortlist is not None and shortlist < 1:
+        shortlist = None
+    cache_key = (
+        tuple(p.trace_key() for p in plugin_set.filter_plugins),
+        tuple((p.trace_key(), plugin_set.weight_of(p))
+              for p in plugin_set.score_plugins),
+        cfg, shortlist, slim, "device_loop",
+    )
+    cached = _LOOP_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
+    # The loop body IS the per-batch step (process-wide memo — a tuner
+    # revisit of the shortlist width reuses the compiled body).
+    step = build_step(plugin_set, explain=False, cfg=cfg,
+                      assignment=assignment, shortlist=shortlist)
+    from .residency import pack_decision_i32, pack_decision_slim
+
+    pack = pack_decision_slim if slim else pack_decision_i32
+
+    def loop(eb_stack, nf, af, counters, base_key):
+        def body(free, slot):
+            eb_s, counter = slot
+            # Identical key derivation to the per-batch path: fold the
+            # slot's pre-assigned step-counter value into the engine's
+            # base key. fold_in is value-deterministic, so a traced u32
+            # draws the same stream as the host's python int.
+            key = jax.random.fold_in(base_key, counter)
+            d = step(eb_s, nf._replace(free=free), af, key)
+            packed = pack(d.chosen, d.assigned, d.gang_rejected,
+                          d.feasible_counts, d.feasible_static,
+                          d.reject_counts, d.shortlist_repaired)
+            return d.free_after, packed
+
+        with jax.named_scope("minisched.device_loop"):
+            free_final, packs = jax.lax.scan(
+                body, nf.free, (eb_stack, counters))
+        return packs, free_final
+
+    jitted = jax.jit(loop)
+    _LOOP_CACHE[cache_key] = jitted
+    return jitted
+
+
+_COMPILE_CACHE: dict = {"dir": None}
+
+
+def enable_compile_cache(path: str) -> bool:
+    """Arm jax's persistent compilation cache at ``path`` (the
+    MINISCHED_COMPILE_CACHE knob — first slice of the ROADMAP cold-start
+    item): compiled executables for the engine's step/loop shape buckets
+    survive process restarts, so a restarted scheduler serves its first
+    batches without re-paying XLA compiles. Idempotent and process-wide
+    (one latch — engines share the jit caches anyway); returns True when
+    the cache is armed, False when this toolchain lacks the API (the
+    knob degrades to a no-op, never an engine failure)."""
+    if not path:
+        return False
+    if _COMPILE_CACHE["dir"] == path:
+        return True
+    try:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Persist even the sub-second CPU-shape compiles: the cold-start
+        # item's unit of progress is "compiles survive restarts", and
+        # the default 1s/64KB floors would skip every test-shape entry.
+        for knob, val in (("jax_persistent_cache_min_compile_time_secs",
+                           0.0),
+                          ("jax_persistent_cache_min_entry_size_bytes",
+                           0)):
+            try:
+                jax.config.update(knob, val)
+            except Exception:  # knob absent on this jax — keep the dir
+                pass
+        _COMPILE_CACHE["dir"] = path
+        return True
+    except Exception:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "MINISCHED_COMPILE_CACHE=%s: compilation cache unavailable "
+            "on this toolchain; continuing without it", path,
+            exc_info=True)
+        return False
+
+
 def max_normalize_100(scores: jnp.ndarray, feasible: jnp.ndarray) -> jnp.ndarray:
     """Standard k8s NormalizeScore: scale so the best feasible node gets 100.
     Rows with all-zero max pass through unchanged (upstream behavior)."""

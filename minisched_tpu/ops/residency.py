@@ -120,6 +120,32 @@ def pack_decision_slim(chosen, assigned, gang_rejected, feasible,
     ])
 
 
+@jax.jit
+def pack_decision_i32(chosen, assigned, gang_rejected, feasible,
+                      feasible_static, rejects, repaired) -> jnp.ndarray:
+    """The legacy all-i32 fused decision pack as a (6+F, P) array — the
+    engine's MINISCHED_DEVICE_RESIDENT=0 readback layout (row order:
+    chosen, assigned, gang_rejected, feasible, feasible_static,
+    repaired, rejects…). Shared here so the device loop
+    (ops/pipeline.build_loop_step) can stack the identical buffer the
+    per-batch path fetches; engine/scheduler.py keeps its historical
+    ``_pack_decision`` alias."""
+    head = jnp.stack([chosen.astype(jnp.int32),
+                      assigned.astype(jnp.int32),
+                      gang_rejected.astype(jnp.int32),
+                      feasible.astype(jnp.int32),
+                      feasible_static.astype(jnp.int32),
+                      repaired.astype(jnp.int32)])
+    return jnp.concatenate([head, rejects.astype(jnp.int32)], axis=0)
+
+
+def unpack_decision_i32(buf: np.ndarray):
+    """Host-side inverse of pack_decision_i32 over a writable fetched
+    (6+F, P) i32 array → the same 7-tuple unpack_decision_slim returns."""
+    return (buf[0], buf[1].astype(bool), buf[2].astype(bool),
+            buf[3], buf[4], buf[6:], buf[5].astype(bool))
+
+
 def slim_buffer_bytes(p: int, f: int) -> int:
     """Host-side size model of pack_decision_slim's buffer (bytes)."""
     return 4 * p + 3 * ((p + 7) // 8) + 2 * p + 2 * p + 2 * f * p

@@ -49,6 +49,15 @@ def main() -> None:
     ap.add_argument("--c4", action="store_true",
                     help="profile the config-4 topology profile instead "
                          "of the resources-only headline profile")
+    ap.add_argument("--loop", type=int, default=0, metavar="DEPTH",
+                    help="also profile the persistent device loop "
+                         "(ops/pipeline.build_loop_step) at this ring "
+                         "depth: one fused dispatch+stacked fetch over "
+                         "DEPTH copies of the batch vs DEPTH per-batch "
+                         "dispatch/fetch cycles — the dispatches-per-"
+                         "batch claim at raw-op level, plus the loop "
+                         "depth/iteration/break counters an engine run "
+                         "exposes via metrics()")
     ap.add_argument("--passes", action="store_true",
                     help="per-pass attribution ladder: time the step "
                          "with an increasing plugin subset; successive "
@@ -192,6 +201,54 @@ def main() -> None:
     print(f"d2h/batch decision fetch = {slim.nbytes} B slim vs "
           f"{legacy.nbytes} B i32 ({legacy.nbytes / max(slim.nbytes, 1):.2f}x)",
           flush=True)
+    if args.loop > 1:
+        # Persistent device loop (MINISCHED_DEVICE_LOOP): DEPTH copies
+        # of this batch through ONE fused lax.scan dispatch + ONE
+        # stacked fetch, vs the same work as DEPTH per-batch cycles.
+        # Raw-op twin of the engine counters: an engine run reports
+        # the live versions as metrics() steps_dispatched /
+        # loop_tranches / loop_iterations / loop_breaks (and
+        # `make bench-deviceloop` commits them).
+        from minisched_tpu.ops.pipeline import build_loop_step
+        from minisched_tpu.ops.residency import (pack_decision_slim as
+                                                 _slim_pack)
+
+        depth = args.loop
+        loop_fn = build_loop_step(pset, shortlist=sl_k, slim=True)
+        eb_stack = jax.tree_util.tree_map(
+            lambda a: np.broadcast_to(a, (depth,) + a.shape).copy(), eb)
+        ctrs = np.arange(1, depth + 1, dtype=np.uint32)
+
+        def fused():
+            packs, _free = loop_fn(eb_stack, nf, af, ctrs, key)
+            return np.array(packs)   # ONE stacked d2h transfer
+
+        stack = timed(f"loop_fused_s[{depth}]", fused)
+
+        def per_batch():
+            bufs = []
+            for c in ctrs:           # DEPTH dispatches + DEPTH fetches
+                dd = step(eb, nf, af, jax.random.fold_in(key, int(c)))
+                bufs.append(np.array(_slim_pack(
+                    dd.chosen, dd.assigned, dd.gang_rejected,
+                    dd.feasible_counts, dd.feasible_static,
+                    dd.reject_counts, dd.shortlist_repaired)))
+            return bufs
+
+        timed(f"loop_perbatch_s[{depth}]", per_batch)
+        fused_s = stages[f"loop_fused_s[{depth}]"]
+        pb_s = stages[f"loop_perbatch_s[{depth}]"]
+        print(f"device_loop: depth={depth} iterations={depth} "
+              f"dispatches=1 fetches=1 breaks=0 (raw op; a live engine "
+              "counts breaks via metrics()['loop_breaks'])", flush=True)
+        print(f"device_loop: dispatches/batch {1.0 / depth:.3f} fused "
+              f"vs 1.0 per-batch; stacked fetch {stack.nbytes} B once "
+              f"vs {stack.nbytes // depth} B x{depth}; wall "
+              f"{fused_s:.4f} s fused vs {pb_s:.4f} s per-batch "
+              f"({pb_s / max(fused_s, 1e-9):.2f}x — dispatch overhead "
+              "is the TPU-tunnel prize; CPU mostly proves the ledger)",
+              flush=True)
+
     if d.spread_pre.shape[0]:
         timed("sp_fetch_s", lambda: np.array(_pack_spread(
             d.spread_pre, d.spread_dom, d.spread_min, d.scan_groups)))
