@@ -13,12 +13,12 @@ CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 SHELL := /bin/bash
 
 .PHONY: test tier1 fault-smoke shortlist-smoke trace-smoke slo-smoke \
-        churn-smoke overload-smoke loop-smoke profile-smoke start \
-        start-remote start-client-engine demo docs bench bench_sharded \
-        bench-cpu bench-pipeline bench-residency bench-shortlist \
-        bench-trace bench-slo bench-churn bench-overload \
-        bench-deviceloop bench-check dryrun dryrun-dcn soak soak-faults \
-        soak-churn soak-overload
+        churn-smoke overload-smoke loop-smoke index-smoke profile-smoke \
+        start start-remote start-client-engine demo docs bench \
+        bench_sharded bench-cpu bench-pipeline bench-residency \
+        bench-shortlist bench-trace bench-slo bench-churn bench-overload \
+        bench-deviceloop bench-index bench-coldstart bench-check dryrun \
+        dryrun-dcn soak soak-faults soak-churn soak-overload
 
 # Unit + integration suite on a virtual 8-device CPU mesh.
 test:
@@ -89,6 +89,19 @@ loop-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_device_loop.py -x -q \
 	  -p no:cacheprovider -p no:randomly
 
+# Fast deterministic maintained-index suite (~30 s): bit-identity of
+# the device-resident class-row index vs the per-batch full step in
+# every engine mode (sync/pipelined/upload/shortlist-off/device-loop),
+# raw-op build/refresh/assign exactness incl. plateau inputs, the
+# steady-state refresh-not-rebuild ledger, adversarial contention
+# repairing in-scan, unassigned-row fallback with real attribution,
+# residency-resync rebuilds, narrowing-vs-widening node updates, the
+# K-dial, and registry-overflow containment. A tier-1 prerequisite
+# after loop-smoke: the index must never change a decision.
+index-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_index.py -x -q \
+	  -p no:cacheprovider -p no:randomly
+
 # The EXACT ROADMAP tier-1 verify command (dots count + exit code
 # preserved) — what the driver runs after every PR; run it locally
 # before shipping. shortlist-smoke runs first: the arbitration
@@ -97,9 +110,11 @@ loop-smoke:
 # slo-smoke (the actuator rides the sentinel); churn-smoke last: the
 # lifecycle oracle rides on all of them; loop-smoke after
 # overload-smoke (the ring composes with the tuner's dials and must
-# never change a decision).
+# never change a decision); index-smoke after loop-smoke (the
+# maintained index composes with ring, residency, and the K-dial and
+# must never change a decision either).
 tier1: shortlist-smoke trace-smoke slo-smoke overload-smoke loop-smoke \
-       churn-smoke
+       index-smoke churn-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -237,6 +252,8 @@ bench-check:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_compare.py --capture
 	JAX_PLATFORMS=cpu $(PY) tools/bench_overload.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_deviceloop.py --check
+	JAX_PLATFORMS=cpu $(PY) tools/bench_index.py --check
+	JAX_PLATFORMS=cpu $(PY) tools/bench_coldstart.py --check
 
 # Persistent device-loop before/after (the committed
 # BENCH_DEVICELOOP.json): interleaved off/on min-of-4 rounds of the
@@ -249,6 +266,26 @@ bench-check:
 # (source bench-deviceloop) so `make bench-check` gates them.
 bench-deviceloop:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_deviceloop.py
+
+# Maintained-index before/after (the committed BENCH_INDEX.json):
+# interleaved off/on min-of-4 rounds of the streaming phase —
+# steady-state scored rows per batch (the plugin-evaluation ledger)
+# down ≥10× at 2000 × 1000 (full P_pad·N vs the warm registry's delta
+# refresh), a paired identical-workload run diffing every placement
+# (zero divergence), hit/fallback/repair/rebuild rates reported, zero
+# certification desyncs. Stable stream keys append to BENCH_LEDGER.json
+# (source bench-index) so `make bench-check` gates them.
+bench-index:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_index.py
+
+# Cross-process compile-cache proof (the committed BENCH_COLDSTART.json;
+# ROADMAP cold-start item): two child processes share one
+# MINISCHED_COMPILE_CACHE directory — the first pays the real XLA
+# compiles and populates it, the second (a fresh process) must load
+# executables instead of compiling (warmup compile seconds ≈ 0). Keys
+# append to BENCH_LEDGER.json (source bench-coldstart).
+bench-coldstart:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_coldstart.py
 
 # p99-under-churn bench (the committed BENCH_CHURN.json): interleaved
 # clean/faulted lifecycle-churn rounds through bench.churn_bench —

@@ -100,6 +100,36 @@ class DynDeltaListener:
         self.valid = False
 
 
+class IndexDeltaListener(DynDeltaListener):
+    """The maintained arbitration index's registration in the delta
+    fan-in (ops/index.py; engine/scheduler._ArbIndex): beyond the
+    dynamic-leaf ``rows`` every DynDeltaListener receives, the cache
+    classifies STATIC node mutations for it —
+
+      * a NARROWING change (cordon, taints grown, allocatable shrunk,
+        node removed — ``state.events.node_update_narrows_only``) can
+        only LOWER the changed row's scores, so it lands in
+        ``static_rows`` and the index repairs that row in place exactly
+        like a capacity debit;
+      * a WIDENING change (new node, uncordon, labels/images/capacity
+        moved, topology-domain refresh) bumps the ``inval`` epoch: the
+        consumer compares the epoch at drain time and REBUILDS — the
+        conservative rung of the index's repair ladder (a widened node
+        may rise anywhere, and a fresh node may even grow the pad past
+        the columns the index ever evaluated).
+
+    Drained together with ``rows`` by ``drain_index_rows``; the dyn
+    epoch protocol of the base class is untouched (this listener is
+    never handed to snapshot_resident)."""
+
+    __slots__ = ("static_rows", "inval")
+
+    def __init__(self):
+        super().__init__()
+        self.static_rows: set = set()
+        self.inval = 0
+
+
 def step_bucket(n: int, minimum: int = 16) -> int:
     """Padding bucket for the STEP's array shapes: power-of-two up to
     2048, then eighth-steps between octaves (2^k · (8+j)/8, j = 1..8).
@@ -226,6 +256,10 @@ class NodeFeatureCache:
         # every mutator of free/used_ports marks the touched rows into
         # each registered listener's set (see DynDeltaListener).
         self._dyn_listeners: List[DynDeltaListener] = []
+        # Maintained-index consumers (subset of _dyn_listeners): static
+        # node mutations additionally classify into narrowing row marks
+        # vs widening invalidation epochs (see IndexDeltaListener).
+        self._index_listeners: List[IndexDeltaListener] = []
 
     def register_dyn_listener(self) -> DynDeltaListener:
         """Register a consumer of the dynamic-leaf elision protocol (one
@@ -249,6 +283,52 @@ class NodeFeatureCache:
             rows = rows.tolist()
         for lst in self._dyn_listeners:
             lst.rows.update(rows)
+
+    def register_index_listener(self) -> IndexDeltaListener:
+        """Register a maintained-index consumer: receives dynamic-leaf
+        row marks like every DynDeltaListener PLUS the static-mutation
+        classification (narrowing rows vs widening invalidation epochs
+        — see IndexDeltaListener). Never unregistered."""
+        lst = IndexDeltaListener()
+        with self._lock:
+            self._dyn_listeners.append(lst)
+            self._index_listeners.append(lst)
+        return lst
+
+    def _mark_index_static_locked(self, row: int) -> None:
+        """A NARROWING static mutation touched ``row`` (caller holds
+        the lock): index consumers repair the row in place."""
+        for lst in self._index_listeners:
+            lst.static_rows.add(int(row))
+
+    def _inval_index_locked(self) -> None:
+        """A WIDENING (or non-row-attributable) static mutation landed
+        (caller holds the lock): index consumers must rebuild."""
+        for lst in self._index_listeners:
+            lst.inval += 1
+
+    def drain_index_rows(self, lst: IndexDeltaListener):
+        """Drain an index listener's accumulated repair rows — dynamic
+        marks ∪ narrowing static marks — plus its invalidation epoch and
+        the cache ``version`` observed under the same lock hold, WITHOUT
+        touching the dyn epoch protocol. The caller must drain BEFORE
+        taking the snapshot it refreshes against (the tranche
+        validator's baseline-drain discipline) and must NOT serve
+        decisions from the index if the version moved between this
+        drain and its snapshot: a mutation in that window is marked for
+        the NEXT refresh but already inside THIS snapshot's truth, so
+        the cached score for its row would be stale exactly for the
+        batch about to consume it (the engine falls back to the full
+        step for that batch — a counted race, not a desync)."""
+        with self._lock:
+            rows = lst.rows | lst.static_rows
+            lst.rows.clear()
+            lst.static_rows.clear()
+            if not rows:
+                return np.zeros(0, dtype=np.int32), lst.inval, self.version
+            out = np.fromiter(rows, dtype=np.int32, count=len(rows))
+            out.sort()
+            return out, lst.inval, self.version
 
     def drain_dyn_rows(self, lst: DynDeltaListener):
         """Drain a listener's marked rows WITHOUT advancing its epoch or
@@ -288,14 +368,21 @@ class NodeFeatureCache:
 
     # ---- node lifecycle -------------------------------------------------
 
-    def upsert_node(self, node: Node, bound_pods=()) -> None:
+    def upsert_node(self, node: Node, bound_pods=(), *,
+                    narrows_only: Optional[bool] = None) -> None:
         """Encode (or re-encode) a node row. ``bound_pods``: pods to
         account onto the row INSIDE the same lock hold — for node
         re-creation, where pods of the previous incarnation are still
         bound to the name in the store. Accounting them after a separate
         upsert would leave a window in which a concurrent snapshot sees
         the recreated node at full free capacity and a batch over-commits
-        it; snapshot takes this lock, so atomicity follows."""
+        it; snapshot takes this lock, so atomicity follows.
+
+        ``narrows_only``: the informer path's
+        ``state.events.node_update_narrows_only`` verdict for an UPDATE
+        — True routes the static change to the index listeners as an
+        in-place row repair; False/None (unknown, or a fresh node) is
+        a widening invalidation (IndexDeltaListener contract)."""
         with self._lock:
             i = self._index.get(node.metadata.name)
             fresh_row = i is None
@@ -318,6 +405,10 @@ class NodeFeatureCache:
             self._recompute_free_row(i)
             for pod in bound_pods:
                 self._account_bind_locked(pod, node.metadata.name)
+            if narrows_only and not fresh_row:
+                self._mark_index_static_locked(i)
+            else:
+                self._inval_index_locked()
             self.version += 1
             self.static_version += 1
 
@@ -442,6 +533,7 @@ class NodeFeatureCache:
                     feats.topo_domains[:, i] = tcol
                 feats.topo_domains[0, i] = i
             if fresh:
+                self._inval_index_locked()
                 self.version += 1
                 self.static_version += 1
         for node in existing:
@@ -477,6 +569,10 @@ class NodeFeatureCache:
                     self._a_free.append(a)
                 self._drop_gang_member(k)
                 self._anti_drop_locked(k, i)
+            # Node removal is NARROWING for the index: the cleared row
+            # re-evaluates to statically-infeasible (valid=False → NEG)
+            # at the next refresh — an in-place repair, no rebuild.
+            self._mark_index_static_locked(i)
             self.version += 1
             self.static_version += 1
             return gone
@@ -1273,6 +1369,10 @@ class NodeFeatureCache:
             F.compute_topo_domains_row(self._feats, i, self.registry,
                                        self.cfg, keys=keys)
         self._topo_version = v
+        # Not row-attributable (every row's domain columns moved) —
+        # index-eligible plugins read no topology state, but the
+        # conservative rung is an invalidation, not a guess.
+        self._inval_index_locked()
         self.static_version += 1
 
     def _recompute_free_row(self, i: int) -> None:

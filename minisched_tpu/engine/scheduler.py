@@ -37,6 +37,8 @@ from ..faults import FAULTS, FaultWorkerDeath
 from ..obs import Histogram, instant, span
 from ..obs import slo as slo_mod
 from ..obs.timeseries import TIMELINE, TimelineTracker
+from ..ops.index import (build_index_ops, index_eligible,
+                         unpack_index_decision)
 from ..ops.pipeline import (Decision, build_loop_step, build_step,
                             enable_compile_cache)
 from ..ops.residency import (I16_SAT, apply_rows, apply_rows_bytes,
@@ -191,7 +193,8 @@ class _InflightBatch:
                  "t_fetch_start", "t_step", "t_resolved", "commit_t0",
                  "commit_t1", "res_carried", "assumed", "detached",
                  "h2d0", "fetch0", "h2d1", "fetch1", "sl_repairs", "gap",
-                 "step_share")
+                 "step_share", "index_packed_dev", "index_free_after",
+                 "index_served", "scored_rows")
 
     def __init__(self):
         self.failures: List[tuple] = []  # (qpi, plugins, message, retryable)
@@ -228,6 +231,21 @@ class _InflightBatch:
         # chain (_DeviceResidency) — its free_after must be carried and
         # its debits replayed into the host mirror at resolve time.
         self.res_carried = False
+        # Maintained-index batch (engine._ArbIndex): the fused
+        # [chosen|assigned|repaired] device buffer the resolve phase
+        # settles, and the indexed scan's carried free_after (adopted by
+        # residency only when every live row is assigned).
+        self.index_packed_dev = None
+        self.index_free_after = None
+        # True once the resolve phase settled this batch FROM the index
+        # (every row certified + assigned; no full step ran).
+        self.index_served = False
+        # Plugin-evaluation work this batch paid, in pod-row × node-row
+        # units (the scored-rows ledger the index claims ride on): a
+        # full step books P_pad·N_pad (or P_pad·K sampled), an index
+        # refresh C_pad·R_bucket, a rebuild C_pad·N_pad, a fallback
+        # both.
+        self.scored_rows = 0
         # Loop-mode slot: this batch's share of its tranche's fused
         # device window (tranche window / slots). Non-None overrides
         # the dispatch→fetch stamps in the watchdog and step_s
@@ -460,6 +478,138 @@ class _DeviceResidency:
         self.pending_rows = self.pending_pre = None
         self.pending_prows = self.pending_ppre = None
         self.listener.invalidate()
+
+
+class _ArbIndex:
+    """Engine-side lifecycle of the maintained arbitration index
+    (ops/index.py): the pod-class registry, the pending repair-row set,
+    the device IndexState, and the rebuild ladder counters.
+
+    Invariants (asserted end to end by tests/test_index.py):
+
+      I1. every cached candidate score equals the masked_total the full
+          step would compute at that column for that class, as of the
+          snapshot of the last build/refresh. Rows whose truth moved
+          since then are in ``pending`` (the cache marks EVERY
+          free/used_ports mutation — assume, unbind, revocation,
+          informer churn — plus narrowing static changes into the
+          IndexDeltaListener; the drain happens BEFORE the snapshot a
+          refresh evaluates against, so a drained row's new truth is
+          always inside that snapshot).
+      I2. every node column NOT in ``pending`` kept exactly its
+          build/refresh-time value in the maintained (C,N) matrix —
+          its truth never moved (I1's marking completeness) — while
+          widened/unknown static changes (fresh nodes, uncordons,
+          topology refreshes) bumped the listener's ``inval`` epoch and
+          force a full rebuild before the index serves again.
+      I3. decisions are bit-identical to the index-off engine: a served
+          batch's scan is the PR 4 certified machinery over gathered
+          class rows (bit-equal inputs ⇒ bit-equal outputs, in-scan
+          repairs included); any UNASSIGNED live row discards the
+          speculative result and re-dispatches the original full step
+          with the batch's original PRNG draw.
+    """
+
+    __slots__ = ("listener", "k_base", "k_target", "n_built",
+                 "c_max", "registry", "rows", "reg_version", "state",
+                 "pending", "pending_inval", "inval_seen", "needs_rebuild",
+                 "rebuild_streak", "drain_version", "_stack_memo")
+
+    def __init__(self, listener, k: int, c_max: int):
+        self.listener = listener
+        self.k_base = k          # configured width (MINISCHED_INDEX_K)
+        self.k_target = k        # tuner-desired scan width (K-dial)
+        self.n_built = -1        # node pad the live state was built at
+        self.c_max = c_max
+        self.registry: Dict[bytes, int] = {}   # class key → class row
+        self.rows: List[dict] = []             # captured pf leaf rows
+        self.reg_version = 0
+        self.state = None                      # ops.index.IndexState
+        self.pending: Set[int] = set()         # node rows awaiting rescore
+        self.pending_inval = 0   # listener.inval at the LAST drain
+        self.inval_seen = -1     # listener.inval the live state covers
+        self.needs_rebuild = True
+        self.rebuild_streak = 0  # consecutive fallback batches (no hit)
+        self.drain_version = -1  # cache.version at the last drain
+        self._stack_memo = None  # (reg_version, stacked class_pf)
+
+    @property
+    def k_eff(self) -> int:
+        """Indexed-scan width: the tuner's live target. Any width is
+        exact (the certified scan's in-scan repairs absorb a narrow
+        one), so dial moves in either direction cost no rebuild — the
+        maintained state is the full class row, not a K-truncation."""
+        return max(1, self.k_target)
+
+    def drain(self, cache) -> None:
+        """Collect the listener's accumulated repair rows + inval epoch.
+        MUST run before the snapshot the next refresh evaluates against
+        (encode/cache.drain_index_rows discipline); the recorded cache
+        version gates serving — see _index_dispatch."""
+        rows, inval, version = cache.drain_index_rows(self.listener)
+        self.pending.update(int(r) for r in rows)
+        self.pending_inval = inval
+        self.drain_version = version
+
+    def classify(self, pf, length: int):
+        """Map batch pods → class rows, registering unseen classes.
+        The class key is the pod's FULL feature-row byte image: two pods
+        with equal rows behave identically under every column-local
+        plugin, and the engine's index-safety walk keeps batch-relative
+        leaves (gang/claim/group ids) at sentinels so keys never alias
+        across batches. Returns (cls (L,) i32, fresh: bool) or None when
+        the registry is full (the batch takes the full step)."""
+        mats = [np.ascontiguousarray(
+            getattr(pf, f)[:length]).reshape(length, -1).view(np.uint8)
+            for f in pf._fields]
+        blob = np.concatenate(mats, axis=1)
+        cls = np.empty(length, dtype=np.int32)
+        fresh = False
+        for i in range(length):
+            key = blob[i].tobytes()
+            row = self.registry.get(key)
+            if row is None:
+                if len(self.rows) >= self.c_max:
+                    return None
+                row = len(self.rows)
+                self.registry[key] = row
+                self.rows.append({f: np.copy(getattr(pf, f)[i])
+                                  for f in pf._fields})
+                self.reg_version += 1
+                fresh = True
+            cls[i] = row
+        if fresh:
+            self.needs_rebuild = True
+        return cls
+
+    def class_pf(self, template):
+        """The class-representative PodFeatures batch (C_pad rows, pow2
+        bucket), memoized per registry version. Pad rows are all-zero:
+        valid=False → NEG everywhere, never chosen, never bounding."""
+        if self._stack_memo and self._stack_memo[0] == self.reg_version:
+            return self._stack_memo[1]
+        c_pad = bucket_for(max(len(self.rows), 1), 16)
+        leaves = {}
+        for f in template._fields:
+            proto = self.rows[0][f]
+            arr = np.zeros((c_pad,) + proto.shape, dtype=proto.dtype)
+            for c, row in enumerate(self.rows):
+                arr[c] = row[f]
+            leaves[f] = arr
+        stacked = type(template)(**leaves)
+        self._stack_memo = (self.reg_version, stacked)
+        return stacked
+
+    def invalidate(self, reason: str) -> None:
+        """Drop the device state; the next index batch rebuilds
+        (counted). Used when the inputs a refresh consumed are no
+        longer trusted — a residency-carry desync means the attached
+        ``free`` the last refresh scored against may have been
+        corrupt."""
+        log.info("arbitration index invalidated (%s); next index batch "
+                 "rebuilds", reason)
+        self.state = None
+        self.needs_rebuild = True
 
 
 def arbitrate_rwo(batch: List[QueuedPodInfo], assigned, chosen,
@@ -1060,6 +1210,34 @@ class Scheduler:
         self._loop_listener = (self.cache.register_dyn_listener()
                                if self._loop_enabled else None)
         self._loop_cooldown = 0
+        # Maintained arbitration index (MINISCHED_INDEX; ops/index.py +
+        # _ArbIndex): per-pod-class score rows kept device-resident
+        # across batches, repaired by the cache's delta fan-in
+        # (encode/cache.register_index_listener). Gated to the greedy
+        # single-device non-explain engine — the same family as
+        # residency/loop — AND to index-eligible profiles: every active
+        # plugin column-local, no topology/affinity state, scorers on
+        # the identity normalize (ops/index.index_eligible). Decisions
+        # are bit-identical index on/off: an unassigned live row
+        # discards the whole batch's speculative result and the
+        # original full step re-runs with the same PRNG draw.
+        self._index = None
+        if (self.config.index and self.config.assignment == "greedy"
+                and self._mesh is None and not self.config.explain):
+            if index_eligible(plugin_set):
+                self._index = _ArbIndex(
+                    self.cache.register_index_listener(),
+                    self.config.index_k, self.config.index_classes)
+            else:
+                log.info("MINISCHED_INDEX=1 but profile %s is not "
+                         "index-eligible (topology/affinity state or a "
+                         "row-normalizing scorer); keeping the per-batch "
+                         "dataflow", [p.name for p in plugin_set.plugins])
+        # Rebuild-ladder cooldown (the index→rebuild→full-rescore rung
+        # composed with the PR 3 ladder): a rebuild storm parks the
+        # index for probation_batches resolved batches.
+        self._index_cooldown = 0
+        self._idx_check_tick = 0
         # Compile-cache bootstrap (MINISCHED_COMPILE_CACHE; ROADMAP
         # cold-start item, first slice): arm jax's persistent
         # compilation cache BEFORE the first step compile so restarts
@@ -1200,6 +1378,30 @@ class Scheduler:
             "steps_dispatched": 0, "loop_tranches": 0,
             "loop_iterations": 0, "loop_breaks": 0,
             "decision_fetches": 0,
+            # Maintained arbitration index (MINISCHED_INDEX):
+            # index_hits counts batches served entirely from the index
+            # (no full filter+score pass ran); index_fallbacks counts
+            # index-attempted batches that re-dispatched the full step
+            # (an unassigned live row, registry overflow);
+            # index_repair_rows counts node columns rescored IN PLACE
+            # by delta refreshes; index_rebuilds counts full (C,N)
+            # rebuilds (new classes, widening invalidation, node-pad
+            # growth, post-desync); index_uncertified counts per-pod
+            # certificate failures repaired IN-SCAN by the indexed
+            # scan's exact full-row body (counted, never a fallback);
+            # index_races counts serve declines because a cache
+            # mutation raced the drain→snapshot window; the
+            # check/desync pair rides MINISCHED_INDEX_CHECK_EVERY;
+            # index_cooldowns counts fallback-storm parks (the
+            # full-rescore rung). scored_rows_total is the engine-wide
+            # plugin-evaluation ledger in pod-row × node-row units —
+            # the per-batch twin lives in batch_series.scored_rows.
+            "index_hits": 0, "index_fallbacks": 0,
+            "index_repair_rows": 0, "index_rebuilds": 0,
+            "index_uncertified": 0, "index_checks": 0,
+            "index_desyncs": 0, "index_cooldowns": 0,
+            "index_races": 0,
+            "scored_rows_total": 0, "last_scored_rows": 0,
         }
         # Rolling time-series ring of metrics() snapshots
         # (MINISCHED_TIMELINE; obs/timeseries.py). The tracker always
@@ -1334,6 +1536,224 @@ class Scheduler:
                                     explain=self.config.explain,
                                     assignment=self.config.assignment,
                                     shortlist=None)
+
+    # ---- maintained arbitration index (MINISCHED_INDEX) ------------------
+
+    def _index_dispatch(self, inf: "_InflightBatch", batch, eb, nf, af,
+                        key, fail_closed) -> bool:
+        """Try to serve this batch from the maintained device-resident
+        index instead of the full (P,N) filter+score pass: repair the
+        (C,N) class-row state from the drained deltas (in-place rescore
+        of exactly the changed node columns; full rebuild on a widening
+        invalidation, fresh classes, or a node-pad change), then
+        dispatch the certified K-compressed scan over gathered class
+        rows speculatively. Returns True with ``inf.index_packed_dev``
+        staged (the resolve phase settles it — serve, or discard +
+        full-step re-dispatch with the same PRNG draw), False = the
+        caller dispatches the full step.
+
+        Engagement gates mirror the device loop's posture: fast-path
+        rung only (a degraded engine drops speculation first), no
+        nominations (their debits modify the step's ``free`` input
+        outside the delta protocol), no explain recorder (it needs the
+        full Decision), no armed shortlist cross-check (its attribution
+        must not be conflated with the index's own), no fail-closed
+        verdicts, and the shared per-pod safety walk. The serving gate
+        additionally requires that NO cache mutation landed between this
+        batch's delta drain and its snapshot (cache.version unchanged;
+        a raced mutation is marked for the NEXT refresh but already
+        inside THIS snapshot's truth — encode/cache.drain_index_rows) —
+        a counted race, not a desync."""
+        idx = self._index
+        if (idx is None or self._index_cooldown > 0
+                or self._sup.level != 0 or self._nominations
+                or self.recorder is not None or fail_closed
+                or self.config.shortlist_check_every
+                or not self._ring_safe_pods(batch)):
+            return False
+        if (self.cache.version != idx.drain_version
+                or idx.listener.inval != idx.pending_inval):
+            self._sup_count("index_races")
+            return False
+        cls = idx.classify(eb.pf, len(batch))
+        if cls is None:
+            # Class registry full — counted fallback, never an error.
+            self._sup_count("index_fallbacks")
+            return False
+        # Fault gate: maintained-index dispatch seam. ``corrupt``
+        # scribbles one index entry AFTER the refresh below — a defect
+        # the in-scan certificate cannot see (the scribbled score IS the
+        # certificate's input); only the MINISCHED_INDEX_CHECK_EVERY
+        # full-step cross-check can catch it (tests/test_faults.py).
+        act = FAULTS.hit("index")
+        n_pad = int(nf.valid.shape[0])
+        k_eff = idx.k_eff
+        rebuild = (idx.state is None or idx.needs_rebuild
+                   or idx.pending_inval != idx.inval_seen
+                   or idx.n_built != n_pad)
+        build_fn, refresh_fn, assign_fn = build_index_ops(
+            self.plugin_set, k_eff, cfg=self.cache.cfg)
+        class_pf = idx.class_pf(eb.pf)
+        c_pad = int(class_pf.valid.shape[0])
+        if rebuild:
+            with span("index.build", classes=len(idx.rows), n=n_pad):
+                idx.state = build_fn(class_pf, nf, af)
+            idx.n_built = n_pad
+            idx.inval_seen = idx.pending_inval
+            idx.pending.clear()
+            idx.needs_rebuild = False
+            self._sup_count("index_rebuilds")
+            inf.scored_rows += c_pad * n_pad
+        elif idx.pending:
+            rows = np.fromiter(idx.pending, dtype=np.int64,
+                               count=len(idx.pending))
+            rows.sort()
+            rows = rows[rows < n_pad]  # node-pad growth forces rebuild
+            idx.pending.clear()
+            if rows.size:
+                rb = bucket_for(int(rows.size), 16)
+                rows_pad = np.full((rb,), n_pad, dtype=np.int32)
+                rows_pad[:rows.size] = rows
+                with span("index.refresh", rows=int(rows.size)):
+                    idx.state = refresh_fn(idx.state, class_pf, nf, af,
+                                           rows_pad)
+                self._sup_count("index_repair_rows", int(rows.size))
+                inf.scored_rows += c_pad * rb
+        if act == "corrupt" and idx.state is not None:
+            # Scribbled index entries: one node column per class handed
+            # an unbeatable cached score (alternating columns 0/1 per
+            # class, so no uniform legitimate winner can shadow the
+            # corruption) — range-sane, a perfectly ordinary score to
+            # the scan's certificate, decision-wrong.
+            st = idx.state
+            c = st.score.shape[0]
+            alt = np.minimum(np.arange(c) % 2,
+                             n_pad - 1).astype(np.int32)
+            idx.state = st._replace(
+                score=st.score.at[np.arange(c), alt].set(1e6))
+        cls_pad = np.zeros((int(eb.pf.valid.shape[0]),), dtype=np.int32)
+        cls_pad[:len(batch)] = cls
+        with span("index.assign", pods=len(batch), k=k_eff):
+            packed, free_after = assign_fn(
+                idx.state, cls_pad, eb.pf.valid, eb.pf.requests,
+                nf.free, key)
+        self._sup_count("steps_dispatched")
+        inf.index_packed_dev = packed
+        inf.index_free_after = free_after
+        return True
+
+    def _settle_index(self, inf: "_InflightBatch") -> None:
+        """Settle a speculatively index-dispatched batch (resolve phase,
+        BEFORE anything consumes a decision): fetch the fused
+        [chosen | assigned | repaired] buffer in ONE transfer. Every
+        live row assigned ⇒ serve the batch from the indexed scan
+        (index hit: no full filter+score pass ran; in-scan certificate
+        repairs are EXACT and merely counted — index_uncertified). An
+        UNASSIGNED live row — the failure path needs the per-plugin
+        reject attribution the index doesn't compute — discards the
+        speculative result wholesale and re-dispatches the ORIGINAL
+        full step with the batch's original PRNG draw, so decisions are
+        bit-identical to the index-off engine in every case (I3)."""
+        idx = self._index
+        p_pad = int(inf.eb.pf.valid.shape[0])
+        with span("fetch.index"):
+            buf = np.array(inf.index_packed_dev)
+        inf.index_packed_dev = None
+        self._count_fetch(buf.nbytes)
+        self._sup_count("decision_fetches")
+        chosen, assigned, repaired = unpack_index_decision(buf, p_pad)
+        L = len(inf.batch)
+        if bool(assigned[:L].all()):
+            n_f = len(self.filter_names)
+            # Synthesized decision tuple: gang/feasibility/reject planes
+            # are never consulted for a batch whose every row is
+            # assigned (the resolve failure paths read them only for
+            # unassigned rows, and index-safe batches carry no gangs).
+            # The repaired plane rides in the shortlist slot — the
+            # indexed scan's repairs ARE PR 4 repair rescans.
+            inf.packed_dev = (
+                chosen.astype(np.int32), assigned,
+                np.zeros((p_pad,), dtype=bool),
+                np.ones((p_pad,), dtype=np.int32),
+                np.ones((p_pad,), dtype=np.int32),
+                np.zeros((n_f, p_pad), dtype=np.int32),
+                repaired)
+            inf.index_served = True
+            if idx is not None:
+                idx.rebuild_streak = 0
+            self._sup_count("index_hits")
+            self._sup_count("index_uncertified", int(repaired[:L].sum()))
+            self._check_index(inf, chosen, assigned)
+            return
+        # Fallback: the original full-row body applied to the whole
+        # batch — the engine-level repair rung of the ladder.
+        self._sup_count("index_fallbacks")
+        inf.index_free_after = None
+        if idx is not None:
+            idx.rebuild_streak += 1
+            if idx.rebuild_streak >= max(2, self.config.probation_batches):
+                # Rebuild/fallback storm: park the index for a probation
+                # of resolved batches (the ladder's full-rescore rung) —
+                # sustained contention past K is cheaper served by the
+                # plain full step than by paying speculation + fallback
+                # per batch.
+                idx.rebuild_streak = 0
+                self._index_cooldown = max(1, self.config.probation_batches)
+                self._sup_count("index_cooldowns")
+                instant("index.cooldown",
+                        batches=self._index_cooldown)
+        with span("step.dispatch"):
+            decision = self._step(inf.eb, inf.nf, inf.af, inf.key)
+        self._sup_count("steps_dispatched")
+        inf.decision = decision
+        inf.packed_dev = self._pack_dec(decision)
+        inf.scored_rows += p_pad * int(inf.nf.valid.shape[0])
+
+    def _check_index(self, inf: "_InflightBatch", chosen,
+                     assigned) -> None:
+        """Every ``index_check_every`` index-SERVED batches, re-run this
+        batch's exact inputs through the full step and compare decisions
+        — the maintained-index twin of _check_shortlist, covering
+        defects OUTSIDE the certificate's proof (a scribbled index entry
+        — the ``index:corrupt`` gate — or a broken backend gather).
+        Divergence counts an index_desync, permanently disables the
+        index, and aborts into the supervised replay, which re-runs the
+        batch bit-identically on the index-off path."""
+        if not self.config.index_check_every:
+            return
+        self._idx_check_tick += 1
+        if self._idx_check_tick % self.config.index_check_every:
+            return
+        self._sup_count("index_checks")
+        check_step = build_step(self.plugin_set,
+                                explain=self.config.explain,
+                                assignment=self.config.assignment,
+                                shortlist=self._shortlist_k)
+        d = check_step(inf.eb, inf.nf, inf.af, inf.key)
+        ref_c = np.asarray(d.chosen)
+        ref_a = np.asarray(d.assigned)
+        self._count_fetch(ref_c.nbytes + ref_a.nbytes)
+        L = len(inf.batch)
+        if (np.array_equal(chosen[:L], ref_c[:L])
+                and np.array_equal(assigned[:L], ref_a[:L])):
+            return
+        bad = int(np.sum((chosen[:L] != ref_c[:L])
+                         | (assigned[:L] != ref_a[:L])))
+        self._sup_count("index_desyncs")
+        instant("index.desync", pods=bad)
+        self._disable_index(
+            f"decisions diverged from the full step on {bad} pod(s)")
+        raise EngineDesync(
+            "maintained-index certification cross-check failed: "
+            f"decisions diverged from the full step on {bad} pod(s)")
+
+    def _disable_index(self, reason: str) -> None:
+        """Permanently revert to the per-batch dataflow (the shortlist
+        revert idiom): the registered listener keeps accumulating marks
+        harmlessly; nothing ever consumes them again."""
+        log.error("disabling the maintained arbitration index (%s); "
+                  "reverting to the per-batch full step", reason)
+        self._index = None
 
     def _count_h2d(self, nbytes: int) -> None:
         with self._metrics_lock:
@@ -1932,9 +2352,22 @@ class Scheduler:
         assume debits port pods out of pod order, which would break the
         bitwise mirror-vs-truth validation), and no owner references
         when SelectorSpread runs (owner groups read the corpus too).
-        A batch the per-batch path would node-SAMPLE is unsafe as well —
-        the ring runs the full axis and sampling draws a different key
-        path, so fusing it would change decisions."""
+        The per-pod walk is shared with the maintained arbitration
+        index (_ring_safe_pods — the same host-state-independence
+        property gates both fast paths). A batch the per-batch path
+        would node-SAMPLE is unsafe as well — the ring runs the full
+        axis and sampling draws a different key path, so fusing it
+        would change decisions."""
+        if not self._ring_safe_pods(batch):
+            return False
+        n_pad = self._node_pad(self.cache.rows_high_water())
+        if self._sampled_step(n_pad, len(batch), False)[0] is not None:
+            return False
+        return True
+
+    def _ring_safe_pods(self, batch: List[QueuedPodInfo]) -> bool:
+        """The per-pod half of the fast-path safety walk, shared by the
+        device loop's work ring and the maintained arbitration index."""
         for q in batch:
             pod = q.pod
             s = pod.spec
@@ -1947,9 +2380,6 @@ class Scheduler:
                 return False
             if self._selspread_enabled and pod.metadata.owner_references:
                 return False
-        n_pad = self._node_pad(self.cache.rows_high_water())
-        if self._sampled_step(n_pad, len(batch), False)[0] is not None:
-            return False
         return True
 
     def _maybe_run_tranche(self, batch: List[QueuedPodInfo], *,
@@ -2362,6 +2792,9 @@ class Scheduler:
         inf.vol_memo, inf.fail_closed = vol_memo, {}
         inf.eb, inf.names, inf.row_incs = eb, names, row_incs
         inf.nf, inf.af = nf, af
+        # Scored-rows ledger: every ring slot pays the full (P_ring, N)
+        # filter+score pass inside the fused scan body.
+        inf.scored_rows = int(P_ring) * int(nf.valid.shape[0])
         inf.key = jax.random.fold_in(self._key, self._step_counter)
         inf.sample_k = None
         inf.decision = None
@@ -2487,6 +2920,14 @@ class Scheduler:
         # which the node snapshot's domain tables must reflect.
         vol_memo, fail_closed, eb = self._encode_batch(
             batch, pods, step_bucket(len(pods), cfg.pod_bucket_min))
+        if self._index is not None:
+            # Baseline-drain the index listener BEFORE the snapshot the
+            # refresh evaluates against (encode/cache.drain_index_rows
+            # discipline): a mutation landing between this drain and the
+            # snapshot is caught by the version gate in _index_dispatch
+            # and costs one counted full-step fallback, never a stale
+            # serve.
+            self._index.drain(self.cache)
         # Versioned snapshot: the static version is observed under the
         # snapshot lock (the snapshot's own topology refresh can bump it),
         # and the cache skips host copies of static leaves we already hold
@@ -2548,6 +2989,11 @@ class Scheduler:
                 self._sup.escalate("resident carry desync")
                 carried = False
                 res.drop("carry cross-check mismatch")
+                if self._index is not None:
+                    # The index's last refresh scored against the
+                    # now-distrusted carried free — rebuild (counted)
+                    # before the index serves again.
+                    self._index.invalidate("resident carry desync")
                 cached = self._nf_static_device
                 nf, names, static_v, row_incs = (
                     self.cache.snapshot_versioned(
@@ -2560,6 +3006,8 @@ class Scheduler:
                               "through a full snapshot")
                 carried = False
                 res.drop("attach error")
+                if self._index is not None:
+                    self._index.invalidate("residency attach error")
                 cached = self._nf_static_device
                 nf, names, static_v, row_incs = (
                     self.cache.snapshot_versioned(
@@ -2621,28 +3069,50 @@ class Scheduler:
         # Fault gate: jitted step dispatch (err → supervised retry down
         # the ladder; stall → lands in the watchdog's step window).
         FAULTS.hit("step")
-        with span("step.dispatch"):
-            decision = step_fn(eb, nf, af, key)
-        self._sup_count("steps_dispatched")
-        # Pack every per-pod output into ONE device buffer before
-        # fetching: on a remote-TPU tunnel each np.asarray is a full
-        # round trip, and five separate fetches of tiny arrays cost ~4
-        # extra latencies per batch (measured ~0.27 s at 10k pods —
-        # comparable to the whole device compute). The slim layout
-        # (default) additionally bit-packs the bool planes and narrows
-        # the counts to i16, ~2.4× fewer bytes than the i32 stack.
-        packed_dev = self._pack_dec(decision)
-        # The spread/anti arbitration inputs are fetched only when the
-        # batch actually carries something the host must arbitrate: a
-        # hard (DoNotSchedule) spread slot or a required anti-affinity
-        # term. A soft-only topology batch (the common ScheduleAnyway
-        # case) pays neither the pack dispatch nor the (2P+2, G)
-        # transfer — arbitrate_spread would return empty for it anyway.
-        needs_arb = hard_spread or bool(
-            self._spread_enabled and self._anti_enabled
-            and (eb.pf.anti_req_group[:L_b] >= 0).any())
-        spread_dev = (self._spread_payload(decision) if needs_arb
-                      else None)
+        # Maintained arbitration index (MINISCHED_INDEX): serve the
+        # batch's arbitration from the device-resident (C,N) class rows
+        # — repaired from this prepare's drained deltas — instead of
+        # dispatching the full (P,N) filter+score pass. Speculative: the
+        # resolve phase settles it and re-dispatches the full step with
+        # the SAME PRNG draw on any unassigned live row.
+        indexed = (self._index is not None and sample_k is None
+                   and self._mesh is None
+                   and self._index_dispatch(inf, batch, eb, nf, af, key,
+                                            fail_closed))
+        if indexed:
+            decision = None
+            packed_dev = None
+            spread_dev = None
+        else:
+            with span("step.dispatch"):
+                decision = step_fn(eb, nf, af, key)
+            self._sup_count("steps_dispatched")
+            # Scored-rows ledger (pod-row × node-row plugin-evaluation
+            # units — batch_series.scored_rows): the full step pays the
+            # whole (P_pad, N) matrix; sampling narrows N to its K.
+            inf.scored_rows += int(eb.pf.valid.shape[0]) * int(
+                sample_k if sample_k is not None else nf.valid.shape[0])
+            # Pack every per-pod output into ONE device buffer before
+            # fetching: on a remote-TPU tunnel each np.asarray is a full
+            # round trip, and five separate fetches of tiny arrays cost
+            # ~4 extra latencies per batch (measured ~0.27 s at 10k pods
+            # — comparable to the whole device compute). The slim layout
+            # (default) additionally bit-packs the bool planes and
+            # narrows the counts to i16, ~2.4× fewer bytes than the i32
+            # stack.
+            packed_dev = self._pack_dec(decision)
+            # The spread/anti arbitration inputs are fetched only when
+            # the batch actually carries something the host must
+            # arbitrate: a hard (DoNotSchedule) spread slot or a
+            # required anti-affinity term. A soft-only topology batch
+            # (the common ScheduleAnyway case) pays neither the pack
+            # dispatch nor the (2P+2, G) transfer — arbitrate_spread
+            # would return empty for it anyway.
+            needs_arb = hard_spread or bool(
+                self._spread_enabled and self._anti_enabled
+                and (eb.pf.anti_req_group[:L_b] >= 0).any())
+            spread_dev = (self._spread_payload(decision) if needs_arb
+                          else None)
         # Dispatch returns before the device finishes (jax async); the
         # first np.asarray in _resolve_batch blocks. Splitting the two
         # reveals whether step time is host→device feeding or device
@@ -2698,6 +3168,10 @@ class Scheduler:
             # The loop→pipelined rung's probation: one clean resolved
             # batch pays one cooldown tick (scheduling thread only).
             self._loop_cooldown -= 1
+        if self._index_cooldown > 0:
+            # The index ladder's full-rescore rung pays down the same
+            # way: one clean resolved batch per cooldown tick.
+            self._index_cooldown -= 1
         if TIMELINE.enabled:
             self._timeline_tick()
 
@@ -2755,6 +3229,11 @@ class Scheduler:
                                     explain=self.config.explain,
                                     assignment=self.config.assignment,
                                     shortlist=want)
+        idx = self._index
+        if idx is not None and idx.k_target != idx.k_base:
+            # Restore the configured indexed-scan width (free — exact
+            # at any width, no state rebuild involved).
+            idx.k_target = idx.k_base
         n = self.queue.release_shed()
         log.info("overload controller disarmed at runtime; actuation "
                  "neutralized (%d shed pod(s) released)", n)
@@ -2790,6 +3269,19 @@ class Scheduler:
                                     explain=self.config.explain,
                                     assignment=self.config.assignment,
                                     shortlist=want)
+        # Maintained-index K-dial (same tuner verdicts, applied to the
+        # INDEXED-SCAN width): live and free in both directions — the
+        # maintained state is the full class row, so any width is exact
+        # (in-scan certificate repairs absorb a narrow one) and
+        # ops/index.build_index_ops memoizes per width, so dial
+        # revisits recompile nothing.
+        idx = self._index
+        if idx is not None:
+            want_k = ov.shortlist_target(idx.k_base)
+            if want_k is not None and want_k != idx.k_target:
+                log.warning("overload tuner: index scan K %d -> %d",
+                            idx.k_target, want_k)
+                idx.k_target = want_k
         # Brownout quality shed: stretch the timeline cadence while
         # level 3 holds (restored on recovery).
         self._timeline.stretch = ov.timeline_stretch
@@ -2898,7 +3390,6 @@ class Scheduler:
 
     def _resolve_batch_impl(self, inf: "_InflightBatch") -> None:
         batch, pods, eb, names = inf.batch, inf.pods, inf.eb, inf.names
-        decision, row_incs = inf.decision, inf.row_incs
         nf, af, key, sample_k = inf.nf, inf.af, inf.key, inf.sample_k
         vol_memo, fail_closed = inf.vol_memo, inf.fail_closed
         spread_dev = inf.spread_dev
@@ -2907,6 +3398,12 @@ class Scheduler:
         # dispatch and this fetch; stamping the fetch start keeps that
         # host-side gap out of the step metric (it books as gap time).
         inf.t_fetch_start = time.perf_counter()
+        if inf.index_packed_dev is not None:
+            # Settle the speculative indexed scan: serve (index hit — no
+            # full pass ran this batch) or discard + full-step
+            # re-dispatch with the original PRNG draw (_settle_index).
+            self._settle_index(inf)
+        decision, row_incs = inf.decision, inf.row_incs
         # decision is None for a loop-mode slot (the tranche resolver
         # pre-unpacked the stacked fetch); the filter count is a static
         # profile property either way.
@@ -2947,9 +3444,13 @@ class Scheduler:
             # before the residual merge mutates chosen/assigned (the
             # carried array is the main step's output; residual/repair
             # placements reach the device as next-batch corrections).
+            # An index-SERVED batch has no Decision — its carried array
+            # is the indexed scan's free_after, bit-equal to the full
+            # scan's (identical debit op sequence over the same carry).
             res = self._residency
             res.note_debits(chosen, assigned, eb.pf.requests,
-                            decision.free_after)
+                            decision.free_after if decision is not None
+                            else inf.index_free_after)
             # ROADMAP residency follow-up (d): model the batch's
             # host-port insertions on the device-resident used_ports
             # (and its mirror, identical integer op order) so a
@@ -3412,6 +3913,11 @@ class Scheduler:
             m["shortlist_repairs"] += inf.sl_repairs
             m["shortlist_certified"] += max(0,
                                             len(batch) - inf.sl_repairs)
+            # Maintained-index scored-rows ledger: plugin-evaluation
+            # work this batch paid (pod-row × node-row units) — the
+            # full step's P_pad·N, a refresh's C_pad·R_bucket, a
+            # rebuild's C_pad·N, a fallback's sum of both.
+            m["scored_rows_total"] += inf.scored_rows
             # Per-batch series for the next TPU capture (ROADMAP ask):
             # device window, uploaded/fetched bytes, and shortlist
             # repairs PER BATCH, not just totals — bounded like the
@@ -3420,13 +3926,15 @@ class Scheduler:
             # even in pipelined mode.
             ser = m.setdefault("batch_series", {
                 "device_s": [], "h2d_bytes": [], "fetch_bytes": [],
-                "shortlist_repairs": [], "gap_gather_s": [],
-                "gap_encode_s": [], "gap_fetch_s": [], "gap_commit_s": []})
+                "shortlist_repairs": [], "scored_rows": [],
+                "gap_gather_s": [], "gap_encode_s": [],
+                "gap_fetch_s": [], "gap_commit_s": []})
             if len(ser["device_s"]) < 64:
                 ser["device_s"].append(round(step_s, 6))
                 ser["h2d_bytes"].append(int(inf.h2d1 - inf.h2d0))
                 ser["fetch_bytes"].append(int(inf.fetch1 - inf.fetch0))
                 ser["shortlist_repairs"].append(int(inf.sl_repairs))
+                ser["scored_rows"].append(int(inf.scored_rows))
                 # engine_gap_s decomposition per batch: the components
                 # _book_gap attributed to this batch, plus this batch's
                 # dispatch→fetch window in the fetch slot.
@@ -3460,6 +3968,7 @@ class Scheduler:
                 m["last_commit_s"] = commit_s
                 m["last_shapes"] = inf.shapes
                 m["last_shortlist_repairs"] = int(inf.sl_repairs)
+                m["last_scored_rows"] = int(inf.scored_rows)
 
     def _flush_failures(self, items: List[tuple]) -> None:
         """Apply a cycle's deferred failure verdicts in bulk — the
@@ -4303,6 +4812,16 @@ class Scheduler:
         # compilation cache armed at init.
         out["loop_depth_effective"] = (self._effective_loop_depth()
                                        if self._loop_enabled else 0)
+        # Maintained arbitration index gauges: the effective scan width
+        # (0 = off — knob, profile ineligibility, or a certification
+        # desync disabled it), the registered pod-class count, and the
+        # batches left on the full-rescore cooldown rung.
+        idx = self._index
+        out["index_width"] = (int(idx.k_eff) if idx is not None
+                              and idx.state is not None else 0)
+        out["index_classes_registered"] = (len(idx.rows)
+                                           if idx is not None else 0)
+        out["index_cooldown_left"] = int(self._index_cooldown)
         out["compile_cache_on"] = int(self._compile_cache_on)
         # Supervisor state: the ladder rung as a gauge (0 = full fast
         # path; exposed on /metrics via the service provider) plus its

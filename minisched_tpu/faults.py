@@ -102,7 +102,7 @@ log = logging.getLogger(__name__)
 # pattern stable under a fixed seed.
 GATES = ("step", "fetch", "residency", "shortlist_repair", "commit",
          "bind", "informer", "http", "checkpoint", "lifecycle",
-         "admission")
+         "admission", "index")
 
 _ACTIONS = ("err", "die", "corrupt", "stall")
 

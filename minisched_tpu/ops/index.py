@@ -1,0 +1,218 @@
+"""Maintained arbitration index: device-resident per-pod-class score
+rows, repaired by the same sparse deltas that keep ``free`` resident —
+the inversion of the per-batch dataflow (ROADMAP "Incremental
+arbitration").
+
+The shortlist stage (ops/select.py, PR 4) compressed the sequential scan
+to O(P·K), but every batch still recomputes filter+score over ALL N
+nodes just to rebuild score rows the previous batch mostly already had —
+PR 2's delta protocol proves only a handful of node rows actually change
+between batches. This module keeps the evaluated rows ALIVE across
+batches, keyed by pod CLASS (pods with bit-identical feature rows score
+every node identically, so the row is a property of the class, not the
+pod):
+
+  * ``build``   — one full (C, N) filter+score pass over the registered
+    pod classes into the maintained score matrix (``IndexState.score``,
+    masked_total semantics: NEG = infeasible).
+  * ``refresh`` — the steady-state path: re-evaluate filter+score at
+    ONLY the changed node columns (cache deltas + the previous batch's
+    debits, gathered like the sampling path gathers its candidate
+    subset) and scatter them in place. Cost: O(C·|changed|) plugin
+    evaluations instead of O(P·N) — the delta-driven repair.
+  * ``assign``  — gather each batch pod's class row into a (P, N)
+    score view (a device gather — ZERO plugin evaluations) and run the
+    PR 4 certified shortlist-compressed scan over it
+    (ops/select.greedy_assign_shortlist at the K-dial's width): the
+    per-batch (score, tie-noise) selection certifies each step or
+    repairs it in-scan with the ORIGINAL full-row body, so decisions —
+    plateaus, capacity contention, and all — are bit-identical to the
+    full recompute by the PR 4 exactness proof. The free-capacity carry
+    is debited with the identical op sequence, so ``free_after`` is
+    bitwise-equal too and the device-residency chain can adopt it.
+
+    Top-K candidate state is therefore PER BATCH (selected against the
+    batch's own tie-noise lattice), while the maintained cross-batch
+    state is the full class row. A cross-batch (C, K) truncation was
+    measured unserviceable: the K-th-score bound cannot certify a
+    score plateau wider than K (hundreds of identical empty nodes in
+    the bench cluster — the common cold-cluster shape), because the
+    scan's tie-break noise is drawn per (batch, pod row) and cannot be
+    precomputed into a cross-batch ordering. Keeping whole rows costs
+    C×N f32 on device (a small multiple of the ``free`` matrix) and
+    makes every batch servable.
+
+Steps the scan cannot SERVE are the engine's to repair at batch
+granularity: an UNASSIGNED live row (the failure path needs per-plugin
+attribution the index doesn't compute) discards the speculative result
+and re-dispatches the original full step with the batch's original PRNG
+draw (engine/scheduler._settle_index). Decisions are bit-identical to
+the index-off engine in every case.
+
+Exactness preconditions (enforced by ``index_eligible`` + the engine's
+per-batch gates, engine/scheduler.py): every active plugin is
+column-local (its filter/score at node n reads only node n's feature
+column — the ``BatchedPlugin.column_local`` declaration), no plugin
+needs topology or node-affinity group state (those read the
+assigned-pod corpus / batch group tables, which move every batch), and
+every active SCORER keeps the identity normalize (a row-normalizer such
+as max_normalize_100 couples every column to the row max, so one
+changed node would invalidate the whole row — the maintained-max
+extension is a documented follow-up). Under these gates the cached
+class rows equal the step's ``masked_total`` rows bitwise: the evaluate
+twin below performs the identical op sequence (same AND-reduction over
+filters, same scorer order, same f32 accumulation) on the same inputs.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..encode.features import DEFAULT_ENCODING, EncodingConfig
+from ..plugins.base import BatchedPlugin, PluginSet
+from .pipeline import _gather_nodes
+from .select import NEG, greedy_assign_shortlist
+
+
+class IndexState(NamedTuple):
+    """The device-resident index: per registered pod class, the CURRENT
+    masked-total score at every node column (NEG = infeasible), as of
+    the snapshot of the last build/refresh."""
+
+    score: jnp.ndarray  # (C,N) f32 masked_total per class row
+
+
+def index_eligible(plugin_set: PluginSet) -> bool:
+    """May this profile's decisions be served from a maintained index?
+    See the module docstring for why each condition is load-bearing."""
+    active = plugin_set.filter_plugins + plugin_set.score_plugins
+    for p in active:
+        if p.needs_topology or p.needs_node_affinity:
+            return False
+        if not getattr(p, "column_local", False):
+            return False
+    for p in plugin_set.score_plugins:
+        if type(p).normalize is not BatchedPlugin.normalize:
+            return False
+    return True
+
+
+_INDEX_CACHE: dict = {}
+
+
+def build_index_ops(plugin_set: PluginSet, k_eff: int, *,
+                    cfg: EncodingConfig = DEFAULT_ENCODING):
+    """Compile (build, refresh, assign) for one profile at indexed-scan
+    width ``k_eff`` (the K-dial — any width is exact: the certified
+    scan's in-scan repairs absorb a narrow one, so dial moves in either
+    direction cost no rebuild). Memoized on the profile's traced
+    behavior like ops/pipeline._STEP_CACHE, so tuner revisits and
+    engine restarts reuse compiles."""
+    if k_eff < 1:
+        raise ValueError(f"index scan width {k_eff} must be >= 1")
+    cache_key = (
+        tuple(p.trace_key() for p in plugin_set.filter_plugins),
+        tuple((p.trace_key(), plugin_set.weight_of(p))
+              for p in plugin_set.score_plugins),
+        cfg, k_eff, "arb_index",
+    )
+    cached = _INDEX_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
+    filters = plugin_set.filter_plugins
+    scorers = plugin_set.score_plugins
+    weights = [plugin_set.weight_of(p) for p in scorers]
+
+    def evaluate(class_pf, nf, af):
+        """(C, Nsub) masked_total for the class batch — the EXACT op
+        sequence of ops/pipeline's evaluate (AND over filters in order,
+        identity-normalized weighted score sum in order, NEG mask), so a
+        gathered column's value equals the step's value at that column
+        bitwise. Eligible plugins read no ctx beyond ``af``."""
+        ctx = {"af": af}
+        valid_pair = class_pf.valid[:, None] & nf.valid[None, :]
+        feasible = valid_pair
+        for p in filters:
+            with jax.named_scope(f"minisched.index.filter.{p.name}"):
+                feasible = feasible & p.filter(class_pf, nf, ctx)
+        total = jnp.zeros_like(valid_pair, dtype=jnp.float32)
+        for p, w in zip(scorers, weights):
+            with jax.named_scope(f"minisched.index.score.{p.name}"):
+                raw = p.score(class_pf, nf, ctx).astype(jnp.float32)
+                norm = p.normalize(raw, feasible).astype(jnp.float32)
+            total = total + w * norm
+        return jnp.where(feasible, total, NEG)
+
+    def build(class_pf, nf, af) -> IndexState:
+        """Full rebuild: one (C, N) evaluate. Pad class rows are
+        all-invalid → NEG everywhere, never chosen."""
+        return IndexState(score=evaluate(class_pf, nf, af))
+
+    def refresh(state: IndexState, class_pf, nf, af,
+                rows_pad) -> IndexState:
+        """Delta repair: re-evaluate ONLY the changed columns
+        (``rows_pad`` (Rb,) i32, sentinel ≥ N for padding) and scatter
+        them in place. Every other column kept its build-time value —
+        its truth did not move (the cache marks EVERY mutation into the
+        IndexDeltaListener), so the whole matrix equals a fresh build
+        against the same snapshot."""
+        n = nf.valid.shape[0]
+        live_col = rows_pad < n
+        safe = jnp.clip(rows_pad, 0, n - 1)
+        nf_sub = _gather_nodes(nf, safe)
+        nf_sub = nf_sub._replace(valid=nf_sub.valid & live_col)
+        new_sc = evaluate(class_pf, nf_sub, af)              # (C,Rb)
+        # Scatter with the RAW (sentinel-carrying) indices and
+        # mode="drop": pad slots fall outside [0, N) and write nothing.
+        # Clipping them to N-1 instead would create duplicate scatter
+        # indices whenever column N-1 is a real repaired node — and a
+        # duplicate-index .set() is order-undefined, so the pad slot's
+        # value could silently overwrite the genuine repair.
+        return IndexState(
+            score=state.score.at[:, rows_pad].set(new_sc, mode="drop"))
+
+    def assign(state: IndexState, cls, valid, requests, free0, key):
+        """The certified shortlist-compressed scan over class rows
+        gathered per pod — zero plugin evaluations. Identical inputs,
+        identical key, identical machinery as the full step's
+        assignment stage (gang_assign with no gangs reduces to the
+        greedy_fn on the raw score matrix), hence bit-identical
+        decisions AND free carry. Returns one fused u8 buffer
+        [chosen i32 × P | assigned bits | repaired bits] plus the
+        carried ``free_after``; ``repaired`` is the in-scan full-row
+        repair ledger (exact — counted, never a fallback trigger)."""
+        scores_p = jnp.where(valid[:, None], state.score[cls], NEG)
+        n = free0.shape[0]
+        r = greedy_assign_shortlist(scores_p, requests, free0, key,
+                                    k=min(k_eff, n))
+        packed = jnp.concatenate([
+            jax.lax.bitcast_convert_type(r.chosen.astype(jnp.int32),
+                                         jnp.uint8).reshape(-1),
+            jnp.packbits(r.assigned.astype(jnp.uint8)),
+            jnp.packbits(r.repaired.astype(jnp.uint8)),
+        ])
+        return packed, r.free_after
+
+    ops = (jax.jit(build), jax.jit(refresh), jax.jit(assign))
+    _INDEX_CACHE[cache_key] = ops
+    return ops
+
+
+def unpack_index_decision(buf, p: int) -> Tuple:
+    """Host-side inverse of the assign pack over the fetched (writable)
+    u8 buffer → (chosen i32, assigned bool, repaired bool)."""
+    nb = (p + 7) // 8
+    chosen = buf[:4 * p].view(np.int32)
+    o = 4 * p
+    assigned = np.unpackbits(buf[o:o + nb])[:p].astype(bool)
+    o += nb
+    repaired = np.unpackbits(buf[o:o + nb])[:p].astype(bool)
+    return chosen, assigned, repaired
+
+
+def index_buffer_bytes(p: int) -> int:
+    """Size model of the assign pack's fused fetch buffer (bytes)."""
+    return 4 * p + 2 * ((p + 7) // 8)

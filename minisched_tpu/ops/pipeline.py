@@ -782,6 +782,18 @@ def enable_compile_cache(path: str) -> bool:
                 jax.config.update(knob, val)
             except Exception:  # knob absent on this jax — keep the dir
                 pass
+        # jax's cache module latches a disabled/uninitialized verdict at
+        # its first consult — which backend probing during import can
+        # trigger BEFORE the dir is configured here. Without the reset
+        # every later compile logs "cache is disabled/not initialized"
+        # and writes nothing (observed on jax 0.4.37 CPU; caught by the
+        # bench_coldstart cross-process proof).
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass
         _COMPILE_CACHE["dir"] = path
         return True
     except Exception:

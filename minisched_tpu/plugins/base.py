@@ -50,6 +50,16 @@ class BatchedPlugin:
     # math may assume such rejections are curable by evicting victims;
     # every other filter stays a hard blocker for the preemptor.
     capacity_only: bool = False
+    # filter/score at node column n read ONLY that node's feature column
+    # (no reduction or gather over the node axis, no ctx state derived
+    # from other nodes). The maintained arbitration index (ops/index.py)
+    # may then re-evaluate a changed column in isolation and get the
+    # full-matrix value bitwise. FAIL-CLOSED default: a plugin must
+    # explicitly declare True to unlock the index for its profile — a
+    # new plugin that couples columns and forgets the declaration must
+    # degrade to the per-batch dataflow, never to stale certified
+    # decisions.
+    column_local: bool = False
 
     # -- event interest (drives requeue gating, reference
     #    minisched/initialize.go:140-157 + nodenumber.go:66-70)

@@ -35,6 +35,7 @@ class NodeResourcesFit(BatchedPlugin):
     default), "MostAllocated", or None to disable the score point."""
 
     name = "NodeResourcesFit"
+    column_local = True  # per-column free/allocatable math only
     # Rejections are purely free-vs-request on the accounted axes —
     # exactly what evicting victims credits back (preemption-curable).
     capacity_only = True
@@ -74,6 +75,8 @@ class _AllocationScorer(BatchedPlugin):
     """Shared math: per-resource utilization after placing the pod, over a
     configurable scored-resource set (upstream's `resources` plugin arg;
     defaults to cpu+memory like upstream)."""
+
+    column_local = True  # per-column utilization math only
 
     def __init__(self, resources=DEFAULT_SCORED_RESOURCES):
         self._resources = tuple(resources)

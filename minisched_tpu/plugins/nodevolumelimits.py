@@ -28,6 +28,9 @@ _VOL = RESOURCE_INDEX["attachable-volumes"]
 
 class NodeVolumeLimits(BatchedPlugin):
     name = "NodeVolumeLimits"
+    # NOT column-local: the pinned-claim surcharge compares against the
+    # node AXIS POSITION (arange over N) — see VolumeRestrictions.
+    column_local = False
 
     def events_to_register(self):
         # Freed attachments (pod delete) or raised limits (node update).
@@ -66,6 +69,8 @@ class CloudVolumeLimits(BatchedPlugin):
     rejections to the named plugin. Typed claims are charged per pod (not
     per-claim-per-node like the generic axis) — two pods sharing one typed
     claim on a node consume two slots, a documented simplification."""
+
+    column_local = True  # per-column axis compare only
 
     def __init__(self):
         self._axis = RESOURCE_INDEX[self.axis_name]

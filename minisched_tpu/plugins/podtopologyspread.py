@@ -31,6 +31,7 @@ class PodTopologySpread(BatchedPlugin):
     name = "PodTopologySpread"
     default_weight = 2.0  # upstream default
     needs_topology = True
+    column_local = False  # reads corpus-derived domain counts
 
     def events_to_register(self):
         return [ClusterEvent(GVK.POD, ActionType.ALL),

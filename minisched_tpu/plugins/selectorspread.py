@@ -42,6 +42,7 @@ _NODE_WEIGHT = 1.0 - _ZONE_WEIGHT
 class SelectorSpread(BatchedPlugin):
     name = "SelectorSpread"
     needs_topology = True
+    column_local = False  # reads corpus-derived domain counts
 
     def events_to_register(self):
         # Population changes on any pod lifecycle event; zone/hostname

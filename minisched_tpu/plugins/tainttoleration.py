@@ -14,6 +14,9 @@ from .base import BatchedPlugin
 
 class TaintToleration(BatchedPlugin):
     name = "TaintToleration"
+    # Per-column taint matching — but the row-normalized score keeps
+    # any profile running it index-ineligible regardless.
+    column_local = True
     default_weight = 3.0  # upstream default weight
 
     def events_to_register(self):

@@ -374,6 +374,33 @@ def test_shortlist_corrupt_caught_by_certification_cross_check():
     assert placed == ref_placed
 
 
+def test_index_corrupt_caught_by_certification_cross_check():
+    """Maintained-index detector (PR 12): ``index:corrupt`` scribbles
+    one entry of the device-resident (C,K) index — a cached score the
+    in-scan certificate consumes as truth, so the scan serves a
+    range-valid but WRONG node and certifies it. With the index
+    cross-check armed (index_check_every=1) the full-step comparison
+    must catch it, count an index_desync, permanently disable the index
+    (index_width gauge -> 0), and the supervised replay must land every
+    pod on the fault-free run's node."""
+    cfg = _config(pipeline=False, index=True, index_k=8,
+                  index_check_every=1)
+    ref_placed, ref_m = _run_burst("", cfg)
+    assert ref_m["index_hits"] >= 1          # the index genuinely served
+    assert ref_m["index_checks"] >= 1        # the detector genuinely ran
+    assert ref_m["index_desyncs"] == 0
+    assert ref_m["index_width"] > 0
+
+    placed, m = _run_burst("index:corrupt@2", cfg)
+    assert m["fault_fires_index"] == 1
+    assert m["index_desyncs"] == 1
+    assert m["index_width"] == 0             # disabled, per-batch dataflow
+    assert m["batch_faults"] >= 1
+    assert m["supervisor_escalations"] >= 1
+    assert m["degradation_state"] == "resident"
+    assert placed == ref_placed
+
+
 def test_bind_gate_reconciles_without_losing_or_double_binding():
     """An aborted bulk bind task reconciles per pod against store truth:
     unbound pods are unassumed + requeued (never lost), already-bound

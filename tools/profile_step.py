@@ -112,6 +112,36 @@ def main() -> None:
           f"{min(sl_k, n_pad) if sl_k else n_pad} per step; "
           "MINISCHED_SHORTLIST / MINISCHED_SHORTLIST_K)", flush=True)
 
+    # Maintained arbitration index (MINISCHED_INDEX, ops/index.py):
+    # posture + the scored-rows model at THIS shape — the raw-op twin
+    # of the engine's live health counters (metrics(): index_hits /
+    # index_fallbacks = hit fraction, index_repair_rows = in-place
+    # repairs, index_rebuilds = certified-stale rebuilds, and the
+    # per-batch scored-rows series in batch_series.scored_rows, which
+    # bench.engine_bench exports as *_batch_scored_rows).
+    from minisched_tpu.ops.index import build_index_ops, index_eligible
+    idx_eligible = index_eligible(pset)
+    if not cfg_env.index:
+        print("index: off (MINISCHED_INDEX unset — every batch pays the "
+              f"full P*N filter+score pass: {p_pad * n_pad} scored "
+              "rows/batch at this shape)", flush=True)
+    elif not idx_eligible:
+        print("index: MINISCHED_INDEX=1 but this profile is not "
+              "index-eligible (topology/affinity state or a "
+              "row-normalizing scorer) — per-batch dataflow kept",
+              flush=True)
+    else:
+        from minisched_tpu.encode.cache import bucket_for
+        c_pad = bucket_for(min(len(pods), cfg_env.index_classes), 16)
+        r_b = bucket_for(min(p_pad, n_pad), 16)
+        print(f"index: ON k={cfg_env.index_k} classes<= "
+              f"{cfg_env.index_classes} — steady-state scored rows/batch "
+              f"{c_pad}x{r_b}={c_pad * r_b} (refresh of <= {r_b} changed "
+              f"columns over {c_pad} class rows) vs full "
+              f"{p_pad}x{n_pad}={p_pad * n_pad} "
+              f"({p_pad * n_pad / (c_pad * r_b):.1f}x; rebuild batches "
+              f"pay {c_pad}x{n_pad}={c_pad * n_pad})", flush=True)
+
     # Overload-control posture (MINISCHED_OVERLOAD, engine/overload.py):
     # the actuation each ladder rung would apply AT THIS SHAPE — the
     # attribution row for a run whose /metrics shows overload_level > 0.
@@ -188,6 +218,25 @@ def main() -> None:
     slim = timed("slim_fetch_s", lambda: np.array(pack_decision_slim(
         d.chosen, d.assigned, d.gang_rejected, d.feasible_counts,
         d.feasible_static, d.reject_counts, d.shortlist_repaired)))
+    if cfg_env.index and idx_eligible:
+        # Maintained-index raw-op phases at a 64-class registry: one
+        # full (C,N) build, one 64-column delta refresh (the
+        # steady-state batch cost), and the indexed scan (gather + the
+        # certified K-compressed scan — zero plugin evaluations).
+        c_model = min(64, p_pad)
+        class_pf = type(eb.pf)(*[np.asarray(getattr(eb.pf, f))[:c_model]
+                                 for f in eb.pf._fields])
+        b_fn, r_fn, a_fn = build_index_ops(pset, cfg_env.index_k)
+        state = timed("index_build_s", lambda: b_fn(class_pf, nf, af))
+        rb = min(64, n_pad)
+        rows_pad = np.arange(rb, dtype=np.int32)
+        timed("index_refresh_s",
+              lambda: r_fn(state, class_pf, nf, af, rows_pad))
+        cls = (np.arange(p_pad) % c_model).astype(np.int32)
+        timed("index_assign_s",
+              lambda: a_fn(state, cls, eb.pf.valid, eb.pf.requests,
+                           nf.free, key)[0])
+
     # Per-batch transfer budget, both residency modes (engine counters
     # measure the same quantities live; this is the shape-exact model):
     dyn_h2d = nf.free.nbytes + nf.used_ports.nbytes
