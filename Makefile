@@ -13,12 +13,13 @@ CPU_MESH := XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 SHELL := /bin/bash
 
 .PHONY: test tier1 fault-smoke shortlist-smoke trace-smoke slo-smoke \
-        churn-smoke overload-smoke loop-smoke index-smoke profile-smoke \
-        start start-remote start-client-engine demo docs bench \
-        bench_sharded bench-cpu bench-pipeline bench-residency \
+        churn-smoke overload-smoke loop-smoke index-smoke journal-smoke \
+        profile-smoke start start-remote start-client-engine demo docs \
+        bench bench_sharded bench-cpu bench-pipeline bench-residency \
         bench-shortlist bench-trace bench-slo bench-churn bench-overload \
-        bench-deviceloop bench-index bench-coldstart bench-check dryrun \
-        dryrun-dcn soak soak-faults soak-churn soak-overload
+        bench-deviceloop bench-index bench-coldstart bench-journal \
+        bench-check dryrun dryrun-dcn soak soak-faults soak-churn \
+        soak-overload
 
 # Unit + integration suite on a virtual 8-device CPU mesh.
 test:
@@ -102,6 +103,20 @@ index-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_index.py -x -q \
 	  -p no:cacheprovider -p no:randomly
 
+# Fast deterministic decision-journal suite (~60 s): journal unarmed is
+# a bit-identical no-op per engine mode (sync/pipelined/resident/
+# shortlist/loop/index), seq monotonicity holds under the two-deep
+# pipeline + commit-worker threads, the JSONL sink and incident bundles
+# validate against the postmortem schema (empty/unarmed included),
+# provenance records match store truth for every bound pod in a faulted
+# churn run, the journal fault gate never touches decisions, and the
+# /journal + /provenance + /timeline?since cursors hold. A tier-1
+# prerequisite after index-smoke: the black-box recorder every incident
+# postmortem leans on must itself be pinned.
+journal-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_journal.py -x -q \
+	  -p no:cacheprovider -p no:randomly
+
 # The EXACT ROADMAP tier-1 verify command (dots count + exit code
 # preserved) — what the driver runs after every PR; run it locally
 # before shipping. shortlist-smoke runs first: the arbitration
@@ -112,9 +127,11 @@ index-smoke:
 # overload-smoke (the ring composes with the tuner's dials and must
 # never change a decision); index-smoke after loop-smoke (the
 # maintained index composes with ring, residency, and the K-dial and
-# must never change a decision either).
+# must never change a decision either); journal-smoke after index-smoke
+# (the black-box recorder hooks every layer above and must never change
+# a decision).
 tier1: shortlist-smoke trace-smoke slo-smoke overload-smoke loop-smoke \
-       index-smoke churn-smoke
+       index-smoke journal-smoke churn-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -254,6 +271,7 @@ bench-check:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_deviceloop.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_index.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_coldstart.py --check
+	JAX_PLATFORMS=cpu $(PY) tools/bench_journal.py --check
 
 # Persistent device-loop before/after (the committed
 # BENCH_DEVICELOOP.json): interleaved off/on min-of-4 rounds of the
@@ -277,6 +295,18 @@ bench-deviceloop:
 # (source bench-index) so `make bench-check` gates them.
 bench-index:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_index.py
+
+# Decision-journal contract bench (the committed BENCH_JOURNAL.json):
+# interleaved journal-off/on min-of-4 rounds — armed overhead ≤5% on
+# the create→bound window with provenance recorded for every settled
+# pod — plus one deterministic faulted round whose consecutive
+# step-dispatch errors walk the ladder to quarantine, auto-capture a
+# schema-valid incident bundle (tools/postmortem.py exits 0 on it), and
+# whose causal narrative names the injected gate. Stable stream keys
+# append to BENCH_LEDGER.json (source bench-journal) so `make
+# bench-check` gates them.
+bench-journal:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_journal.py
 
 # Cross-process compile-cache proof (the committed BENCH_COLDSTART.json;
 # ROADMAP cold-start item): two child processes share one

@@ -1395,6 +1395,13 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                 f"{prefix}_slo_alerts": int(m.get("slo_alerts_total", 0)),
                 f"{prefix}_early_warnings":
                     int(m.get("supervisor_early_warnings", 0)),
+                # Decision journal + provenance (obs/journal.py) — all
+                # 0 with MINISCHED_JOURNAL unset (the overhead artifact
+                # BENCH_JOURNAL.json interleaves on/off on these).
+                f"{prefix}_journal_events":
+                    int(m.get("journal_events", 0)),
+                f"{prefix}_provenance_records":
+                    int(m.get("provenance_records", 0)),
             }
     return out
 

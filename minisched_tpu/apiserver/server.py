@@ -128,6 +128,17 @@ class APIServer:
         # ``timeline()``; the endpoint merges every provider into one
         # JSON body. Providers must be thread-safe.
         self.timeline_providers: list = []
+        # /journal extension point: callables taking the ?since cursor
+        # and returning the decision-journal document (obs/journal
+        # to_doc shape). The journal is process-wide, so the FIRST
+        # provider's document answers; a co-located SchedulerService
+        # appends ``journal``. Providers must be thread-safe.
+        self.journal_providers: list = []
+        # /provenance extension point: callables taking a pod key and
+        # returning its decision-provenance record or None; the first
+        # non-None answer wins (profiles share no pods), all-None = 404.
+        # A co-located SchedulerService appends ``provenance``.
+        self.provenance_providers: list = []
         # Overload admission extension point: callables returning None
         # (admit) or a reason string — a non-None verdict rejects POD
         # creates with a typed 429 (reason ``SchedulerOverloaded`` +
@@ -158,7 +169,9 @@ class APIServer:
                                 self._mutating_cv, self._track_mutation,
                                 self._draining, self.histogram_providers,
                                 self.timeline_providers,
-                                self.admission_providers)
+                                self.admission_providers,
+                                self.journal_providers,
+                                self.provenance_providers)
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
@@ -215,7 +228,9 @@ def _make_handler(store: ClusterStore, token: str | None = None,
                   track_mutation=None, draining=None,
                   histogram_providers: list | None = None,
                   timeline_providers: list | None = None,
-                  admission_providers: list | None = None):
+                  admission_providers: list | None = None,
+                  journal_providers: list | None = None,
+                  provenance_providers: list | None = None):
     if counters is None:
         counters = {}
     if counters_lock is None:
@@ -383,7 +398,11 @@ def _make_handler(store: ClusterStore, token: str | None = None,
             if kind == "metrics":
                 return self._guard(self._metrics)
             if kind == "timeline":
-                return self._guard(self._timeline)
+                return self._guard(lambda: self._timeline(q))
+            if kind == "journal":
+                return self._guard(lambda: self._journal(q))
+            if kind == "provenance":
+                return self._guard(lambda: self._provenance(key))
             if kind == "watch":
                 return self._guard(lambda: self._watch(q))
             if kind == "snapshot":
@@ -517,25 +536,98 @@ def _make_handler(store: ClusterStore, token: str | None = None,
             self.end_headers()
             self.wfile.write(body)
 
-        def _timeline(self):
+        def _timeline(self, q):
             """Temporal-telemetry JSON: every provider's per-profile
             timeline documents merged into one body. A broken provider
             must not 500 the endpoint — its profiles are skipped and
-            the error noted, same contract as the metrics providers."""
+            the error noted, same contract as the metrics providers.
+            ``?since=<seq>`` returns only rows newer than the cursor
+            (each document's ``next_seq`` is what the client hands back
+            next poll — scrapers stop re-downloading the full ring);
+            legacy zero-arg providers keep answering the full ring.
+            Each profile's seq space is independent, so a MULTI-profile
+            scraper polls one profile per request —
+            ``?profile=<name>&since=<seq>`` — a single scalar cursor
+            across profiles would starve the slower profile's rows."""
+            import inspect
+
+            try:
+                since = int(q.get("since", ["0"])[0])
+            except ValueError:
+                return self._error(400, "since must be an integer")
+            want_profile = q.get("profile", [None])[0]
             merged: dict = {}
             errors = 0
             for provider in (timeline_providers or ()):
                 try:
-                    doc = provider()
+                    # Signature-dispatched (NOT a TypeError fallback: a
+                    # TypeError raised inside a modern provider's body
+                    # must surface as that provider's error, never
+                    # silently re-run it zero-arg).
+                    try:
+                        takes_since = bool(
+                            inspect.signature(provider).parameters)
+                    except (TypeError, ValueError):
+                        takes_since = False
+                    doc = provider(since) if takes_since else provider()
                     if isinstance(doc, dict):
                         merged.update(doc)
                 except Exception:
                     errors += 1
                     log.exception("timeline provider failed")
+            if want_profile is not None:
+                merged = {k: v for k, v in merged.items()
+                          if k == want_profile}
             body = {"timelines": merged}
             if errors:
                 body["provider_errors"] = errors
             self._send(200, body)
+
+        def _journal(self, q):
+            """Decision-journal JSON (obs/journal.py): the process-wide
+            causal event log from the first answering provider, filtered
+            by the ``?since=<seq>`` cursor (poll with the last response's
+            ``next_seq``). Empty-but-valid when no provider is wired or
+            MINISCHED_JOURNAL is unset."""
+            try:
+                since = int(q.get("since", ["0"])[0])
+            except ValueError:
+                return self._error(400, "since must be an integer")
+            errors = 0
+            for provider in (journal_providers or ()):
+                try:
+                    doc = provider(since)
+                    if isinstance(doc, dict):
+                        return self._send(200, doc)
+                except Exception:
+                    errors += 1
+                    log.exception("journal provider failed")
+            if errors:
+                # A CRASHED provider must not masquerade as an unarmed
+                # journal (enabled:false would tell the operator to
+                # stop looking exactly when the history matters) — the
+                # _timeline provider_errors contract.
+                return self._send(200, {"provider_errors": errors,
+                                        "entries": []})
+            self._send(200, {"enabled": False, "next_seq": 0,
+                             "dropped": 0, "entries": []})
+
+        def _provenance(self, key):
+            """Per-pod decision provenance (obs/journal.ProvenanceStore
+            via the engine): ``GET /provenance/<ns>/<name>``. The first
+            provider holding a record answers; none = 404 (a pod the
+            journal never saw, or MINISCHED_JOURNAL unset)."""
+            if not key:
+                return self._error(404, "no route")
+            for provider in (provenance_providers or ()):
+                try:
+                    rec = provider(key)
+                    if rec is not None:
+                        return self._send(200, rec)
+                except Exception:
+                    log.exception("provenance provider failed")
+            self._error(404, f"no provenance record for {key!r}",
+                        reason="NotFound")
 
         def _watch(self, q):
             """Stateless long-poll watch: each call opens a cursor at

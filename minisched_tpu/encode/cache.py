@@ -301,11 +301,18 @@ class NodeFeatureCache:
         for lst in self._index_listeners:
             lst.static_rows.add(int(row))
 
-    def _inval_index_locked(self) -> None:
+    def _inval_index_locked(self, cause: str = "widening") -> None:
         """A WIDENING (or non-row-attributable) static mutation landed
-        (caller holds the lock): index consumers must rebuild."""
+        (caller holds the lock): index consumers must rebuild. The
+        journal event names the cause so a postmortem can tell a fresh
+        node from a topology refresh when attributing a rebuild."""
+        if not self._index_listeners:
+            return
         for lst in self._index_listeners:
             lst.inval += 1
+        from ..obs.journal import note as _jnote
+
+        _jnote("cache.index_inval", cause=cause)
 
     def drain_index_rows(self, lst: IndexDeltaListener):
         """Drain an index listener's accumulated repair rows — dynamic
@@ -408,7 +415,8 @@ class NodeFeatureCache:
             if narrows_only and not fresh_row:
                 self._mark_index_static_locked(i)
             else:
-                self._inval_index_locked()
+                self._inval_index_locked("fresh-node" if fresh_row
+                                         else "widening-update")
             self.version += 1
             self.static_version += 1
 
@@ -533,7 +541,7 @@ class NodeFeatureCache:
                     feats.topo_domains[:, i] = tcol
                 feats.topo_domains[0, i] = i
             if fresh:
-                self._inval_index_locked()
+                self._inval_index_locked("fresh-nodes-bulk")
                 self.version += 1
                 self.static_version += 1
         for node in existing:
@@ -1372,7 +1380,7 @@ class NodeFeatureCache:
         # Not row-attributable (every row's domain columns moved) —
         # index-eligible plugins read no topology state, but the
         # conservative rung is an invalidation, not a guess.
-        self._inval_index_locked()
+        self._inval_index_locked("topology-refresh")
         self.static_version += 1
 
     def _recompute_free_row(self, i: int) -> None:

@@ -65,6 +65,7 @@ import time
 from typing import Dict, Optional, Set
 
 from ..obs import instant
+from ..obs.journal import note as jnote
 from ..obs.timeseries import TIMELINE
 
 __all__ = ["OVERLOAD", "OVERLOAD_LADDER", "OverloadConfig",
@@ -320,6 +321,7 @@ class OverloadController:
                 self._clean = 0
                 self._count("overload_transitions")
                 instant("overload.disarm")
+                jnote("overload.disarm", engine=self.name)
                 return True
             return False
         cfg = OVERLOAD
@@ -342,6 +344,14 @@ class OverloadController:
                 instant("overload.escalate",
                         to=OVERLOAD_LADDER[self.level], level=self.level,
                         burning=",".join(sorted(burning)))
+                jnote("overload.escalate", engine=self.name,
+                      frm=OVERLOAD_LADDER[self.level - 1],
+                      to=OVERLOAD_LADDER[self.level], level=self.level,
+                      burning=",".join(sorted(burning)),
+                      knobs=("batch,window" if self.level == 1
+                             else "admission,shed"
+                             if self.level == 2
+                             else "explain,timeline_stretch,sampling"))
                 if TIMELINE.enabled:
                     TIMELINE.note_activity(
                         f"overload:{OVERLOAD_LADDER[self.level]}")
@@ -366,6 +376,9 @@ class OverloadController:
                     self._count("overload_tuner_adjustments")
                     changed = True
                     instant("overload.tune", shortlist_exp=want)
+                    jnote("overload.tune", engine=self.name,
+                          shortlist_exp=want,
+                          burning=",".join(sorted(burning)))
             # Tune depth follows the level (bounded): deeper burn, the
             # smaller the effective batch / wider the formation window.
             want_tune = min(cfg.tune_max, self.level)
@@ -384,6 +397,9 @@ class OverloadController:
                 self._count("overload_transitions")
                 instant("overload.recover",
                         to=OVERLOAD_LADDER[self.level], level=self.level)
+                jnote("overload.recover", engine=self.name,
+                      frm=OVERLOAD_LADDER[self.level + 1],
+                      to=OVERLOAD_LADDER[self.level], level=self.level)
                 if TIMELINE.enabled:
                     TIMELINE.note_activity(
                         f"overload:{OVERLOAD_LADDER[self.level]}")

@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Set
 
 from ..faults import FAULTS, FaultInjected
 from ..obs import span
+from ..obs.journal import note as jnote
 from ..state.events import ClusterEvent
 from ..state.objects import Pod, gang_key
 
@@ -77,6 +78,11 @@ class QueuedPodInfo:
     # Lazy-deletion marker: list/heap entries for a deleted pod stay in
     # place and are skipped at pop/flush time (heap removal is O(n)).
     gone: bool = False
+    # Decision-provenance stamp (obs/journal.ProvenanceStore): the
+    # engine writes the path-that-served-it record here at placement
+    # time (journal armed only) and the bound/failed settlement sites
+    # publish it into the LRU.
+    prov: Optional[dict] = None
 
     @property
     def key(self) -> str:
@@ -178,6 +184,7 @@ class SchedulingQueue:
     def add(self, pod: Pod) -> None:
         """New unscheduled pod (reference queue.go:35-43)."""
         forced = self._ingress_fault()
+        shed = False
         with self._cond:
             if pod.key in self._known or self._closed:
                 return
@@ -185,9 +192,16 @@ class SchedulingQueue:
             qpi = QueuedPodInfo(pod=pod)
             if forced or not self._admits(pod):
                 self._push_shed(qpi)
-                return
-            self._push_active(qpi)
-            self._cond.notify_all()
+                shed = True
+            else:
+                self._push_active(qpi)
+                self._cond.notify_all()
+        if shed:
+            # Journal OUTSIDE the queue lock (the journal's JSONL sink
+            # write must never extend a lock hold the scheduling
+            # thread's pop waits on), one event per ingress transaction
+            # — never per pod in a loop.
+            jnote("queue.shed", pods=1, pod=pod.key)
 
     def add_many(self, pods: List[Pod]) -> None:
         """Bulk ``add``: one lock acquisition and ONE consumer wake-up for
@@ -195,6 +209,7 @@ class SchedulingQueue:
         ``pop_batch`` thread once per pod — 10k context-switch round-trips
         per workload submission)."""
         forced = self._ingress_fault()
+        shed_n = 0
         with self._cond:
             if self._closed:
                 return
@@ -206,11 +221,19 @@ class SchedulingQueue:
                 qpi = QueuedPodInfo(pod=pod)
                 if forced or not self._admits(pod):
                     self._push_shed(qpi)
+                    shed_n += 1
                     continue
                 self._push_active(qpi)
                 added = True
             if added:
                 self._cond.notify_all()
+        if shed_n:
+            # One aggregate event per ingress transaction, outside the
+            # lock — a shed WAVE must not flood the journal ring with
+            # per-pod entries (evicting the ladder history the ring
+            # exists to keep) nor pay a sink write per pod under the
+            # queue lock.
+            jnote("queue.shed", pods=shed_n)
 
     def update(self, old: Pod, new: Pod) -> None:
         """Pod updated (reference Update panics, queue.go:109-118; we
@@ -525,7 +548,15 @@ class SchedulingQueue:
         No if the pod left the pipeline (deleted/bound → not in _known) or
         if the key is now held by a DIFFERENT qpi — the pod was deleted and
         recreated while this attempt was in flight; indexing the stale qpi
-        would orphan the live one and resurrect a stale spec."""
+        would orphan the live one and resurrect a stale spec.
+
+        Re-entry also consumes any leftover provenance stamp: a later
+        attempt must never publish THIS attempt's node/batch tags under
+        its own verdict — the settlement sites consume stamps while the
+        journal is armed, but a disarm window (or a quarantine, which
+        settles nothing) can leave one behind, and this is the one
+        choke point every re-entry path crosses."""
+        qpi.prov = None
         if qpi.key not in self._known or self._closed:
             return False
         existing = self._index.get(qpi.key)
@@ -584,7 +615,9 @@ class SchedulingQueue:
             self._shed_readmitted += moved
             if moved:
                 self._cond.notify_all()
-            return moved
+        if moved:
+            jnote("queue.release_shed", pods=moved)
+        return moved
 
     def _push_backoff(self, qpi: QueuedPodInfo,
                       ready: Optional[float] = None) -> None:
@@ -624,6 +657,7 @@ class SchedulingQueue:
         """Drain due backoff entries into activeQ — the flusher the
         reference never implemented (queue.go:136-139 panics)."""
         while True:
+            readmitted = 0
             with self._cond:
                 if self._closed:
                     return
@@ -669,9 +703,14 @@ class SchedulingQueue:
                         qpi.added_at = now
                         self._push_active(qpi)
                         self._shed_readmitted += 1
+                        readmitted += 1
                         fired = True
                     else:
                         self._push_shed(qpi)
                 if fired:
                     self._cond.notify_all()
+            if readmitted:
+                # One aggregate event per flush pass, outside the lock
+                # (see add_many's shed event for the rationale).
+                jnote("queue.readmit", pods=readmitted)
             time.sleep(interval)

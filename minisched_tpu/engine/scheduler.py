@@ -35,7 +35,10 @@ from ..encode.features import NodeFeatures
 from ..errors import ConflictError, NotFoundError
 from ..faults import FAULTS, FaultWorkerDeath
 from ..obs import Histogram, instant, span
+from ..obs import bundle as bundle_mod
 from ..obs import slo as slo_mod
+from ..obs.journal import JOURNAL, ProvenanceStore
+from ..obs.journal import note as jnote
 from ..obs.timeseries import TIMELINE, TimelineTracker
 from ..ops.index import (build_index_ops, index_eligible,
                          unpack_index_decision)
@@ -129,6 +132,13 @@ class _Supervisor:
         self._sched._sup_count("supervisor_escalations")
         instant("supervisor.escalate", to=DEGRADATION_LADDER[self.level],
                 level=self.level, reason=reason)
+        if JOURNAL.enabled:
+            s = self._sched
+            jnote("supervisor.escalate", profile=s.profile,
+                  frm=DEGRADATION_LADDER[self.level - 1],
+                  to=DEGRADATION_LADDER[self.level], level=self.level,
+                  reason=reason, batch=s._batch_seq,
+                  step=s._step_counter)
         log.warning("supervisor: degraded to %r (%s)",
                     DEGRADATION_LADDER[self.level], reason)
 
@@ -147,6 +157,10 @@ class _Supervisor:
         self._sched._sup_count("supervisor_early_warnings")
         instant("supervisor.early_warning", reason=reason,
                 level=self.level)
+        if JOURNAL.enabled:
+            jnote("supervisor.early_warning",
+                  profile=self._sched.profile, reason=reason,
+                  level=self.level, batch=self._sched._batch_seq)
         log.warning("supervisor: SLO early warning (%s); probation "
                     "extended, watchdog pre-armed for %d batches",
                     reason, SLO_PREARM_BATCHES)
@@ -177,6 +191,12 @@ class _Supervisor:
             self._sched._sup_count("supervisor_recoveries")
             instant("supervisor.recover",
                     to=DEGRADATION_LADDER[self.level], level=self.level)
+            if JOURNAL.enabled:
+                jnote("supervisor.recover",
+                      profile=self._sched.profile,
+                      frm=DEGRADATION_LADDER[self.level + 1],
+                      to=DEGRADATION_LADDER[self.level],
+                      level=self.level, batch=self._sched._batch_seq)
             log.info("supervisor: probation passed; re-escalated to %r",
                      DEGRADATION_LADDER[self.level])
 
@@ -194,7 +214,8 @@ class _InflightBatch:
                  "commit_t1", "res_carried", "assumed", "detached",
                  "h2d0", "fetch0", "h2d1", "fetch1", "sl_repairs", "gap",
                  "step_share", "index_packed_dev", "index_free_after",
-                 "index_served", "scored_rows")
+                 "index_served", "scored_rows", "loop_slot",
+                 "index_mode")
 
     def __init__(self):
         self.failures: List[tuple] = []  # (qpi, plugins, message, retryable)
@@ -252,6 +273,11 @@ class _InflightBatch:
         # accounting — a depth-8 tranche must not book (or trip) an
         # 8-batch window against one batch's deadline.
         self.step_share: Optional[float] = None
+        # Provenance tags (obs/journal.ProvenanceStore): which ring
+        # slot served this batch (None = per-batch dispatch) and how
+        # the maintained index treated it ("off" | "hit" | "fallback").
+        self.loop_slot: Optional[int] = None
+        self.index_mode = "off"
 
 
 # Fuse the per-pod step outputs into one (6+F, P) i32 array so the
@@ -472,6 +498,7 @@ class _DeviceResidency:
         if self.epoch >= 0:
             log.info("device residency dropped (%s); next batch "
                      "re-uploads the dynamic leaves", reason)
+            jnote("residency.drop", reason=reason)
         self.epoch = -1
         self.free_dev = self.ports_dev = None
         self.mirror_free = self.mirror_ports = None
@@ -608,6 +635,7 @@ class _ArbIndex:
         corrupt."""
         log.info("arbitration index invalidated (%s); next index batch "
                  "rebuilds", reason)
+        jnote("index.invalidate", reason=reason)
         self.state = None
         self.needs_rebuild = True
 
@@ -1003,7 +1031,7 @@ class Scheduler:
     def __init__(self, store, plugin_set: PluginSet,
                  config: Optional[SchedulerConfig] = None,
                  recorder=None, scheduler_names: Optional[Set[str]] = None,
-                 shared=None):
+                 shared=None, profile: Optional[str] = None):
         from .clusterstate import SharedClusterState
 
         self.store = store
@@ -1015,6 +1043,14 @@ class Scheduler:
         # KubeSchedulerProfile.SchedulerName selection); None = accept all
         # (single-profile mode).
         self.scheduler_names = scheduler_names
+        # Serving-profile label for per-profile attribution: journal
+        # events, timeline rows, and provenance records all carry it so
+        # a multi-profile service's shared surfaces stay attributable
+        # (the multi-tenant per-tenant dimension, pre-staged). The
+        # service passes the profile's name explicitly; a directly
+        # constructed engine derives it from its routing set.
+        self.profile = profile or (sorted(scheduler_names)[0]
+                                   if scheduler_names else "default")
         # Cluster state (feature cache + informers) is SHARED across the
         # service's profile engines (reference: one scheduler struct,
         # many profiles, scheduler.go:97-142) — a solo engine owns a
@@ -1253,6 +1289,10 @@ class Scheduler:
         # batch currently in resolve on the scheduling thread, thread-
         # gated exactly like _fail_sink.
         self._track: Optional[_InflightBatch] = None
+        # Batch-scoped provenance path (journal armed only): set by
+        # _resolve_batch beside _fail_sink, consumed by the placement
+        # stamp sites on the same thread. None = journal unarmed.
+        self._prov_batch: Optional[dict] = None
         # Pods CURRENTLY owned by an async owner (binder bulk commit,
         # permit wait): added at hand-off, removed when the owner
         # concludes (bound / requeued / forgotten). A supervised retry
@@ -1408,7 +1448,13 @@ class Scheduler:
         # exists — cheap — and tick() is gated on the process-wide
         # enabled attribute at the one call site (_resolve_batch), so
         # the disarmed hot-path cost is a single attribute test.
-        self._timeline = TimelineTracker(self.metrics)
+        self._timeline = TimelineTracker(self.metrics, name=self.profile)
+        # Per-pod decision provenance (obs/journal.ProvenanceStore):
+        # bounded LRU beside the resultstore. Always constructed
+        # (cheap); records are written only while MINISCHED_JOURNAL is
+        # armed (JOURNAL.enabled attribute test at the stamp sites), so
+        # the unarmed hot path pays one attribute test per batch.
+        self._provenance = ProvenanceStore()
         # SLO sentinel, built lazily from the epoch-current process
         # config at first armed tick (tests re-arm between runs).
         self._slo_sentinel: Optional[slo_mod.SLOSentinel] = None
@@ -1518,6 +1564,8 @@ class Scheduler:
                          | (assigned[:L] != ref_assigned[:L])))
         self._sup_count("shortlist_desyncs")
         instant("shortlist.desync", pods=bad)
+        jnote("shortlist.desync", profile=self.profile, pods=bad,
+              batch=inf.seq)
         self._disable_shortlist(
             f"decisions diverged from the full scan on {bad} pod(s)")
         raise EngineDesync(
@@ -1530,6 +1578,10 @@ class Scheduler:
         stage; sampled steps consult ``_shortlist_k`` per batch."""
         log.error("disabling shortlist-compressed arbitration (%s); "
                   "reverting to the full-width scan", reason)
+        jnote("shortlist.disable", profile=self.profile, reason=reason,
+              batch=self._batch_seq)
+        bundle_mod.capture("shortlist_revert", scheduler=self,
+                           reason=reason)
         self._shortlist_k = None
         if self._mesh is None:
             self._step = build_step(self.plugin_set,
@@ -1596,6 +1648,18 @@ class Scheduler:
         class_pf = idx.class_pf(eb.pf)
         c_pad = int(class_pf.valid.shape[0])
         if rebuild:
+            # Cause precedence: a moved inval epoch wins (the widening
+            # mutation forced this rebuild regardless of what else is
+            # pending); a never-built index (n_built sentinel) is cold;
+            # a dropped state with a prior build is an explicit
+            # invalidate() (residency desync / attach error); then pad
+            # growth; else the classify() fresh-class path.
+            cause = ("widening-invalidation"
+                     if idx.pending_inval != idx.inval_seen
+                     else "cold" if idx.n_built == -1
+                     else "invalidated" if idx.state is None
+                     else "node-pad" if idx.n_built != n_pad
+                     else "fresh-classes")
             with span("index.build", classes=len(idx.rows), n=n_pad):
                 idx.state = build_fn(class_pf, nf, af)
             idx.n_built = n_pad
@@ -1603,6 +1667,8 @@ class Scheduler:
             idx.pending.clear()
             idx.needs_rebuild = False
             self._sup_count("index_rebuilds")
+            jnote("index.rebuild", profile=self.profile, cause=cause,
+                  classes=len(idx.rows), n=n_pad, batch=self._batch_seq)
             inf.scored_rows += c_pad * n_pad
         elif idx.pending:
             rows = np.fromiter(idx.pending, dtype=np.int64,
@@ -1618,6 +1684,8 @@ class Scheduler:
                     idx.state = refresh_fn(idx.state, class_pf, nf, af,
                                            rows_pad)
                 self._sup_count("index_repair_rows", int(rows.size))
+                jnote("index.repair", profile=self.profile,
+                      rows=int(rows.size), batch=self._batch_seq)
                 inf.scored_rows += c_pad * rb
         if act == "corrupt" and idx.state is not None:
             # Scribbled index entries: one node column per class handed
@@ -1679,6 +1747,7 @@ class Scheduler:
                 np.zeros((n_f, p_pad), dtype=np.int32),
                 repaired)
             inf.index_served = True
+            inf.index_mode = "hit"
             if idx is not None:
                 idx.rebuild_streak = 0
             self._sup_count("index_hits")
@@ -1688,6 +1757,8 @@ class Scheduler:
         # Fallback: the original full-row body applied to the whole
         # batch — the engine-level repair rung of the ladder.
         self._sup_count("index_fallbacks")
+        inf.index_mode = "fallback"
+        jnote("index.fallback", profile=self.profile, batch=inf.seq)
         inf.index_free_after = None
         if idx is not None:
             idx.rebuild_streak += 1
@@ -1702,6 +1773,8 @@ class Scheduler:
                 self._sup_count("index_cooldowns")
                 instant("index.cooldown",
                         batches=self._index_cooldown)
+                jnote("index.cooldown", profile=self.profile,
+                      batches=self._index_cooldown, batch=inf.seq)
         with span("step.dispatch"):
             decision = self._step(inf.eb, inf.nf, inf.af, inf.key)
         self._sup_count("steps_dispatched")
@@ -1741,6 +1814,8 @@ class Scheduler:
                          | (assigned[:L] != ref_a[:L])))
         self._sup_count("index_desyncs")
         instant("index.desync", pods=bad)
+        jnote("index.desync", profile=self.profile, pods=bad,
+              batch=inf.seq)
         self._disable_index(
             f"decisions diverged from the full step on {bad} pod(s)")
         raise EngineDesync(
@@ -1753,6 +1828,10 @@ class Scheduler:
         harmlessly; nothing ever consumes them again."""
         log.error("disabling the maintained arbitration index (%s); "
                   "reverting to the per-batch full step", reason)
+        jnote("index.disable", profile=self.profile, reason=reason,
+              batch=self._batch_seq)
+        bundle_mod.capture("index_revert", scheduler=self,
+                           reason=reason)
         self._index = None
 
     def _count_h2d(self, nbytes: int) -> None:
@@ -1914,6 +1993,12 @@ class Scheduler:
         profiles, the SERVICE must construct every engine before starting
         any — a late registration would miss the initial sync."""
         self._shared.ensure_started()
+        jnote("engine.start", profile=self.profile,
+              mode="pipelined" if self.config.pipeline else "sync",
+              resident=bool(self._residency is not None),
+              shortlist_k=int(self._shortlist_k or 0),
+              loop=bool(self._loop_enabled),
+              index=bool(self._index is not None))
         self._thread = threading.Thread(target=self.run, daemon=True,
                                         name="scheduling-loop")
         self._thread.start()
@@ -2213,6 +2298,13 @@ class Scheduler:
                 self._step_counter = anchor  # no decision consumed it
                 for qpi in retry:
                     self.queue.quarantine(qpi)
+                jnote("supervisor.quarantine", profile=self.profile,
+                      pods=len(retry), batch=self._batch_seq,
+                      step=anchor)
+                bundle_mod.capture(
+                    "quarantine", scheduler=self,
+                    reason=f"degradation ladder exhausted; "
+                           f"{len(retry)} pod(s) quarantined")
                 log.error(
                     "supervisor: exhausted the degradation ladder; "
                     "quarantined %d pods (requeued at backoff ceiling)",
@@ -2222,8 +2314,18 @@ class Scheduler:
             self._step_counter = anchor  # replay, don't advance
             try:
                 self.schedule_batch(list(retry))
+                jnote("supervisor.retry", profile=self.profile,
+                      outcome="ok",
+                      rung=DEGRADATION_LADDER[self._sup.level],
+                      pods=len(retry), batch=self._batch_seq,
+                      step=anchor)
                 return
             except Exception:
+                jnote("supervisor.retry", profile=self.profile,
+                      outcome="failed",
+                      rung=DEGRADATION_LADDER[self._sup.level],
+                      pods=len(retry), batch=self._batch_seq,
+                      step=anchor)
                 log.exception("degraded retry failed at rung %r; "
                               "escalating further",
                               DEGRADATION_LADDER[self._sup.level])
@@ -2431,6 +2533,8 @@ class Scheduler:
         just invalidated)."""
         self._sup_count("loop_breaks")
         instant("loop.break", reason=reason, slot=slot)
+        jnote("loop.break", profile=self.profile, reason=reason,
+              slot=slot, batch=self._batch_seq)
         res = self._residency
         if res is not None:
             res.drop(f"device-loop break: {reason}")
@@ -2605,6 +2709,7 @@ class Scheduler:
                    if self._slim else unpack_decision_i32(buf))
             inf.packed_dev = tup
             inf.step_share = share
+            inf.loop_slot = j
             inf.t_dispatch = t_disp0
             self._prep_step0 = int(counters[j]) - 1
             try:
@@ -2986,6 +3091,8 @@ class Scheduler:
                             "forcing a full re-upload", e)
                 self._sup_count("residency_desyncs")
                 instant("residency.desync", reason=str(e))
+                jnote("residency.desync", profile=self.profile,
+                      reason=str(e), batch=self._batch_seq)
                 self._sup.escalate("resident carry desync")
                 carried = False
                 res.drop("carry cross-check mismatch")
@@ -3158,6 +3265,7 @@ class Scheduler:
         finally:
             self._fail_sink = None
             self._track = None
+            self._prov_batch = None
         inf.t_resolved = time.perf_counter()
         with self._metrics_lock:
             inf.h2d1 = self._metrics["h2d_bytes_total"]
@@ -3198,10 +3306,16 @@ class Scheduler:
             self._sup_count(f"slo_alerts_{alert['slo']}")
             instant("slo.burn", **{k: v for k, v in alert.items()
                                    if isinstance(v, (int, float, str))})
+            jnote("slo.burn", profile=self.profile,
+                  batch=self._batch_seq,
+                  **{k: v for k, v in alert.items()
+                     if isinstance(v, (int, float, str))})
             self._timeline.note_alert(alert)
             self._sup.early_warning(f"slo:{alert['slo']}")
         for name in self._slo_sentinel.last_cleared:
             instant("slo.clear", slo=name)
+            jnote("slo.clear", profile=self.profile, slo=name,
+                  batch=self._batch_seq)
         if overload_mod.OVERLOAD.enabled:
             self._drive_overload(entry)
         else:
@@ -3251,9 +3365,18 @@ class Scheduler:
                    if s.kind != "degraded" and sent.burning.get(s.name)}
         ov = self._overload
         prev_shedding = ov.shedding
+        prev_brownout = ov.brownout_active
         if not ov.note_window(burning,
                               entry.get("d_shortlist_repairs", 0.0)):
             return
+        if ov.brownout_active and not prev_brownout:
+            # Brownout ENTRY is one of the bundle-trigger incident
+            # classes: the deepest overload rung means quality is being
+            # shed — freeze the state that explains how we got here.
+            bundle_mod.capture(
+                "brownout", scheduler=self,
+                reason=f"overload ladder entered brownout "
+                       f"(burning: {', '.join(sorted(burning))})")
         # Shortlist retune: always within the certified machinery (any
         # K is exact — repairs absorb a narrow one); a permanent
         # certification revert (_shortlist_k = None) wins forever.
@@ -3309,12 +3432,14 @@ class Scheduler:
         return any(sent.burning.get(s.name) for s in sent.specs
                    if s.kind != "degraded")
 
-    def timeline(self) -> Dict:
+    def timeline(self, since: int = 0) -> Dict:
         """The GET /timeline JSON payload for this engine: the snapshot
         ring (gauges + window deltas + histogram-delta quantiles +
         attribution tags) and the SLO alert log. Empty-but-valid when
-        MINISCHED_TIMELINE is unset."""
-        return self._timeline.to_doc()
+        MINISCHED_TIMELINE is unset. ``since`` returns only rows with
+        ``seq > since`` (the /journal cursor contract — scrapers stop
+        re-downloading the full ring every poll)."""
+        return self._timeline.to_doc(since)
 
     def overload_reject_reason(self) -> Optional[str]:
         """The apiserver admission provider's per-engine verdict: a
@@ -3322,6 +3447,75 @@ class Scheduler:
         past its HTTP-reject rung (counted in admission_rejects_total),
         else None. Any-thread safe (int reads)."""
         return self._overload.http_reject_reason()
+
+    # ---- per-pod decision provenance (obs/journal.ProvenanceStore) -------
+
+    def _prov_path(self, inf: "_InflightBatch") -> dict:
+        """The batch-scoped half of a provenance record: the path that
+        served this batch — engine mode, ring slot, ladder rungs, index
+        posture, shortlist width, residency posture — computed once per
+        resolved batch (journal armed only) and shared by every pod the
+        batch settles."""
+        return {
+            "profile": self.profile,
+            "batch": inf.seq,
+            "step": self._prep_step0 + 1,
+            "mode": ("loop" if inf.step_share is not None
+                     else "pipelined" if self.config.pipeline
+                     else "sync"),
+            "loop_slot": inf.loop_slot,
+            "rung": DEGRADATION_LADDER[self._sup.level],
+            "resident": bool(inf.res_carried),
+            "index": inf.index_mode,
+            "shortlist_k": int(self._shortlist_k or 0),
+            "overload_level": self._overload.level,
+            "decided_unix": round(time.time(), 3),
+        }
+
+    def _prov_stamp(self, qpi: QueuedPodInfo, node_name: str, *,
+                    repaired: bool = False,
+                    spread_repaired: bool = False) -> None:
+        """Stamp a pod's decision provenance onto its QueuedPodInfo at
+        placement time (scheduling thread, inside resolve — the one
+        window where the chosen node and the batch path are both
+        known). The bound/failed settlement sites then publish it into
+        the LRU with the outcome. Callers gate on ``_prov_batch`` so
+        the unarmed path never even makes the call."""
+        path = self._prov_batch
+        if path is None:
+            return
+        qpi.prov = {**path, "pod": qpi.pod.key, "node": node_name,
+                    "attempts": qpi.attempts,
+                    "shed_count": qpi.shed_count,
+                    "shortlist_repaired": bool(repaired),
+                    "spread_repaired": bool(spread_repaired)}
+
+    def _prov_settle_failure(self, qpi: QueuedPodInfo, plugins,
+                             message: str, retryable: bool) -> None:
+        """Publish a failed/requeued pod's provenance record (journal
+        armed only; callers gate on JOURNAL.enabled). A pod that never
+        reached a placement stamp still gets the batch path when the
+        verdict lands on the scheduling thread mid-resolve."""
+        base = qpi.prov
+        qpi.prov = None  # consumed — see the bound-settlement twin
+        if base is None:
+            path = (self._prov_batch
+                    if threading.get_ident() == self._fail_sink_tid
+                    else None)
+            base = {**path, "pod": qpi.pod.key} if path else {
+                "profile": self.profile, "pod": qpi.pod.key}
+        self._provenance.record(qpi.pod.key, {
+            **base, "outcome": "requeued" if retryable else "failed",
+            "plugins": sorted(plugins), "message": message[:200],
+            "attempts": qpi.attempts,
+            "settled_unix": round(time.time(), 3)})
+
+    def provenance(self, pod_key: str) -> Optional[dict]:
+        """The ``GET /provenance/<pod>`` record for one pod, or None.
+        Empty store when MINISCHED_JOURNAL was never armed. (The
+        journal itself is process-wide — SchedulerService.journal
+        serves it; there is deliberately no per-engine proxy.)"""
+        return self._provenance.get(pod_key)
 
     def _rollback_assumed(self, inf: "_InflightBatch") -> None:
         if not inf.assumed:
@@ -3368,6 +3562,9 @@ class Scheduler:
             self._sup_count("watchdog_trips")
             instant("watchdog.trip", window_s=round(step_window, 6),
                     deadline_s=wd)
+            jnote("watchdog.trip", profile=self.profile,
+                  window_s=round(step_window, 6), deadline_s=wd,
+                  batch=inf.seq)
             self._sup.escalate(
                 f"watchdog: device step took {step_window:.3f}s "
                 f"(deadline {wd}s)")
@@ -3438,6 +3635,10 @@ class Scheduler:
             self._check_shortlist(inf, chosen, assigned)
             inf.sl_repairs += int(sl_repaired[:L0].sum())
         sp = self._fetch_spread(spread_dev)
+        # Provenance path (journal armed only): computed AFTER the index
+        # settle (index_mode is final) and before any placement stamp.
+        self._prov_batch = (self._prov_path(inf) if JOURNAL.enabled
+                            else None)
         if inf.res_carried:
             # Replay the MAIN step's device debits into the host mirror
             # and adopt free_after as the carried next-batch input —
@@ -3622,6 +3823,9 @@ class Scheduler:
                 continue
             if assigned_l[i]:
                 node_name = names[chosen_l[i]]
+                if self._prov_batch is not None:
+                    self._prov_stamp(qpi, node_name,
+                                     repaired=bool(sl_repaired[i]))
                 if bulk_assume:
                     assume_items.append((qpi.pod, node_name))
                     assume_rows.append(i)
@@ -4236,6 +4440,10 @@ class Scheduler:
                     # pods the same way, so the two paths agree.
                     n_admitted += 1
                     node_name = names[int(chosen2[j])]
+                    if self._prov_batch is not None:
+                        self._prov_stamp(batch[i], node_name,
+                                         repaired=bool(rep2[j]),
+                                         spread_repaired=True)
                     if bulk:
                         items.append((batch[i].pod, node_name))
                         req_rows.append(j)
@@ -4878,6 +5086,16 @@ class Scheduler:
         if self.recorder is not None:
             for k, v in self.recorder.stats().items():
                 out[f"resultstore_{k}"] = v
+        # Decision-journal + provenance surfaces (obs/journal.py): the
+        # process-wide event count/drop ledger and this engine's
+        # provenance LRU occupancy. All zeros with MINISCHED_JOURNAL
+        # unset.
+        out["journal_events"] = JOURNAL.next_seq()
+        out["journal_dropped"] = JOURNAL.dropped()
+        out["journal_dropped_by_fault"] = JOURNAL.dropped_by_fault
+        pstats = self._provenance.stats()
+        out["provenance_records"] = pstats["records"]
+        out["provenance_evictions"] = pstats["evictions"]
         # Per-gate fault-injection fire counts (PROCESS-wide registry —
         # shared across co-located engines; with MINISCHED_FAULTS unset
         # all zeros, proving a run was fault-free).
@@ -5122,6 +5340,20 @@ class Scheduler:
         if bnd:
             h["pod_bind_s"].observe_many(bnd)
         h["pod_create_to_bound_s"].observe_many(c2b)
+        if JOURNAL.enabled:
+            # Settle the per-pod provenance records: every pods_bound
+            # site funnels through here, so "record exists and matches
+            # store truth for every bound pod" holds by construction.
+            for qpi in qpis:
+                rec = qpi.prov
+                if rec is not None:
+                    # The stamp is consumed at settlement: a later
+                    # attempt of a requeued pod must never publish this
+                    # attempt's node/batch tags under its own verdict.
+                    qpi.prov = None
+                    self._provenance.record(qpi.pod.key, {
+                        **rec, "outcome": "bound",
+                        "bound_unix": round(now_w, 3)})
 
     def _bind(self, qpi: QueuedPodInfo, node_name: str) -> None:
         pod = qpi.pod
@@ -5279,6 +5511,8 @@ class Scheduler:
 
     def _handle_failure(self, qpi: QueuedPodInfo, plugins: Set[str],
                         message: str, *, retryable: bool) -> None:
+        if JOURNAL.enabled:
+            self._prov_settle_failure(qpi, plugins, message, retryable)
         # Resolve-phase verdicts defer into the cycle's failure sink and
         # flush in bulk at commit (_flush_failures) — a skew-constrained
         # burst otherwise pays two store round-trips per revocation on

@@ -38,6 +38,14 @@ production scheduler's failure domain spans:
                 flusher re-admits), ``err`` models the verdict
                 machinery failing and the ingress FAILS OPEN (admit),
                 ``stall`` delays the ingress transaction.
+    journal     decision-journal event write (obs/journal.py) —
+                ``err`` DROPS the event (counted ``dropped_by_fault``;
+                the journal is an observer, so a faulted recorder
+                loses history, never a decision — bit-identity under
+                an err'd journal is pinned by test), ``corrupt``
+                scribbles the recorded seq field (the internal
+                ordering key stays exact, so a corrupted recorder is
+                observable but can never reorder history).
 
 Configured once per process from ``MINISCHED_FAULTS`` (tests reconfigure
 via :func:`configure`), a comma-separated list of ``gate:action@trigger``
@@ -92,17 +100,18 @@ import time
 from typing import Dict, List, Optional
 
 from .obs import instant as _trace_instant
+from .obs.journal import note as _journal_note
 
 log = logging.getLogger(__name__)
 
 #: The gate catalog; hit() rejects unknown names so a typo in a rule or a
 #: call site cannot silently never fire.
-# "admission" appends LAST: per-gate PRNG streams seed by catalog index,
+# "journal" appends LAST: per-gate PRNG streams seed by catalog index,
 # so appending (never inserting) keeps every existing gate's firing
 # pattern stable under a fixed seed.
 GATES = ("step", "fetch", "residency", "shortlist_repair", "commit",
          "bind", "informer", "http", "checkpoint", "lifecycle",
-         "admission", "index")
+         "admission", "index", "journal")
 
 _ACTIONS = ("err", "die", "corrupt", "stall")
 
@@ -263,6 +272,13 @@ class FaultRegistry:
         # shows WHERE each gate fired relative to the engine spans.
         _trace_instant(f"fault.{gate}", spec=fired.spec, call=call_no,
                        action=fired.action)
+        # Decision-journal event (obs/journal.py): the causal chain's
+        # ROOT — postmortem narratives trace from this fire through the
+        # ladder moves it provoked. note() skips its own gate for the
+        # ``fault.journal`` kind, so a firing journal gate cannot
+        # recurse.
+        _journal_note(f"fault.{gate}", spec=fired.spec, call=call_no,
+                      action=fired.action)
         if fired.action == "stall":
             time.sleep(fired.stall_s)
             return None

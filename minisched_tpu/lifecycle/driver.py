@@ -536,6 +536,23 @@ class LifecycleDriver:
                 # sentinel's invariant_violations objective watches this
                 # tag (threshold 0 — one confirmed violation burns).
                 note_activity("invariant_violation", len(viols))
+                # Journal + incident bundle BEFORE the raise unwinds:
+                # the oracle's verdict is a terminal incident class and
+                # the state explaining it is gone once the run tears
+                # down. Engine surfaces ride along when the cluster
+                # runs live.
+                from ..obs import bundle as bundle_mod
+                from ..obs.journal import note as jnote
+
+                jnote("invariant.violation", invariant=name,
+                      step=self.steps, t=round(self.clock, 6),
+                      seed=self.seed, count=len(viols),
+                      first=viols[0][:200])
+                svc = getattr(self.cluster, "service", None)
+                sched = svc.scheduler if svc is not None else None
+                bundle_mod.capture(
+                    "invariant_violation", scheduler=sched,
+                    reason=f"[{name}] " + "; ".join(viols[:3]))
                 raise InvariantViolation(
                     f"[{name}] after step #{self.steps} "
                     f"(t={self.clock:.3f}, seed={self.seed}): "

@@ -76,7 +76,20 @@ from bisect import bisect_left
 from typing import Dict, List, Optional
 
 __all__ = ["TRACE", "TraceRecorder", "Histogram", "LATENCY_BUCKETS",
-           "configure", "span", "instant", "traced", "hist_quantile"]
+           "configure", "span", "instant", "traced", "hist_quantile",
+           "ring_tail"]
+
+
+def ring_tail(buf: list, n: int, cap: int) -> list:
+    """Oldest-retained-first copy of a bounded overwrite ring (the
+    journal / timeline ring discipline: append at ``n % cap`` once
+    full). One shared definition — the rotation arithmetic must not be
+    re-derived at every snapshot site. Caller holds whatever lock
+    guards ``buf``."""
+    if n <= cap:
+        return list(buf)
+    i = n % cap
+    return buf[i:] + buf[:i]
 
 
 class _NullSpan:

@@ -59,7 +59,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from . import hist_quantile
+from . import hist_quantile, ring_tail
 
 __all__ = ["TIMELINE", "TimelineConfig", "TimelineTracker", "configure",
            "note_activity", "parse_every"]
@@ -274,7 +274,15 @@ class TimelineTracker:
         m = self._metrics_fn()
         now = time.monotonic()
         entry: dict = {"t": round(now - self._t0, 6),
-                       "unix": round(time.time(), 3)}
+                       "unix": round(time.time(), 3),
+                       # Monotonic per-tracker row number — the ``GET
+                       # /timeline?since=<seq>`` cursor (scrapers stop
+                       # re-downloading the full ring every poll) and
+                       # the per-profile attribution key: every row
+                       # names the profile whose engine produced it
+                       # (the multi-tenant per-tenant dimension).
+                       "seq": self.snapshots() + 1,
+                       "profile": self.name}
         for k in GAUGE_KEYS:
             v = m.get(k)
             if isinstance(v, (int, float)):
@@ -340,10 +348,7 @@ class TimelineTracker:
     def entries(self) -> List[dict]:
         """Time-ordered snapshot copies (oldest retained first)."""
         with self._lock:
-            if self._n <= self._cap:
-                return list(self._ring)
-            i = self._n % self._cap
-            return self._ring[i:] + self._ring[:i]
+            return ring_tail(self._ring, self._n, self._cap)
 
     def alerts(self) -> List[dict]:
         with self._lock:
@@ -363,14 +368,31 @@ class TimelineTracker:
         growing (idle engine)."""
         return time.monotonic() - self._t0
 
-    def to_doc(self) -> dict:
-        """The ``GET /timeline`` JSON payload for this engine."""
+    def to_doc(self, since: int = 0) -> dict:
+        """The ``GET /timeline`` JSON payload for this engine.
+        ``since`` is the cursor contract shared with ``/journal``: only
+        rows with ``seq > since`` are returned, and ``next_seq`` is
+        what the client hands back next poll — rows the ring already
+        dropped are simply gone (the client's cursor stays valid; the
+        ``dropped`` count says how much history it missed)."""
         cfg = TIMELINE
+        # Ring and counters under ONE lock hold: a tick landing between
+        # an entries() snapshot and a separate counter read would
+        # advance next_seq past a row the client never received — the
+        # cursor must cover exactly the returned rows.
+        with self._lock:
+            entries = ring_tail(self._ring, self._n, self._cap)
+            snapshots = self._n
+            dropped = max(0, self._n - len(self._ring))
+            alerts = list(self._alerts)
+        if since:
+            entries = [e for e in entries if e.get("seq", 0) > since]
         return {"enabled": cfg.enabled,
                 "every_batches": cfg.every_batches,
                 "every_s": cfg.every_s,
                 "capacity": cfg.capacity,
-                "snapshots": self.snapshots(),
-                "dropped": self.dropped(),
-                "entries": self.entries(),
-                "alerts": self.alerts()}
+                "snapshots": snapshots,
+                "next_seq": snapshots,
+                "dropped": dropped,
+                "entries": entries,
+                "alerts": alerts}

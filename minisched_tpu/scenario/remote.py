@@ -71,6 +71,12 @@ def serve(store_only: bool = False) -> None:
         # snapshot ring + SLO alert log (empty-but-valid when
         # MINISCHED_TIMELINE is unset)
         api.timeline_providers.append(svc.timeline)
+        # black-box decision journal + per-pod provenance: GET
+        # /journal?since=<seq> streams the causal event log, GET
+        # /provenance/<ns>/<pod> serves the path-that-served-it record
+        # (both empty/404 when MINISCHED_JOURNAL is unset)
+        api.journal_providers.append(svc.journal)
+        api.provenance_providers.append(svc.provenance)
         # overload backpressure: pod creates answer a typed 429 while
         # a co-located engine sheds (MINISCHED_OVERLOAD; a no-op when
         # unset)

@@ -129,16 +129,41 @@ class SchedulerService:
                 return reason
         return None
 
-    def timeline(self) -> Dict[str, dict]:
+    def timeline(self, since: int = 0) -> Dict[str, dict]:
         """Per-profile temporal-telemetry documents (the ``GET
         /timeline`` payload): profile name → ``Scheduler.timeline()``
         dict (snapshot ring + SLO alert log). Always keyed by profile
         name — the timeline is a diagnostic surface, and an explicit
         key survives a later second profile without renaming (unlike
         metrics(), whose unprefixed single-profile names are a pinned
-        scrape contract)."""
-        return {name: engine.timeline()
+        scrape contract). ``since`` is the per-profile row cursor
+        (``?since=<seq>``; poll with each document's ``next_seq``).
+        Seq spaces are independent per profile, so a multi-profile
+        scraper polls one profile per request
+        (``?profile=<name>&since=<seq>`` on the endpoint) — one scalar
+        cursor across profiles would starve the slower profile."""
+        return {name: engine.timeline(since)
                 for name, engine in self.schedulers.items()}
+
+    def journal(self, since: int = 0) -> Dict:
+        """The ``GET /journal`` payload (``APIServer.journal_providers``
+        feed): the process-wide decision journal — one causal event log
+        shared by every profile engine, each event tagged with its
+        serving profile. Empty-but-valid with MINISCHED_JOURNAL unset."""
+        from ..obs.journal import JOURNAL
+
+        return JOURNAL.to_doc(since)
+
+    def provenance(self, pod_key: str):
+        """The ``GET /provenance/<pod>`` record
+        (``APIServer.provenance_providers`` feed): the first profile
+        engine holding a decision-provenance record for the pod answers
+        (profiles share no pods); None = no record."""
+        for engine in self._scheds.values():
+            rec = engine.provenance(pod_key)
+            if rec is not None:
+                return rec
+        return None
 
     def start_scheduler(self, profile: ProfileSpec = None,
                         config: Optional[SchedulerConfig] = None) -> Scheduler:
@@ -217,7 +242,7 @@ class SchedulerService:
             sched = Scheduler(
                 self._store, plugin_set, self._config, recorder=recorder,
                 scheduler_names={p.name} if self._multi else None,
-                shared=self._shared_state)
+                shared=self._shared_state, profile=p.name)
             self._scheds[p.name] = sched
         for sched in self._scheds.values():
             sched.start()

@@ -653,6 +653,96 @@ def test_admission_gate_corrupt_sheds_and_flusher_readmits(registry):
         q.close()
 
 
+# ---- journal gate (obs/journal.py) ---------------------------------------
+
+
+def test_journal_gate_err_drops_event_corrupt_scribbles_seq(registry):
+    """The ``journal`` gate sits on the event write: err drops the
+    event (counted — history lost, nothing else), corrupt scribbles
+    the recorded seq field while the internal order stays exact, and
+    the registry's own fault.journal fire event never re-traverses the
+    gate (recursion guard)."""
+    from minisched_tpu.obs import journal as journal_mod
+
+    journal_mod.configure("1")
+    try:
+        _configure("journal:err@1")
+        journal_mod.note("test.dropped")
+        assert journal_mod.JOURNAL.dropped_by_fault == 1
+        # the gate's own fire event IS recorded (it skips the gate);
+        # the original event is what the err dropped
+        assert [e["kind"] for e in journal_mod.JOURNAL.entries()] == [
+            "fault.journal"]
+        journal_mod.note("test.kept")  # gate call #2: no fire
+        assert [e["kind"] for e in journal_mod.JOURNAL.entries()] == [
+            "fault.journal", "test.kept"]
+
+        journal_mod.configure("1")
+        _configure("journal:corrupt@1")
+        journal_mod.note("test.scribbled")
+        ents = journal_mod.JOURNAL.entries()
+        # the gate's own fire event lands first (it skips the gate),
+        # then the scribbled-seq original
+        assert [e["kind"] for e in ents] == ["fault.journal",
+                                             "test.scribbled"]
+        assert ents[0]["seq"] == 1
+        assert ents[1]["seq"] >= (1 << 30)  # observable scribble
+    finally:
+        journal_mod.configure("")
+
+
+def test_journal_fault_never_touches_decisions(registry):
+    """Bit-identity under an err'd journal: a run whose every journal
+    write fails must place every pod exactly where the clean run did —
+    the recorder is an observer, never an input."""
+    from minisched_tpu.obs import journal as journal_mod
+
+    def run():
+        c = Cluster()
+        try:
+            c.start(profile=Profile(plugins=[
+                        "NodeUnschedulable", "NodeResourcesFit",
+                        "NodeResourcesLeastAllocated"]),
+                    config=SchedulerConfig(max_batch_size=8,
+                                           batch_window_s=0.3,
+                                           batch_idle_s=0.1,
+                                           backoff_initial_s=0.05,
+                                           backoff_max_s=0.3),
+                    with_pv_controller=False)
+            for i, cpu in enumerate((64000, 48000)):
+                c.create_node(f"n{i}", cpu=cpu)
+            c.create_objects([obj.Pod(
+                metadata=obj.ObjectMeta(name=f"jf{i}",
+                                        namespace="default"),
+                spec=obj.PodSpec(requests={"cpu": 100 + 13 * i}))
+                for i in range(12)])
+            deadline = time.monotonic() + 60
+            placed = {}
+            while time.monotonic() < deadline:
+                placed = {p.metadata.name: p.spec.node_name
+                          for p in c.list_pods() if p.spec.node_name}
+                if len(placed) == 12:
+                    break
+                time.sleep(0.05)
+            assert len(placed) == 12
+            return placed
+        finally:
+            c.shutdown()
+
+    base = run()
+    journal_mod.configure("1")
+    try:
+        # nth-form rules: the first two journal writes deterministically
+        # err (engine.start is write #1 — losing the run marker must
+        # still not move a placement)
+        _configure("journal:err@1,journal:err@2")
+        armed = run()
+        assert armed == base
+        assert journal_mod.JOURNAL.dropped_by_fault >= 1
+    finally:
+        journal_mod.configure("")
+
+
 # ---- whole-suite coverage ------------------------------------------------
 
 

@@ -400,10 +400,16 @@ def test_brownout_engages_and_recovers_in_ladder_order():
         total = 0
         deadline = time.monotonic() + 60
         # backlog-held saturation until the brownout rung is observed
-        # (see the shed test: bounded total, guaranteed burn)
+        # (see the shed test: bounded total, guaranteed burn). The
+        # backlog floor must clear the 0.3 s queue-wait threshold with
+        # MARGIN: at 150 pods a warm process (the full tier-1 shape,
+        # where every step shape is long since compiled) drains a
+        # 3-pod batch in a few ms and p95 hovers AT the threshold —
+        # observed as a full-suite-only flake; 400 pods puts the
+        # steady wait decisively past it on any host.
         wave = 0
         while ov.level < 3 and time.monotonic() < deadline:
-            if total - sched.metrics()["pods_bound"] < 150:
+            if total - sched.metrics()["pods_bound"] < 400:
                 c.create_objects([_pod(f"b{wave}-{j}", prio=1000, cpu=10)
                                   for j in range(8)])
                 total += 8

@@ -1,0 +1,309 @@
+"""Decision-journal overhead + incident-bundle contract bench at CPU
+shapes.
+
+Interleaved journal-off/on rounds (the BENCH_TRACE/BENCH_SLO
+drift-cancelling discipline) through bench.check_phases — single-burst
+and sustained streaming — plus one deterministic faulted round with the
+journal + bundle capture armed, proving the acceptance claims of the
+black-box recorder:
+
+  * overhead: journal + provenance armed stays within 5% of unarmed on
+    the create→bound window (min-of-N per mode; events fire only at
+    state transitions, provenance is one dict write per settled pod);
+  * clean rounds record provenance for EVERY bound pod and the journal
+    stays quiet (a healthy run has no transitions to journal);
+  * the faulted round drives the supervisor ladder to quarantine with a
+    consecutive-fault schedule, auto-captures a schema-valid incident
+    bundle (tools/postmortem.py exits 0 on it), and the bundle's causal
+    narrative NAMES the injected gate (``fault.step`` roots the chain).
+
+Tools of record commit the output as BENCH_JOURNAL.json:
+
+    JAX_PLATFORMS=cpu python tools/bench_journal.py [> BENCH_JOURNAL.json]
+
+    # the `make bench-check` slice: min-of-2 structural claim gate at
+    # the 500 x 250 check shape (exit 1 on a claim failure; wall-clock
+    # overhead is advisory there — sub-second windows jitter ±20% both
+    # directions) + advisory key diff vs the committed
+    # BENCH_LEDGER.json entry (source bench-journal)
+    JAX_PLATFORMS=cpu python tools/bench_journal.py --check
+    JAX_PLATFORMS=cpu python tools/bench_journal.py --check --update
+
+MINISCHED_BENCH_NODES / MINISCHED_BENCH_PODS override the 2000 x 1000
+CPU shape; MINISCHED_BENCH_ROUNDS the interleave count.
+"""
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+MODES = (("journal_off", False), ("journal_on", True))
+PHASES = ("engine", "stream")
+
+#: stream keys stable enough for the cross-run regression ledger
+LEDGER_KEYS = ("stream_sched_s", "stream_pods_per_sec",
+               "stream_hist_p99_s")
+
+
+def run_phases(n: int, p: int) -> dict:
+    import bench
+
+    return bench.check_phases(n, p)
+
+
+def faulted_round() -> dict:
+    """One deterministic faulted burst: four consecutive step-dispatch
+    errors walk the ladder resident→upload→sync→quarantine, the
+    quarantine transition auto-captures an incident bundle, and the
+    postmortem validates it and traces the chain back to the injected
+    gate. Small shape — the claim is causal, not temporal."""
+    from minisched_tpu import faults
+    from minisched_tpu.config import SchedulerConfig
+    from minisched_tpu.obs import bundle as bundle_mod
+    from minisched_tpu.obs import journal as journal_mod
+    from minisched_tpu.scenario import Cluster
+    from minisched_tpu.service.defaultconfig import Profile
+    from minisched_tpu.state import objects as obj
+
+    import postmortem
+
+    tmp = tempfile.mkdtemp(prefix="bench-journal-bundles-")
+    journal_mod.configure("1")
+    bundle_mod.configure(tmp)
+    faults.configure("step:err@2,step:err@3,step:err@4,step:err@5")
+    out = {}
+    c = Cluster()
+    try:
+        c.start(profile=Profile(plugins=["NodeUnschedulable",
+                                         "NodeResourcesFit",
+                                         "NodeResourcesLeastAllocated"]),
+                config=SchedulerConfig(max_batch_size=16,
+                                       backoff_initial_s=0.05,
+                                       backoff_max_s=0.3,
+                                       probation_batches=2),
+                with_pv_controller=False)
+        sched = c.service.scheduler
+        for i in range(2):
+            c.create_node(f"n{i}", cpu=64000)
+        c.create_objects([obj.Pod(
+            metadata=obj.ObjectMeta(name=f"p{i}", namespace="default"),
+            spec=obj.PodSpec(requests={"cpu": 100 + i}))
+            for i in range(40)])
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if sum(1 for q in c.list_pods() if q.spec.node_name) == 40:
+                break
+            time.sleep(0.1)
+        faults.configure("")
+        # recovery pump: probation climbs only on clean batches
+        pump, dl = 0, time.monotonic() + 90
+        while (sched.metrics()["degradation_state"] != "resident"
+               and time.monotonic() < dl):
+            c.create_objects([obj.Pod(
+                metadata=obj.ObjectMeta(name=f"pump{pump}-{j}",
+                                        namespace="default"),
+                spec=obj.PodSpec(requests={"cpu": 10}))
+                for j in range(4)])
+            pump += 1
+            time.sleep(0.3)
+        m = sched.metrics()
+        bound = [q for q in c.list_pods() if q.spec.node_name]
+        prov_ok = sum(
+            1 for q in bound
+            if (r := sched.provenance(q.key)) is not None
+            and r.get("outcome") == "bound"
+            and r.get("node") == q.spec.node_name)
+        events = journal_mod.JOURNAL.entries()
+        kinds = [e["kind"] for e in events]
+        chains = postmortem.narrative(events)
+        bundles = [d for d in os.listdir(tmp)
+                   if d.startswith("incident-")]
+        bundle_valid = False
+        names_gate = False
+        if bundles:
+            bpath = os.path.join(tmp, bundles[0])
+            doc = postmortem.load_bundle(bpath)
+            try:
+                postmortem.validate_bundle(doc)
+                bundle_valid = True
+            except ValueError as e:
+                out["bundle_error"] = str(e)
+            names_gate = any("fault.step" in line for line in chains)
+        out.update({
+            "pods_bound": int(m["pods_bound"]),
+            "quarantined_batches": int(m["quarantined_batches"]),
+            "recovered_resident":
+                m["degradation_state"] == "resident",
+            "journal_events": int(m["journal_events"]),
+            "journal_kinds": sorted(set(kinds)),
+            "provenance_bound_matching": prov_ok,
+            "provenance_bound_total": len(bound),
+            "bundles_captured": bundles,
+            "bundle_schema_valid": bundle_valid,
+            "narrative_names_injected_gate": names_gate,
+            "causal_chains": chains[:6],
+            "chain_reaches_recovery": any(
+                "supervisor.recover" in line and "[unresolved]"
+                not in line for line in chains),
+        })
+    finally:
+        faults.configure("")
+        c.shutdown()
+        journal_mod.configure("")
+        bundle_mod.configure("")
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def claims(doc: dict, *, overhead_bar=5.0) -> list:
+    """The artifact's acceptance contract → list of failure strings.
+    ``overhead_bar=None`` makes the wall-clock overhead ADVISORY (the
+    --check shape's sub-second windows carry ±20% host jitter in BOTH
+    directions — the committed min-of-4 full-shape artifact is where
+    the ≤5% claim is measurable and enforced; the structural claims
+    below gate identically at every shape)."""
+    bad = []
+    if overhead_bar is not None:
+        for v in (doc.get("journal_overhead") or {}).values():
+            if v > overhead_bar:
+                bad.append(f"journal overhead {v}% > {overhead_bar}%")
+    on = doc["modes"]["journal_on"]
+    for prefix in PHASES:
+        b = on.get(f"{prefix}_bound")
+        pr = on.get(f"{prefix}_provenance_records")
+        if b and (pr or 0) < b:
+            bad.append(f"{prefix}: provenance records {pr} < bound {b}")
+    f = doc.get("faulted") or {}
+    if not f.get("bundle_schema_valid"):
+        bad.append("faulted round captured no schema-valid bundle")
+    if not f.get("narrative_names_injected_gate"):
+        bad.append("bundle narrative does not name the injected gate")
+    if not f.get("chain_reaches_recovery"):
+        bad.append("no causal chain reaches a recovery event")
+    if f.get("provenance_bound_matching") != f.get(
+            "provenance_bound_total"):
+        bad.append("faulted round: provenance != store truth for some "
+                   "bound pod")
+    return bad
+
+
+def capture(n: int, p: int, rounds: int, *,
+            overhead_bar=5.0) -> dict:
+    from minisched_tpu.obs import journal as journal_mod
+
+    doc = {"nodes": n, "pods": p, "platform": "cpu",
+           "methodology":
+               f"interleaved journal-off/on rounds; time keys are "
+               f"min-of-{rounds} per mode; armed rounds ride the "
+               "default ring cap with provenance recorded for every "
+               "settled pod; the faulted round injects four "
+               "consecutive step-dispatch errors (ladder walks to "
+               "quarantine), auto-captures the incident bundle, and "
+               "gates postmortem schema validity + the causal "
+               "narrative naming the injected gate",
+           "modes": {}}
+    runs = {label: [] for label, _ in MODES}
+    for _round in range(rounds):
+        for label, armed in MODES:  # interleaved: off, on, off, on
+            journal_mod.configure("1" if armed else "")
+            runs[label].append(run_phases(n, p))
+    journal_mod.configure("")
+    for label, _ in MODES:
+        merged = dict(runs[label][0])
+        for rep in runs[label][1:]:
+            for k, v in rep.items():
+                if (k.endswith("_s") and isinstance(v, (int, float))
+                        and isinstance(merged.get(k), (int, float))):
+                    merged[k] = min(merged[k], v)
+                elif k.endswith("_provenance_records"):
+                    merged[k] = max(merged.get(k, 0), v)
+        bound = merged.get("stream_bound")
+        sched_s = merged.get("stream_sched_s")
+        if bound and sched_s:
+            merged["stream_pods_per_sec"] = round(bound / sched_s, 1)
+        doc["modes"][label] = merged
+    off, on = doc["modes"]["journal_off"], doc["modes"]["journal_on"]
+    overhead = {}
+    for prefix in PHASES:
+        a, b = off.get(f"{prefix}_sched_s"), on.get(f"{prefix}_sched_s")
+        if a and b:
+            overhead[f"{prefix}_overhead_pct"] = round(
+                100.0 * (b - a) / a, 2)
+    doc["journal_overhead"] = overhead
+    doc["overhead_within_5pct"] = all(v <= 5.0
+                                      for v in overhead.values())
+    doc["faulted"] = faulted_round()
+    doc["claims_failed"] = claims(doc, overhead_bar=overhead_bar)
+    doc["ok"] = not doc["claims_failed"]
+    return doc
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="one-round claim-contract gate + advisory key "
+                         "diff vs the committed ledger (exit 1 on a "
+                         "claim failure)")
+    ap.add_argument("--update", action="store_true",
+                    help="append this capture to the ledger as the new "
+                         "bench-journal baseline")
+    ap.add_argument("--ledger",
+                    default=os.path.join(REPO, "BENCH_LEDGER.json"))
+    args = ap.parse_args()
+    # --check runs at the bench-check shape (500 × 250, like
+    # tools/bench_compare.py) so the gate stays minutes-class; the
+    # committed artifact uses the full CPU shape. The check slice's
+    # sub-second phase windows carry ±20% host jitter in both
+    # directions (observed: the ARMED round measuring faster), so the
+    # wall-clock overhead is advisory there (the bench-overload
+    # precedent) and the hard gate is the structural contract —
+    # bundle schema validity, the narrative naming the injected gate,
+    # the chain reaching recovery, provenance == store truth. The ≤5%
+    # overhead claim is enforced on the committed min-of-4 full-shape
+    # capture (`make bench-journal`).
+    default_shape = ("500", "250") if args.check else ("2000", "1000")
+    n = int(os.environ.get("MINISCHED_BENCH_NODES", default_shape[0]))
+    p = int(os.environ.get("MINISCHED_BENCH_PODS", default_shape[1]))
+    rounds = int(os.environ.get("MINISCHED_BENCH_ROUNDS",
+                                "2" if args.check else "4"))
+    doc = capture(n, p, rounds,
+                  overhead_bar=None if args.check else 5.0)
+
+    # ---- ledger + (advisory) regression diff ---------------------------
+    import bench
+    from bench_compare import compare, latest_baseline
+
+    keys = {k: v for k in LEDGER_KEYS
+            for v in [doc["modes"]["journal_on"].get(k)]
+            if isinstance(v, (int, float)) and v}
+    entry = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+             "source": "bench-journal", "platform": "cpu",
+             "nodes": n, "pods": p, "keys": keys}
+    try:
+        with open(args.ledger, encoding="utf-8") as f:
+            ledger = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        ledger = {"schema": 1, "runs": []}
+    base = latest_baseline(ledger, n, p, "cpu", source="bench-journal")
+    if base is not None:
+        # Advisory: CPU wall-clock varies several-fold between hosts;
+        # the hard gate is the claim contract (overhead + bundle).
+        doc["ledger_diff"] = compare(keys, base.get("keys") or {})
+    if args.update or (not args.check and base is None):
+        bench.append_ledger(entry, args.ledger)
+        doc["ledger_appended"] = True
+    print(json.dumps(doc))
+    if args.check and not doc["ok"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
