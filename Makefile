@@ -14,10 +14,12 @@ SHELL := /bin/bash
 
 .PHONY: test tier1 fault-smoke shortlist-smoke trace-smoke slo-smoke \
         churn-smoke overload-smoke loop-smoke index-smoke journal-smoke \
-        profile-smoke start start-remote start-client-engine demo docs \
+        fleet-smoke profile-smoke start start-remote start-client-engine \
+        demo docs \
         bench bench_sharded bench-cpu bench-pipeline bench-residency \
         bench-shortlist bench-trace bench-slo bench-churn bench-overload \
         bench-deviceloop bench-index bench-coldstart bench-journal \
+        bench-fleet \
         bench-check dryrun dryrun-dcn soak soak-faults soak-churn \
         soak-overload
 
@@ -117,6 +119,17 @@ journal-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_journal.py -x -q \
 	  -p no:cacheprovider -p no:randomly
 
+# Fast deterministic replicated-fleet suite (~60 s): shard map purity/
+# totality, lease epochs monotone under concurrent claimants, clean
+# 2-replica partition with zero cross-shard binds, kill-mid-burst
+# takeover oracle-green within one lease TTL, restart rejoins without
+# stealing, decisions bit-identical to a single-engine run on the same
+# shard. A tier-1 prerequisite after journal-smoke: the HA control
+# plane rides on the journal's takeover provenance.
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py -x -q \
+	  -p no:cacheprovider -p no:randomly
+
 # The EXACT ROADMAP tier-1 verify command (dots count + exit code
 # preserved) — what the driver runs after every PR; run it locally
 # before shipping. shortlist-smoke runs first: the arbitration
@@ -129,9 +142,10 @@ journal-smoke:
 # maintained index composes with ring, residency, and the K-dial and
 # must never change a decision either); journal-smoke after index-smoke
 # (the black-box recorder hooks every layer above and must never change
-# a decision).
+# a decision); fleet-smoke after journal-smoke (lease takeovers journal
+# their provenance through the recorder).
 tier1: shortlist-smoke trace-smoke slo-smoke overload-smoke loop-smoke \
-       index-smoke journal-smoke churn-smoke
+       index-smoke journal-smoke fleet-smoke churn-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -272,6 +286,7 @@ bench-check:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_index.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_coldstart.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_journal.py --check
+	JAX_PLATFORMS=cpu $(PY) tools/bench_fleet.py --check
 
 # Persistent device-loop before/after (the committed
 # BENCH_DEVICELOOP.json): interleaved off/on min-of-4 rounds of the
@@ -307,6 +322,20 @@ bench-index:
 # bench-check` gates them.
 bench-journal:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_journal.py
+
+# Replicated-fleet contract bench (the committed BENCH_FLEET.json):
+# the same saturated burst at 1/2/4 replicas (median-of-N wall-clock;
+# the ≥1.5x 2-replica scaling claim gates only on ≥2-core hosts — on
+# one core the gate is the ≤25% replication-tax bound, recorded as
+# not-expressible in the artifact), the 2-replica clean-partition
+# contract (zero stale-owner disposals, both shards served), and a
+# kill-mid-burst failover phase: zero pods lost, exactly-once binds,
+# journaled takeover within 2×TTL + scan slack, p99-under-failover
+# bounded by the clean p99 + takeover budget. Stable keys append to
+# BENCH_LEDGER.json (source bench-fleet) so `make bench-check` gates
+# them.
+bench-fleet:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_fleet.py
 
 # Cross-process compile-cache proof (the committed BENCH_COLDSTART.json;
 # ROADMAP cold-start item): two child processes share one

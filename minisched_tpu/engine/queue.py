@@ -280,6 +280,37 @@ class SchedulingQueue:
         with self._cond:
             self._known.difference_update(keys)
 
+    def release_unwanted(self, wants) -> List[str]:
+        """Fleet shard handoff (engine.release_shards): drop every
+        QUEUED pod ``wants(pod)`` now rejects — the replica lost the
+        pod's shard lease, and the new owner's takeover sweep re-gathers
+        the pod from the store. Only pods HELD by a sub-queue are
+        released; popped/in-flight pods stay known until their commit
+        resolves through the bind fence / store CAS. ``wants`` is a
+        cheap pure predicate (set lookups + a crc32), safe under the
+        lock. Returns the released keys."""
+        out: List[str] = []
+        with self._cond:
+            for key, qpi in list(self._index.items()):
+                try:
+                    if wants(qpi.pod):
+                        continue
+                except Exception:
+                    continue  # a broken filter must not drop pods
+                self._index.pop(key, None)
+                self._known.discard(key)
+                qpi.gone = True
+                if qpi.where == "active":
+                    self._active_live -= 1
+                elif qpi.where == "backoff":
+                    self._backoff_live -= 1
+                elif qpi.where == "shed":
+                    self._shed_live -= 1
+                elif qpi.where == "unsched":
+                    self._unschedulable.pop(key, None)
+                out.append(key)
+        return out
+
     def add_unschedulable(self, qpi: QueuedPodInfo,
                           unschedulable_plugins: Set[str]) -> None:
         """Scheduling attempt failed (reference AddUnschedulable

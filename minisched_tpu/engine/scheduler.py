@@ -134,7 +134,7 @@ class _Supervisor:
                 level=self.level, reason=reason)
         if JOURNAL.enabled:
             s = self._sched
-            jnote("supervisor.escalate", profile=s.profile,
+            jnote("supervisor.escalate", profile=s.profile, replica=s.replica,
                   frm=DEGRADATION_LADDER[self.level - 1],
                   to=DEGRADATION_LADDER[self.level], level=self.level,
                   reason=reason, batch=s._batch_seq,
@@ -159,7 +159,7 @@ class _Supervisor:
                 level=self.level)
         if JOURNAL.enabled:
             jnote("supervisor.early_warning",
-                  profile=self._sched.profile, reason=reason,
+                  profile=self._sched.profile, replica=self._sched.replica, reason=reason,
                   level=self.level, batch=self._sched._batch_seq)
         log.warning("supervisor: SLO early warning (%s); probation "
                     "extended, watchdog pre-armed for %d batches",
@@ -193,7 +193,7 @@ class _Supervisor:
                     to=DEGRADATION_LADDER[self.level], level=self.level)
             if JOURNAL.enabled:
                 jnote("supervisor.recover",
-                      profile=self._sched.profile,
+                      profile=self._sched.profile, replica=self._sched.replica,
                       frm=DEGRADATION_LADDER[self.level + 1],
                       to=DEGRADATION_LADDER[self.level],
                       level=self.level, batch=self._sched._batch_seq)
@@ -1031,7 +1031,8 @@ class Scheduler:
     def __init__(self, store, plugin_set: PluginSet,
                  config: Optional[SchedulerConfig] = None,
                  recorder=None, scheduler_names: Optional[Set[str]] = None,
-                 shared=None, profile: Optional[str] = None):
+                 shared=None, profile: Optional[str] = None,
+                 replica: Optional[str] = None):
         from .clusterstate import SharedClusterState
 
         self.store = store
@@ -1051,6 +1052,23 @@ class Scheduler:
         # constructed engine derives it from its routing set.
         self.profile = profile or (sorted(scheduler_names)[0]
                                    if scheduler_names else "default")
+        # Fleet replica id (fleet/supervisor.py): rides next to the
+        # profile on every journal event and provenance record so a
+        # replicated run's shared surfaces stay attributable per
+        # replica. "" = not a fleet member (solo engine / service).
+        self.replica = replica or ""
+        # Fleet shard ownership: (n_shards, owned frozenset, epoch) read
+        # as ONE tuple on the wants_pod hot path (a single attribute
+        # load — replacement-only, so informer threads never observe a
+        # half-updated pair). n_shards == 0 disables sharding entirely
+        # (the solo default: own every pod).
+        self._shard_view = (0, frozenset(), 0)
+        # Fleet bind fencing: callable(pod_key) -> bool installed by the
+        # fleet supervisor; a False verdict at commit time means this
+        # engine no longer owns the pod's shard — the bind is withheld
+        # and the pod handed back (the new owner's takeover sweep
+        # re-gathers it from the store). None = no fencing (solo).
+        self._bind_guard = None
         # Cluster state (feature cache + informers) is SHARED across the
         # service's profile engines (reference: one scheduler struct,
         # many profiles, scheduler.go:97-142) — a solo engine owns a
@@ -1343,6 +1361,10 @@ class Scheduler:
         self._metrics: Dict[str, float] = {
             "batches": 0, "pods_seen": 0, "pods_assigned": 0,
             "pods_failed": 0, "pods_bound": 0, "bind_conflicts": 0,
+            # Fleet bind fencing: commits withheld because this replica
+            # lost the pod's shard lease between decision and commit
+            # (the pod is handed back; the new owner re-gathers it).
+            "stale_owner_binds": 0,
             "encode_s_total": 0.0, "step_s_total": 0.0,
             "step_dispatch_s_total": 0.0, "commit_s_total": 0.0,
             "gap_s_total": 0.0,
@@ -1564,7 +1586,7 @@ class Scheduler:
                          | (assigned[:L] != ref_assigned[:L])))
         self._sup_count("shortlist_desyncs")
         instant("shortlist.desync", pods=bad)
-        jnote("shortlist.desync", profile=self.profile, pods=bad,
+        jnote("shortlist.desync", profile=self.profile, replica=self.replica, pods=bad,
               batch=inf.seq)
         self._disable_shortlist(
             f"decisions diverged from the full scan on {bad} pod(s)")
@@ -1578,7 +1600,7 @@ class Scheduler:
         stage; sampled steps consult ``_shortlist_k`` per batch."""
         log.error("disabling shortlist-compressed arbitration (%s); "
                   "reverting to the full-width scan", reason)
-        jnote("shortlist.disable", profile=self.profile, reason=reason,
+        jnote("shortlist.disable", profile=self.profile, replica=self.replica, reason=reason,
               batch=self._batch_seq)
         bundle_mod.capture("shortlist_revert", scheduler=self,
                            reason=reason)
@@ -1667,7 +1689,7 @@ class Scheduler:
             idx.pending.clear()
             idx.needs_rebuild = False
             self._sup_count("index_rebuilds")
-            jnote("index.rebuild", profile=self.profile, cause=cause,
+            jnote("index.rebuild", profile=self.profile, replica=self.replica, cause=cause,
                   classes=len(idx.rows), n=n_pad, batch=self._batch_seq)
             inf.scored_rows += c_pad * n_pad
         elif idx.pending:
@@ -1684,7 +1706,7 @@ class Scheduler:
                     idx.state = refresh_fn(idx.state, class_pf, nf, af,
                                            rows_pad)
                 self._sup_count("index_repair_rows", int(rows.size))
-                jnote("index.repair", profile=self.profile,
+                jnote("index.repair", profile=self.profile, replica=self.replica,
                       rows=int(rows.size), batch=self._batch_seq)
                 inf.scored_rows += c_pad * rb
         if act == "corrupt" and idx.state is not None:
@@ -1758,7 +1780,7 @@ class Scheduler:
         # batch — the engine-level repair rung of the ladder.
         self._sup_count("index_fallbacks")
         inf.index_mode = "fallback"
-        jnote("index.fallback", profile=self.profile, batch=inf.seq)
+        jnote("index.fallback", profile=self.profile, replica=self.replica, batch=inf.seq)
         inf.index_free_after = None
         if idx is not None:
             idx.rebuild_streak += 1
@@ -1773,7 +1795,7 @@ class Scheduler:
                 self._sup_count("index_cooldowns")
                 instant("index.cooldown",
                         batches=self._index_cooldown)
-                jnote("index.cooldown", profile=self.profile,
+                jnote("index.cooldown", profile=self.profile, replica=self.replica,
                       batches=self._index_cooldown, batch=inf.seq)
         with span("step.dispatch"):
             decision = self._step(inf.eb, inf.nf, inf.af, inf.key)
@@ -1814,7 +1836,7 @@ class Scheduler:
                          | (assigned[:L] != ref_a[:L])))
         self._sup_count("index_desyncs")
         instant("index.desync", pods=bad)
-        jnote("index.desync", profile=self.profile, pods=bad,
+        jnote("index.desync", profile=self.profile, replica=self.replica, pods=bad,
               batch=inf.seq)
         self._disable_index(
             f"decisions diverged from the full step on {bad} pod(s)")
@@ -1828,7 +1850,7 @@ class Scheduler:
         harmlessly; nothing ever consumes them again."""
         log.error("disabling the maintained arbitration index (%s); "
                   "reverting to the per-batch full step", reason)
-        jnote("index.disable", profile=self.profile, reason=reason,
+        jnote("index.disable", profile=self.profile, replica=self.replica, reason=reason,
               batch=self._batch_seq)
         bundle_mod.capture("index_revert", scheduler=self,
                            reason=reason)
@@ -1979,10 +2001,72 @@ class Scheduler:
         return out
 
     def wants_pod(self, pod: Pod) -> bool:
-        """Does this scheduler's profile handle the pod? (multi-profile
-        routing by spec.scheduler_name)."""
-        return (self.scheduler_names is None
-                or pod.spec.scheduler_name in self.scheduler_names)
+        """Does this scheduler handle the pod? Profile routing by
+        spec.scheduler_name, then — in fleet mode — the deterministic
+        shard filter: the pod's hash shard (fleet/shardmap.py) must be
+        in this replica's owned set. The shard view is one tuple load,
+        so the hot path needs no lock and no store round-trip."""
+        if not (self.scheduler_names is None
+                or pod.spec.scheduler_name in self.scheduler_names):
+            return False
+        n_shards, owned, _epoch = self._shard_view
+        if n_shards:
+            from ..fleet.shardmap import shard_of
+
+            return shard_of(pod.key, n_shards) in owned
+        return True
+
+    # ---- fleet shard ownership (fleet/supervisor.py) --------------------
+
+    @property
+    def shard_view(self):
+        """(n_shards, owned frozenset, epoch) — the fleet ownership
+        view. (0, frozenset(), 0) when sharding is off."""
+        return self._shard_view
+
+    def set_shards(self, owned, n_shards: int, *, epoch: int = 0) -> None:
+        """Atomically replace this replica's owned-shard set. Must be
+        called BEFORE start() for the initial assignment (the informer's
+        initial sync consults wants_pod at delivery); later calls are
+        the takeover/handoff path (adopt_shards / release_shards)."""
+        self._shard_view = (int(n_shards), frozenset(owned), int(epoch))
+
+    def set_bind_guard(self, fn) -> None:
+        """Install the fleet bind fence: ``fn(pod_key) -> bool`` (False
+        = this engine lost the pod's shard; withhold the commit)."""
+        self._bind_guard = fn
+
+    def adopt_shards(self, shards, *, epoch: int = 0,
+                     reason: str = "") -> int:
+        """Live-takeover entry point: extend the owned-shard set and
+        drain the dead owner's pending work — every unbound store pod
+        that now routes here is re-gathered into the active queue (the
+        queue's keyed dedupe skips pods already queued or in flight).
+        Returns the number of pods adopted."""
+        n_shards, owned, _ = self._shard_view
+        self.set_shards(owned | set(shards), n_shards, epoch=epoch)
+        adopted = [p for p in self.store.list("Pod")
+                   if not p.spec.node_name and self.wants_pod(p)]
+        if adopted:
+            self.queue.add_many(adopted)
+        jnote("fleet.adopt", profile=self.profile, replica=self.replica,
+              shards=",".join(str(s) for s in sorted(shards)),
+              epoch=epoch, pods=len(adopted), reason=reason)
+        return len(adopted)
+
+    def release_shards(self, shards, *, epoch: int = 0,
+                       reason: str = "") -> int:
+        """Shard handoff on lease loss: shrink the owned set and drop
+        every QUEUED pod this replica no longer owns (in-flight pods are
+        untouched — their binds resolve through the store CAS / bind
+        fence). Returns the number of pods released."""
+        n_shards, owned, _ = self._shard_view
+        self.set_shards(owned - set(shards), n_shards, epoch=epoch)
+        released = self.queue.release_unwanted(self.wants_pod)
+        jnote("fleet.release", profile=self.profile, replica=self.replica,
+              shards=",".join(str(s) for s in sorted(shards)),
+              epoch=epoch, pods=len(released), reason=reason)
+        return len(released)
 
     # ---- lifecycle ------------------------------------------------------
 
@@ -1993,7 +2077,7 @@ class Scheduler:
         profiles, the SERVICE must construct every engine before starting
         any — a late registration would miss the initial sync."""
         self._shared.ensure_started()
-        jnote("engine.start", profile=self.profile,
+        jnote("engine.start", profile=self.profile, replica=self.replica,
               mode="pipelined" if self.config.pipeline else "sync",
               resident=bool(self._residency is not None),
               shortlist_k=int(self._shortlist_k or 0),
@@ -2298,7 +2382,7 @@ class Scheduler:
                 self._step_counter = anchor  # no decision consumed it
                 for qpi in retry:
                     self.queue.quarantine(qpi)
-                jnote("supervisor.quarantine", profile=self.profile,
+                jnote("supervisor.quarantine", profile=self.profile, replica=self.replica,
                       pods=len(retry), batch=self._batch_seq,
                       step=anchor)
                 bundle_mod.capture(
@@ -2314,14 +2398,14 @@ class Scheduler:
             self._step_counter = anchor  # replay, don't advance
             try:
                 self.schedule_batch(list(retry))
-                jnote("supervisor.retry", profile=self.profile,
+                jnote("supervisor.retry", profile=self.profile, replica=self.replica,
                       outcome="ok",
                       rung=DEGRADATION_LADDER[self._sup.level],
                       pods=len(retry), batch=self._batch_seq,
                       step=anchor)
                 return
             except Exception:
-                jnote("supervisor.retry", profile=self.profile,
+                jnote("supervisor.retry", profile=self.profile, replica=self.replica,
                       outcome="failed",
                       rung=DEGRADATION_LADDER[self._sup.level],
                       pods=len(retry), batch=self._batch_seq,
@@ -2533,7 +2617,7 @@ class Scheduler:
         just invalidated)."""
         self._sup_count("loop_breaks")
         instant("loop.break", reason=reason, slot=slot)
-        jnote("loop.break", profile=self.profile, reason=reason,
+        jnote("loop.break", profile=self.profile, replica=self.replica, reason=reason,
               slot=slot, batch=self._batch_seq)
         res = self._residency
         if res is not None:
@@ -3091,7 +3175,7 @@ class Scheduler:
                             "forcing a full re-upload", e)
                 self._sup_count("residency_desyncs")
                 instant("residency.desync", reason=str(e))
-                jnote("residency.desync", profile=self.profile,
+                jnote("residency.desync", profile=self.profile, replica=self.replica,
                       reason=str(e), batch=self._batch_seq)
                 self._sup.escalate("resident carry desync")
                 carried = False
@@ -3306,7 +3390,7 @@ class Scheduler:
             self._sup_count(f"slo_alerts_{alert['slo']}")
             instant("slo.burn", **{k: v for k, v in alert.items()
                                    if isinstance(v, (int, float, str))})
-            jnote("slo.burn", profile=self.profile,
+            jnote("slo.burn", profile=self.profile, replica=self.replica,
                   batch=self._batch_seq,
                   **{k: v for k, v in alert.items()
                      if isinstance(v, (int, float, str))})
@@ -3314,7 +3398,7 @@ class Scheduler:
             self._sup.early_warning(f"slo:{alert['slo']}")
         for name in self._slo_sentinel.last_cleared:
             instant("slo.clear", slo=name)
-            jnote("slo.clear", profile=self.profile, slo=name,
+            jnote("slo.clear", profile=self.profile, replica=self.replica, slo=name,
                   batch=self._batch_seq)
         if overload_mod.OVERLOAD.enabled:
             self._drive_overload(entry)
@@ -3458,6 +3542,7 @@ class Scheduler:
         batch settles."""
         return {
             "profile": self.profile,
+            "replica": self.replica,
             "batch": inf.seq,
             "step": self._prep_step0 + 1,
             "mode": ("loop" if inf.step_share is not None
@@ -3503,7 +3588,8 @@ class Scheduler:
                     if threading.get_ident() == self._fail_sink_tid
                     else None)
             base = {**path, "pod": qpi.pod.key} if path else {
-                "profile": self.profile, "pod": qpi.pod.key}
+                "profile": self.profile, "replica": self.replica,
+                "pod": qpi.pod.key}
         self._provenance.record(qpi.pod.key, {
             **base, "outcome": "requeued" if retryable else "failed",
             "plugins": sorted(plugins), "message": message[:200],
@@ -3562,7 +3648,7 @@ class Scheduler:
             self._sup_count("watchdog_trips")
             instant("watchdog.trip", window_s=round(step_window, 6),
                     deadline_s=wd)
-            jnote("watchdog.trip", profile=self.profile,
+            jnote("watchdog.trip", profile=self.profile, replica=self.replica,
                   window_s=round(step_window, 6), deadline_s=wd,
                   batch=inf.seq)
             self._sup.escalate(
@@ -5355,7 +5441,43 @@ class Scheduler:
                         **rec, "outcome": "bound",
                         "bound_unix": round(now_w, 3)})
 
+    def _dispose_stale_owner(self, items: List[tuple]) -> None:
+        """Fleet bind fence tripped: this replica lost the shard lease
+        between decision and commit. Withhold the bind — unassume (the
+        capacity bookkeeping must not leak) and forget, WITHOUT
+        requeueing: the pod belongs to the shard's new owner now, whose
+        takeover sweep re-gathers it from the store. A true epoch race
+        (both replicas believe they hold) is still safe without this
+        fence — the store's bind CAS lets exactly one commit win."""
+        for qpi, _node in items:
+            self._unassume(qpi)
+            self.queue.forget(qpi.pod.key)
+        with self._metrics_lock:
+            self._metrics["stale_owner_binds"] += len(items)
+        jnote("fleet.stale_bind", profile=self.profile,
+              replica=self.replica, pods=len(items))
+
+    def _fence_binds(self, items: List[tuple]) -> List[tuple]:
+        """Partition a bind tranche through the fleet bind guard (no-op
+        without one): stale-owner placements are disposed, the rest
+        proceed to the store commit."""
+        guard = self._bind_guard
+        if guard is None:
+            return items
+        live, stale = [], []
+        for it in items:
+            try:
+                ok = guard(it[0].pod.key)
+            except Exception:
+                ok = True  # a broken fence must not drop commits
+            (live if ok else stale).append(it)
+        if stale:
+            self._dispose_stale_owner(stale)
+        return live
+
     def _bind(self, qpi: QueuedPodInfo, node_name: str) -> None:
+        if not self._fence_binds([(qpi, node_name)]):
+            return
         pod = qpi.pod
         try:
             with span("bind.pod"):
@@ -5377,14 +5499,17 @@ class Scheduler:
         never requeued (lost) with their capacity pinned forever. Any
         failure (wire fault on a RemoteStore, injected ``bind`` gate)
         reconciles per pod against store truth instead."""
+        live = items
         try:
-            FAULTS.hit("bind")  # fault gate: bulk binding task
-            with span("bind.bulk", pods=len(items)):
-                self._bind_many_impl(items)
+            live = self._fence_binds(items)
+            if live:
+                FAULTS.hit("bind")  # fault gate: bulk binding task
+                with span("bind.bulk", pods=len(live)):
+                    self._bind_many_impl(live)
         except Exception:
             log.exception("bulk bind task failed; reconciling %d "
-                          "placement(s) against store truth", len(items))
-            self._reconcile_bind_failure(items)
+                          "placement(s) against store truth", len(live))
+            self._reconcile_bind_failure(live)
         finally:
             # The bulk commit concluded for every pod (bound, requeued,
             # or forgotten): release the supervised-retry exclusions.

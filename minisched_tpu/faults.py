@@ -46,6 +46,15 @@ production scheduler's failure domain spans:
                 scribbles the recorded seq field (the internal
                 ordering key stays exact, so a corrupted recorder is
                 observable but can never reorder history).
+    lease       fleet lease heartbeat write  (fleet/lease.py) —
+                ``err`` fails the heartbeat write (the renewal is
+                skipped and counted; miss enough and the lease expires,
+                handing the shard to a peer — the degraded-network
+                failure mode), ``corrupt`` sends the heartbeat with a
+                STALE resource_version so the store's CAS must reject
+                it (a zombie replica writing with an old fencing token;
+                the rejection proves a corrupted lease can never mint
+                two live owners of one shard).
 
 Configured once per process from ``MINISCHED_FAULTS`` (tests reconfigure
 via :func:`configure`), a comma-separated list of ``gate:action@trigger``
@@ -106,12 +115,12 @@ log = logging.getLogger(__name__)
 
 #: The gate catalog; hit() rejects unknown names so a typo in a rule or a
 #: call site cannot silently never fire.
-# "journal" appends LAST: per-gate PRNG streams seed by catalog index,
+# "lease" appends LAST: per-gate PRNG streams seed by catalog index,
 # so appending (never inserting) keeps every existing gate's firing
 # pattern stable under a fixed seed.
 GATES = ("step", "fetch", "residency", "shortlist_repair", "commit",
          "bind", "informer", "http", "checkpoint", "lifecycle",
-         "admission", "index", "journal")
+         "admission", "index", "journal", "lease")
 
 _ACTIONS = ("err", "die", "corrupt", "stall")
 

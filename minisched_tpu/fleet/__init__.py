@@ -1,0 +1,16 @@
+"""Replicated scheduler fleet: shard-owned HA control plane.
+
+The Omega/Borg shape (Schwarzkopf et al., EuroSys 2013; Verma et al.,
+EuroSys 2015): N engine replicas against ONE shared store, pod ownership
+partitioned by a deterministic shard map, every bind still a
+compare-and-swap against store truth — no coordination on the hot path —
+and lease-based failover so a peer claims a dead replica's shards with
+an epoch bump and drains its pending pods.
+
+Import the pieces directly (``fleet.shardmap`` is dependency-free so the
+engine's wants_pod hot path can use it without an import cycle):
+
+    from minisched_tpu.fleet.shardmap import shard_of, lease_name
+    from minisched_tpu.fleet.lease import LeaseManager
+    from minisched_tpu.fleet.supervisor import FleetSupervisor
+"""

@@ -9,18 +9,21 @@ enforces, and ARCHITECTURE.md "Cluster-lifecycle scenario engine".
 from .driver import (AMPLITUDE_ENV, RATE_ENV, SEED_ENV, DisruptionBudget,
                      InvariantViolation, LifecycleDriver, LifecycleEvent,
                      LifecycleView, seed_from_env)
-from .generators import (AutoscalerLoop, Generator, PoissonArrivals,
-                         ReclamationWave, RollingUpgrade, TenantMix)
-from .invariants import (MonotoneVersions, bound_on_live_nodes,
-                         budget_respected, default_invariants, no_overcommit,
-                         no_pod_lost)
+from .generators import (AutoscalerLoop, Generator, KillScheduler,
+                         PoissonArrivals, ReclamationWave, RestartScheduler,
+                         RollingUpgrade, TenantMix)
+from .invariants import (LeaseIntegrity, MonotoneVersions, StableBindings,
+                         bound_on_live_nodes, budget_respected,
+                         default_invariants, no_overcommit, no_pod_lost)
 
 __all__ = [
     "AMPLITUDE_ENV", "RATE_ENV", "SEED_ENV",
     "AutoscalerLoop", "DisruptionBudget", "Generator",
-    "InvariantViolation", "LifecycleDriver", "LifecycleEvent",
+    "InvariantViolation", "KillScheduler", "LeaseIntegrity",
+    "LifecycleDriver", "LifecycleEvent",
     "LifecycleView", "MonotoneVersions", "PoissonArrivals",
-    "ReclamationWave", "RollingUpgrade", "TenantMix",
+    "ReclamationWave", "RestartScheduler", "RollingUpgrade",
+    "StableBindings", "TenantMix",
     "bound_on_live_nodes", "budget_respected", "default_invariants",
     "no_overcommit", "no_pod_lost", "seed_from_env",
 ]

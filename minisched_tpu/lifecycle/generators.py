@@ -30,6 +30,13 @@ Catalog (the trace-study staples):
     per-tenant priorities (sustained exercise for ``PodSpec.priority``
     and the preemption PostFilter) plus the controller-side reconcile
     loop that recreates preempted victims.
+  * :class:`KillScheduler` / :class:`RestartScheduler` — control-plane
+    failure injection for fleet runs (``MINISCHED_FLEET`` ≥ 2 or
+    ``Cluster.start(fleet=N)``): crash one replica mid-workload (its
+    lease is left to EXPIRE — the honest crash model) and optionally
+    bring it back after a downtime window. The failover invariants
+    (no_pod_lost, stable_bindings, lease_integrity) then certify the
+    takeover end-to-end.
 """
 from __future__ import annotations
 
@@ -266,6 +273,64 @@ class RollingUpgrade(Generator):
                 v.count("nodes_upgraded")
             self.budget.release(n)
             yield 1e-3  # hand the clock over between members
+
+
+def _fleet_of(env):
+    """The FleetSupervisor behind this cluster, or None when the run is
+    single-engine (the generators degrade to no-ops so a mix that
+    includes them stays reusable outside fleet mode)."""
+    svc = getattr(env.view.cluster, "service", None)
+    return getattr(svc, "fleet", None) if svc is not None else None
+
+
+class KillScheduler(Generator):
+    """Crash one fleet replica mid-workload. The kill is the CRASH
+    model: the engine stops and the replica forgets its leases locally,
+    but the store's Lease objects are left untouched — a peer may only
+    claim the dead replica's shards after the TTL expires, exactly as a
+    dead process leaves the world. Pods the victim had queued are
+    re-derived from the store by the claimant's takeover sweep, so the
+    no_pod_lost / stable_bindings oracle certifies the failover."""
+
+    def __init__(self, name: str = "kill-sched", *, replica: str = "r1",
+                 after_s: float = 1.0):
+        self.name = name
+        self.replica = replica
+        self.after = float(after_s)
+
+    def run(self, env):
+        yield self.after
+        fleet = _fleet_of(env)
+        if fleet is None:
+            return  # single-engine run: nothing to kill
+        if fleet.kill(self.replica):
+            env.view.count("scheduler_kills")
+
+
+class RestartScheduler(Generator):
+    """Crash one replica, wait out a downtime window, then bring a
+    fresh incarnation back under the same id. The restarted replica
+    rejoins with an EMPTY shard set and re-earns ownership through the
+    lease scan — shards its peers claimed during the outage stay theirs
+    until those leases lapse (no failback storm)."""
+
+    def __init__(self, name: str = "restart-sched", *, replica: str = "r1",
+                 after_s: float = 1.0, downtime_s: float = 2.0):
+        self.name = name
+        self.replica = replica
+        self.after = float(after_s)
+        self.downtime = float(downtime_s)
+
+    def run(self, env):
+        yield self.after
+        fleet = _fleet_of(env)
+        if fleet is None:
+            return
+        if fleet.kill(self.replica):
+            env.view.count("scheduler_kills")
+        yield self.downtime
+        if fleet.restart(self.replica):
+            env.view.count("scheduler_restarts")
 
 
 class TenantMix(Generator):

@@ -30,6 +30,14 @@ The default set:
   * **no_overcommit** — no live node's bound pods exceed its
     allocatable on any axis (the chaos-suite capacity contract, now
     checked continuously instead of at quiescence only).
+  * **stable_bindings** — once a pod incarnation (uid) is bound, its
+    node NEVER changes: the no-double-bind oracle for fleet failover,
+    re-derived from the store every step (a takeover that re-scheduled
+    an already-bound pod would trip it immediately).
+  * **lease_integrity** — shard-lease fencing re-derived from the
+    store: epochs never regress and the holder never changes without an
+    epoch bump (two live owners of one shard would require exactly such
+    a bumpless swap). Vacuously green outside fleet runs.
 """
 from __future__ import annotations
 
@@ -130,6 +138,61 @@ def no_overcommit(view) -> List[str]:
     return viols
 
 
+class StableBindings:
+    """Stateful: remembers every bound pod incarnation's node (keyed by
+    uid so a delete/recreate under the same name is a fresh incarnation,
+    not a rebind) and flags any later observation that shows a DIFFERENT
+    node — the doubly-bound pod a split-brain fleet would produce. The
+    store's bind CAS makes this structurally impossible; this check is
+    the independent oracle that says so from observed truth alone."""
+
+    def __init__(self):
+        self._bound: Dict[str, Tuple[str, str]] = {}  # uid -> (key, node)
+
+    def __call__(self, view) -> List[str]:
+        viols = []
+        for p in view.store.list("Pod"):
+            if not p.spec.node_name:
+                continue
+            prev = self._bound.get(p.metadata.uid)
+            if prev is None:
+                self._bound[p.metadata.uid] = (p.key, p.spec.node_name)
+            elif prev[1] != p.spec.node_name:
+                viols.append(
+                    f"pod {p.key} rebound {prev[1]!r} -> "
+                    f"{p.spec.node_name!r} (double bind)")
+        return viols
+
+
+class LeaseIntegrity:
+    """Stateful: the shard-lease fencing contract re-derived from store
+    truth. Per lease, the epoch is monotone and the holder only changes
+    together with an epoch bump — renewals keep (holder, epoch) fixed,
+    claims/takeovers bump. A bumpless holder swap is exactly the write
+    the CAS exists to forbid. Empty-store (non-fleet) runs are green."""
+
+    def __init__(self):
+        self._seen: Dict[str, Tuple[int, str]] = {}  # name -> (epoch, holder)
+
+    def __call__(self, view) -> List[str]:
+        viols = []
+        for lease in view.store.list("Lease"):
+            last = self._seen.get(lease.key)
+            if last is not None:
+                epoch0, holder0 = last
+                if lease.epoch < epoch0:
+                    viols.append(
+                        f"lease {lease.key} epoch regressed "
+                        f"{lease.epoch} < {epoch0}")
+                elif lease.holder != holder0 and lease.epoch == epoch0:
+                    viols.append(
+                        f"lease {lease.key} holder changed "
+                        f"{holder0!r} -> {lease.holder!r} without an "
+                        f"epoch bump")
+            self._seen[lease.key] = (lease.epoch, lease.holder)
+        return viols
+
+
 def default_invariants(driver):
     """(name, fn) pairs the driver installs by default — the standard
     oracle plus one budget invariant per registered pool budget."""
@@ -138,6 +201,8 @@ def default_invariants(driver):
         ("bound_on_live_nodes", bound_on_live_nodes),
         ("monotone_versions", MonotoneVersions()),
         ("no_overcommit", no_overcommit),
+        ("stable_bindings", StableBindings()),
+        ("lease_integrity", LeaseIntegrity()),
     ]
     for pool, b in sorted(driver.budgets().items()):
         out.append((f"disruption_budget[{pool}]", budget_respected(b)))

@@ -67,11 +67,15 @@ class Cluster:
 
     def start(self, profile: Optional[Profile] = None,
               config: Optional[SchedulerConfig] = None,
-              with_pv_controller: bool = True) -> "Cluster":
+              with_pv_controller: bool = True,
+              fleet: Optional[int] = None) -> "Cluster":
+        """``fleet`` ≥ 2 boots a replicated scheduler fleet (shard
+        leases + takeover, service/_start_fleet) instead of a single
+        engine; None defers to ``MINISCHED_FLEET``."""
         if with_pv_controller:
             self.pv_controller = PVController(self.store)
             self.pv_controller.start()
-        self.service.start_scheduler(profile, config)
+        self.service.start_scheduler(profile, config, fleet=fleet)
         return self
 
     def shutdown(self) -> None:

@@ -46,6 +46,12 @@ class SchedulerService:
         self._multi = False
         self._config: Optional[SchedulerConfig] = None
         self.result_store: Optional[ResultStore] = None
+        # Replicated-fleet mode (fleet/supervisor.py): N engine replicas
+        # with shard leases instead of one engine. Single-profile only —
+        # profiles partition by scheduler_name, shards by pod-key hash;
+        # crossing the two routing schemes is undefined and refused.
+        self._fleet = None
+        self._fleet_n = 0
         # RemoteStore also has a snapshot() (the /snapshot verb), so the
         # duck check must be the checkpointer's ACTUAL surface —
         # resource_version() is the store-local half RemoteStore lacks.
@@ -60,13 +66,25 @@ class SchedulerService:
 
     @property
     def scheduler(self) -> Optional[Scheduler]:
-        """The first (or only) running engine — the single-profile API."""
+        """The first (or only) running engine — the single-profile API.
+        Fleet mode: the first LIVE replica's engine."""
+        if self._fleet is not None:
+            return self._fleet.scheduler
         return next(iter(self._scheds.values()), None)
 
     @property
     def schedulers(self) -> Dict[str, Scheduler]:
-        """Profile name → engine."""
+        """Profile name → engine (fleet mode: replica id → engine,
+        live replicas only — kills/restarts keep this view fresh)."""
+        if self._fleet is not None:
+            return self._fleet.engines()
         return dict(self._scheds)
+
+    @property
+    def fleet(self):
+        """The FleetSupervisor when fleet mode is on, else None (the
+        lifecycle kill/restart generators reach the fleet here)."""
+        return self._fleet
 
     def metrics(self) -> Dict[str, float]:
         """Engine cycle metrics across every profile, flattened for one
@@ -85,6 +103,10 @@ class SchedulerService:
             return {k: v for k, v in m.items()
                     if isinstance(v, (int, float)) and not isinstance(v, bool)}
 
+        if self._fleet is not None:
+            # Fleet: counters summed across live replicas (the
+            # fleet-wide totals), plus lease/takeover gauges.
+            return self._fleet.metrics()
         scheds = self.schedulers
         if not scheds:
             return {}
@@ -105,6 +127,8 @@ class SchedulerService:
         histogram exposition (`_bucket`/`_sum`/`_count`); ``metrics()``
         itself stays ``Dict[str, float]`` (a pinned contract — the flat
         gauges must remain scrape-compatible)."""
+        if self._fleet is not None:
+            return self._fleet.histograms()
         scheds = self.schedulers
         if not scheds:
             return {}
@@ -123,7 +147,7 @@ class SchedulerService:
         overload controller is at/past its HTTP-reject rung supplies
         the typed 429 reason; None admits. With MINISCHED_OVERLOAD
         unset this is a handful of attribute tests per pod create."""
-        for engine in self._scheds.values():
+        for engine in self.schedulers.values():
             reason = engine.overload_reject_reason()
             if reason:
                 return reason
@@ -158,16 +182,21 @@ class SchedulerService:
         """The ``GET /provenance/<pod>`` record
         (``APIServer.provenance_providers`` feed): the first profile
         engine holding a decision-provenance record for the pod answers
-        (profiles share no pods); None = no record."""
-        for engine in self._scheds.values():
+        (profiles share no pods, replicas share no shards); None = no
+        record."""
+        for engine in self.schedulers.values():
             rec = engine.provenance(pod_key)
             if rec is not None:
                 return rec
         return None
 
     def start_scheduler(self, profile: ProfileSpec = None,
-                        config: Optional[SchedulerConfig] = None) -> Scheduler:
-        if self._scheds:
+                        config: Optional[SchedulerConfig] = None,
+                        fleet: Optional[int] = None) -> Scheduler:
+        """``fleet``: run N replicated engines with shard leases instead
+        of one (fleet/supervisor.py); None reads ``MINISCHED_FLEET``
+        (0/1 = off). Fleet mode is single-profile only."""
+        if self._scheds or self._fleet is not None:
             raise RuntimeError("scheduler already running")
         if isinstance(profile, SchedulerConfiguration):
             profiles, self._multi = list(profile.profiles), True
@@ -201,6 +230,17 @@ class SchedulerService:
             # reference's off-hot-path informer-event flush pattern).
             self.result_store = recorder = ResultStore(self._store,
                                                        async_flush=True)
+        from ..fleet.shardmap import fleet_from_env
+
+        n_fleet = int(fleet) if fleet is not None else fleet_from_env()
+        if n_fleet >= 2:
+            if self._multi:
+                raise ValueError(
+                    "fleet mode is single-profile: profiles partition "
+                    "pods by scheduler_name, fleet shards by pod-key "
+                    "hash — one routing scheme at a time")
+            return self._start_fleet(profiles[0], recorder, n_fleet)
+        self._fleet_n = 0
         # Build every PluginSet BEFORE starting any engine so a bad later
         # profile (unknown plugin, bad args) can't leave a half-started
         # service behind.
@@ -255,7 +295,42 @@ class SchedulerService:
         log.info("scheduler started (profiles=%s)", names)
         return self.scheduler
 
+    def _start_fleet(self, p: Profile, recorder, n: int) -> Scheduler:
+        """Replicated-fleet wiring: N engines, each with its OWN private
+        cluster state (informers + feature cache) against the one store
+        — independent optimistic views, races resolved at the store's
+        bind CAS — supervised by a FleetSupervisor driving the shard
+        leases. The checkpointer (when configured) is created first so
+        takeovers can persist post-claim ownership promptly."""
+        from ..fleet.shardmap import shards_from_env
+        from ..fleet.supervisor import FleetSupervisor
+
+        if self._checkpoint_path:
+            from ..state.persistence import Checkpointer
+
+            self._checkpointer = Checkpointer(
+                self._store, self._checkpoint_path,
+                interval_s=self._checkpoint_interval_s)
+
+        def factory(rid: str, _p=p, _rec=recorder) -> Scheduler:
+            return Scheduler(self._store, _p.build(), self._config,
+                             recorder=_rec, profile=_p.name, replica=rid)
+
+        self._fleet = FleetSupervisor(
+            self._store, engine_factory=factory, replicas=n,
+            n_shards=shards_from_env(n),
+            checkpointer=self._checkpointer)
+        self._fleet_n = n
+        self._fleet.start()
+        log.info("scheduler fleet started (%d replicas, profile=%s, "
+                 "%d shards)", n, p.name, self._fleet.n_shards)
+        return self.scheduler
+
     def shutdown_scheduler(self) -> None:
+        if self._fleet is not None:
+            self._fleet.shutdown()
+            self._fleet = None
+            log.info("scheduler fleet shut down")
         for name, sched in list(self._scheds.items()):
             sched.shutdown()
             log.info("scheduler %s shut down", name)
@@ -275,11 +350,12 @@ class SchedulerService:
         RestartScheduler scheduler.go:40-47). Queue/cache state is rebuilt
         from surviving store state, same as the reference."""
         profiles, config, multi = self._profiles, self._config, self._multi
+        fleet_n = self._fleet_n
         self.shutdown_scheduler()
         self._profiles, self._config = [], None
         spec: ProfileSpec = profiles if multi else (profiles[0] if profiles
                                                     else None)
-        return self.start_scheduler(spec, config)
+        return self.start_scheduler(spec, config, fleet=fleet_n or None)
 
     def get_scheduler_profile(self) -> Optional[Profile]:
         """reference GetSchedulerConfig (scheduler.go:89-91)."""

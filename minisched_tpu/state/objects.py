@@ -445,6 +445,32 @@ class PodDisruptionBudget:
         return f"{self.metadata.namespace}/{self.metadata.name}"
 
 
+@dataclass
+class Lease:
+    """Shard-ownership lease (the coordination.k8s.io/v1 Lease shape
+    applied to scheduler-fleet shards, fleet/lease.py): ``holder`` is
+    the replica id currently serving the shard, ``epoch`` is the fencing
+    token — bumped by CAS on every ownership CHANGE, never on renewal —
+    and ``renewed_at`` + ``ttl_s`` define expiry. All ownership writes go
+    through the store's optimistic-concurrency update (resource_version
+    CAS), so two claimants can never both win an epoch: the loser's
+    write raises Conflict and it re-reads the new truth."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder: str = ""  # replica id, "" = unheld
+    epoch: int = 0  # fencing token: bumped on every ownership change
+    ttl_s: float = 3.0
+    renewed_at: float = 0.0  # holder's time.monotonic() heartbeat stamp
+    shard: int = 0
+
+    @property
+    def key(self) -> str:
+        return self.metadata.name
+
+    def expired(self, now: float) -> bool:
+        return not self.holder or now - self.renewed_at > self.ttl_s
+
+
 KIND_OF = {
     Pod: "Pod",
     Node: "Node",
@@ -452,11 +478,12 @@ KIND_OF = {
     PersistentVolumeClaim: "PersistentVolumeClaim",
     Event: "Event",
     PodDisruptionBudget: "PodDisruptionBudget",
+    Lease: "Lease",
 }
 
 NAMESPACED = {"Pod": True, "Node": False, "PersistentVolume": False,
               "PersistentVolumeClaim": True, "Event": True,
-              "PodDisruptionBudget": True}
+              "PodDisruptionBudget": True, "Lease": False}
 
 
 def kind_of(obj: Any) -> str:

@@ -124,7 +124,7 @@ class ClusterStore:
     """Thread-safe typed object store with versioned watch log."""
 
     KINDS = ("Pod", "Node", "PersistentVolume", "PersistentVolumeClaim",
-             "Event", "PodDisruptionBudget")
+             "Event", "PodDisruptionBudget", "Lease")
 
     def __init__(self, max_log: int = 100_000):
         self._cond = threading.Condition()

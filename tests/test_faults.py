@@ -743,6 +743,94 @@ def test_journal_fault_never_touches_decisions(registry):
         journal_mod.configure("")
 
 
+# ---- lease gate (fleet/lease.py) -----------------------------------------
+
+
+def test_lease_gate_err_drops_heartbeat(registry):
+    """``lease:err`` drops the renewal before it reaches the store —
+    counted, journaled, and the store's ``renewed_at`` stamp unmoved.
+    Miss enough in a row and the lease expires under a live holder: the
+    degraded-network failure mode the takeover scan is built for."""
+    from minisched_tpu.fleet.lease import LeaseManager
+
+    store = ClusterStore()
+    clk = [0.0]
+    mgr = LeaseManager(store, "rA", ttl_s=10.0, clock=lambda: clk[0])
+    assert mgr.try_acquire(0)
+    stamp = store.get("Lease", "shard-0").renewed_at
+    _configure("lease:err@1")
+    clk[0] = 1.0
+    assert mgr.renew(0) is False
+    assert mgr.counters["heartbeats_dropped"] == 1
+    # The write never left the replica: store truth is untouched.
+    assert store.get("Lease", "shard-0").renewed_at == stamp
+    assert mgr.holds(0)  # a dropped heartbeat is not a loss
+    # Gate consumed (nth-form): the next heartbeat lands cleanly.
+    assert mgr.renew(0) is True
+    assert store.get("Lease", "shard-0").renewed_at == 1.0
+
+
+def test_lease_gate_corrupt_stale_heartbeat_loses_cas(registry):
+    """``lease:corrupt`` sends the heartbeat with a REWOUND
+    resource_version — the store CAS must reject it by construction.
+    The rejection is counted and store truth (holder, epoch, stamp)
+    stays exactly as the last honest write left it."""
+    from minisched_tpu.fleet.lease import LeaseManager
+
+    store = ClusterStore()
+    clk = [0.0]
+    mgr = LeaseManager(store, "rA", ttl_s=10.0, clock=lambda: clk[0])
+    assert mgr.try_acquire(0)
+    before = store.get("Lease", "shard-0")
+    _configure("lease:corrupt@1")
+    clk[0] = 1.0
+    assert mgr.renew(0) is False
+    assert mgr.counters["stale_heartbeats_rejected"] == 1
+    after = store.get("Lease", "shard-0")
+    assert (after.holder, after.epoch, after.renewed_at) == \
+        ("rA", before.epoch, before.renewed_at)
+    # The replica itself is undecided, not deposed: the next CLEAN
+    # renewal re-reads store truth and recommits honestly.
+    assert mgr.renew(0) is True
+    assert store.get("Lease", "shard-0").renewed_at == 1.0
+
+
+def test_corrupted_lease_cannot_mint_two_owners(registry):
+    """Containment: a zombie holder whose every heartbeat is corrupt can
+    never keep its shard against a live peer, and at NO point does the
+    store name two owners or let the epoch move without a CAS win. The
+    zombie window (both replicas locally believing they hold) is real —
+    and exactly what the epoch fence + bind CAS make harmless — but
+    store truth is singular throughout."""
+    from minisched_tpu.fleet.lease import LeaseManager
+
+    store = ClusterStore()
+    clk = [0.0]
+    zombie = LeaseManager(store, "rZ", ttl_s=1.0, clock=lambda: clk[0])
+    peer = LeaseManager(store, "rP", ttl_s=1.0, clock=lambda: clk[0])
+    assert zombie.try_acquire(0)
+    # Every zombie heartbeat from here on is a stale-rv write.
+    _configure("lease:corrupt@1,lease:corrupt@2,lease:corrupt@3")
+    clk[0] = 0.5
+    assert zombie.renew(0) is False  # rejected; lease ages on
+    assert store.get("Lease", "shard-0").renewed_at == 0.0
+    clk[0] = 1.5  # past TTL: the un-renewed lease is now expired
+    assert peer.try_acquire(0)  # honest claim, epoch 1 -> 2
+    truth = store.get("Lease", "shard-0")
+    assert (truth.holder, truth.epoch) == ("rP", 2)
+    # Zombie window: both hold locally, but store truth is singular and
+    # the zombie's next heartbeat — corrupt or not — discovers the
+    # supersession BEFORE it could write anything.
+    assert zombie.holds(0) and peer.holds(0)
+    assert zombie.renew(0) is False
+    assert not zombie.holds(0)  # deposed: lease.lose journaled
+    assert zombie.counters["losses"] == 1
+    truth = store.get("Lease", "shard-0")
+    assert (truth.holder, truth.epoch) == ("rP", 2)
+    # Epochs only ever moved through CAS wins: 1 (create) -> 2 (claim).
+    assert peer.epoch_of(0) == 2 and zombie.epoch_of(0) == 0
+
+
 # ---- whole-suite coverage ------------------------------------------------
 
 

@@ -49,7 +49,8 @@ _RECOVERY_KINDS = ("supervisor.recover", "overload.recover",
 #: Kinds that belong to a chain between its fault root and recovery.
 _CHAIN_PREFIXES = ("supervisor.", "overload.", "index.", "shortlist.",
                    "residency.", "loop.", "watchdog.", "slo.",
-                   "queue.", "bundle.", "invariant.")
+                   "queue.", "bundle.", "invariant.", "lease.",
+                   "fleet.")
 
 
 def validate_journal(events: List[dict]) -> None:
@@ -177,6 +178,15 @@ def _fmt_event(ev: dict) -> str:
     kind = ev.get("kind", "?")
     detail = ev.get("to") or ev.get("outcome") or ev.get("reason") \
         or ev.get("slo") or ev.get("gate") or ev.get("cause") or ""
+    if kind.startswith(("lease.", "fleet.")):
+        # Fleet events read as WHO did WHAT: takeover names the dead
+        # peer and the claiming epoch; others name the acting replica.
+        who = ev.get("replica", "")
+        frm = ev.get("frm", "")
+        if kind == "lease.takeover" and frm:
+            detail = f"{who}<-{frm}@e{ev.get('epoch', '?')}"
+        elif who:
+            detail = f"{who}" + (f": {detail}" if detail else "")
     return f"{kind}({detail})" if detail else kind
 
 
