@@ -14,12 +14,13 @@ SHELL := /bin/bash
 
 .PHONY: test tier1 fault-smoke shortlist-smoke trace-smoke slo-smoke \
         churn-smoke overload-smoke loop-smoke index-smoke journal-smoke \
-        fleet-smoke profile-smoke start start-remote start-client-engine \
+        fleet-smoke tenant-smoke profile-smoke start start-remote \
+        start-client-engine \
         demo docs \
         bench bench_sharded bench-cpu bench-pipeline bench-residency \
         bench-shortlist bench-trace bench-slo bench-churn bench-overload \
         bench-deviceloop bench-index bench-coldstart bench-journal \
-        bench-fleet \
+        bench-fleet bench-tenants \
         bench-check dryrun dryrun-dcn soak soak-faults soak-churn \
         soak-overload
 
@@ -130,6 +131,20 @@ fleet-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py -x -q \
 	  -p no:cacheprovider -p no:randomly
 
+# Fast deterministic fused multi-tenant suite (~60 s): per-tenant
+# placements bit-identical between the fused coordinator and the
+# sequential baseline in every engine config (sync/pipelined/upload/
+# index), ragged tenant batches harmonized by masked-row padding,
+# mid-tranche delta races falling back solo and counted, fair-share
+# slot apportionment never starving a tenant, provenance/journal
+# attribution never crossing tenants, and the profile-scoped shed
+# budget holding under a one-tenant overload burst. A tier-1
+# prerequisite after fleet-smoke: the mux rides the same dispatch seam
+# the fleet's shard engines do.
+tenant-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tenants.py -x -q \
+	  -p no:cacheprovider -p no:randomly
+
 # The EXACT ROADMAP tier-1 verify command (dots count + exit code
 # preserved) — what the driver runs after every PR; run it locally
 # before shipping. shortlist-smoke runs first: the arbitration
@@ -143,9 +158,11 @@ fleet-smoke:
 # must never change a decision either); journal-smoke after index-smoke
 # (the black-box recorder hooks every layer above and must never change
 # a decision); fleet-smoke after journal-smoke (lease takeovers journal
-# their provenance through the recorder).
+# their provenance through the recorder); tenant-smoke after
+# fleet-smoke (the fused-tenant mux must never change a decision
+# either).
 tier1: shortlist-smoke trace-smoke slo-smoke overload-smoke loop-smoke \
-       index-smoke journal-smoke fleet-smoke churn-smoke
+       index-smoke journal-smoke fleet-smoke tenant-smoke churn-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -287,6 +304,7 @@ bench-check:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_coldstart.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_journal.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_fleet.py --check
+	JAX_PLATFORMS=cpu $(PY) tools/bench_tenants.py --check
 
 # Persistent device-loop before/after (the committed
 # BENCH_DEVICELOOP.json): interleaved off/on min-of-4 rounds of the
@@ -336,6 +354,18 @@ bench-journal:
 # them.
 bench-fleet:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_fleet.py
+
+# Fused multi-tenant before/after (the committed BENCH_TENANTS.json):
+# interleaved sequential/fused min-of-4 rounds of T=8 small virtual
+# clusters — step dispatches per served tenant batch down ≥5× (one
+# vmapped tranche serves the whole compat group; mid-tranche races fall
+# back solo, counted), every paired placement bit-identical PER TENANT,
+# a journal-armed probe proving zero cross-tenant provenance leakage,
+# and a one-tenant overload burst held by the profile-scoped shed
+# budget. Stable keys append to BENCH_LEDGER.json (source
+# bench-tenants) so `make bench-check` gates them.
+bench-tenants:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_tenants.py
 
 # Cross-process compile-cache proof (the committed BENCH_COLDSTART.json;
 # ROADMAP cold-start item): two child processes share one

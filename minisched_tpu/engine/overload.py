@@ -52,6 +52,12 @@ burn verdicts over the snapshot ring):
     MINISCHED_OVERLOAD=1                       default knobs
     MINISCHED_OVERLOAD="shed_priority=500,min_batch=16,hold=2,
                         probation=2,brownout_pct=50"
+    MINISCHED_OVERLOAD="shed_priority=0;noisy:shed_priority=500"
+                                               per-tenant shed budget:
+                                               the ``noisy`` profile's
+                                               engine sheds below 500
+                                               while every other tenant
+                                               keeps the base threshold
 
 Unset (the default), every hook is a single attribute test and
 decisions are bit-identical to an engine without this module —
@@ -69,7 +75,8 @@ from ..obs.journal import note as jnote
 from ..obs.timeseries import TIMELINE
 
 __all__ = ["OVERLOAD", "OVERLOAD_LADDER", "OverloadConfig",
-           "OverloadController", "configure", "parse_spec"]
+           "OverloadController", "configure", "parse_spec",
+           "parse_spec_overrides"]
 
 #: The actuation ladder, calm first. ``OverloadController.level``
 #: indexes it; each level includes every shallower level's actuation.
@@ -122,14 +129,55 @@ _KNOBS = {
 
 
 def parse_spec(spec: str) -> Dict[str, float]:
-    """``MINISCHED_OVERLOAD`` grammar → knob dict. ``"1"`` = defaults;
-    otherwise comma-separated ``name=value`` pairs over the knob
-    catalog. Raises ValueError on junk — a silently-ignored overload
-    spec would defeat the knob."""
+    """``MINISCHED_OVERLOAD`` grammar → knob dict (the process-wide
+    knobs; per-profile override segments are validated but returned by
+    :func:`parse_spec_overrides`). Raises ValueError on junk — a
+    silently-ignored overload spec would defeat the knob."""
+    return parse_spec_overrides(spec)[0]
+
+
+def parse_spec_overrides(spec: str) -> tuple:
+    """Full ``MINISCHED_OVERLOAD`` grammar → (knobs, shed_overrides).
+
+    Segments split on ``;``. The FIRST segment is the process-wide knob
+    spec (``"1"`` = defaults; otherwise comma-separated ``name=value``
+    pairs over the knob catalog). Every LATER segment is a per-profile
+    shed-budget override, ``profile:shed_priority=N`` — that profile's
+    engine sheds below N while the rest keep the base threshold, so one
+    noisy tenant browns out alone (ISSUE 16 satellite):
+
+        MINISCHED_OVERLOAD="shed_priority=0,hold=1;noisy:shed_priority=500"
+    """
     out = {k: float(v) for k, v in _KNOBS.items()}
     spec = (spec or "").strip()
-    if spec and spec != "1":
-        for part in spec.split(","):
+    segments = spec.split(";")
+    base = segments[0].strip()
+    overrides: Dict[str, int] = {}
+    for seg in segments[1:]:
+        seg = seg.strip()
+        if not seg:
+            continue
+        try:
+            prof, term = seg.split(":", 1)
+            name, val = term.split("=", 1)
+            prof, name, fval = prof.strip(), name.strip(), float(val)
+        except ValueError:
+            raise ValueError(
+                f"bad per-profile overload term {seg!r} "
+                "(want profile:shed_priority=N)")
+        if not prof:
+            raise ValueError(
+                f"empty profile name in overload term {seg!r}")
+        if name != "shed_priority":
+            # shed_priority is the only per-profile knob: the ladder
+            # state machine is per engine already, and the remaining
+            # knobs shape process-wide machinery (windows, sentinel).
+            raise ValueError(
+                f"unknown per-profile overload knob {name!r} "
+                "(only shed_priority may be set per profile)")
+        overrides[prof] = int(fval)
+    if base and base != "1":
+        for part in base.split(","):
             part = part.strip()
             if not part:
                 continue
@@ -159,7 +207,7 @@ def parse_spec(spec: str) -> Dict[str, float]:
                     f"brownout_pct={fval} outside (0, 100) — 100 would "
                     "make the brownout rung a no-op")
             out[name] = fval
-    return out
+    return out, overrides
 
 
 class OverloadConfig:
@@ -177,12 +225,18 @@ class OverloadConfig:
         self.configure(spec)
 
     def configure(self, spec: str) -> None:
-        knobs = parse_spec(spec) if spec else {
-            k: float(v) for k, v in _KNOBS.items()}
+        if spec:
+            knobs, shed_overrides = parse_spec_overrides(spec)
+        else:
+            knobs = {k: float(v) for k, v in _KNOBS.items()}
+            shed_overrides = {}
         with self._lock:
             self.epoch += 1
             self.spec = spec or ""
             self.shed_priority = int(knobs["shed_priority"])
+            # Per-profile shed budgets (profile name → priority
+            # threshold): an engine whose name is absent keeps the base.
+            self.shed_overrides = dict(shed_overrides)
             self.min_batch = int(knobs["min_batch"])
             self.hold = int(knobs["hold"])
             self.probation = int(knobs["probation"])
@@ -230,6 +284,14 @@ class OverloadConfig:
                     and not os.environ.get("MINISCHED_SLO", "")):
                 slo_mod.SLO.configure("")
             self._armed_slo = False
+
+    def shed_priority_for(self, name: str) -> int:
+        """The shed-budget threshold for one engine's profile name —
+        the per-profile override when present, else the base knob
+        (ISSUE 16 per-tenant shed budgets). Read on informer threads;
+        both attributes are replaced under configure's lock, so worst
+        case is one stale epoch, never a torn value."""
+        return self.shed_overrides.get(name, self.shed_priority)
 
 
 def _from_env() -> OverloadConfig:
@@ -505,7 +567,11 @@ class OverloadController:
             return True
         if self._gates_idle_open():
             return True
-        return pod.spec.priority >= OVERLOAD.shed_priority
+        # Per-profile shed budget: this controller's name (the engine's
+        # serving profile) selects its own threshold, so one noisy
+        # tenant's override sheds that tenant alone while every quiet
+        # tenant's gate keeps the base budget.
+        return pod.spec.priority >= OVERLOAD.shed_priority_for(self.name)
 
     def explain_skip(self) -> bool:
         """Brownout quality shed: pause explain-result ingestion

@@ -48,6 +48,80 @@ BATCH_CAPACITY = "BatchCapacity"
 COSCHEDULING = "Coscheduling"
 
 
+def weighted_gather(demands: List[int], weights: List[float],
+                    capacity: int) -> List[int]:
+    """Weighted fair batch formation across tenants: split ``capacity``
+    batch slots over tenants in proportion to ``weights``, never granting
+    a tenant more than its ``demands`` (pending pods) — the fused-slot
+    apportionment that keeps one hot tenant from starving the rest
+    (ISSUE 16 fairness gather).
+
+    Largest-remainder apportionment with demand caps, iterated: each
+    round splits the remaining capacity over the still-unmet tenants by
+    weight (floor of the ideal share, capped by unmet demand), then
+    hands out any whole slots the flooring stranded one at a time in
+    descending fractional-remainder order (ties broken by tenant index
+    — deterministic). Capacity a capped tenant cannot use rolls over to
+    the others in the next round, so the result saturates: either every
+    tenant's demand is fully met or every slot is granted.
+
+    Properties (pinned by tests/test_tenants.py): sum(alloc) <=
+    capacity; alloc[i] <= demands[i]; sum(alloc) == min(capacity,
+    sum(demands)) when all live weights > 0; zero-weight tenants are
+    granted only what zero competition leaves behind (nothing, unless
+    every weighted tenant's demand is already met)."""
+    t = len(demands)
+    alloc = [0] * t
+    if capacity <= 0 or t == 0:
+        return alloc
+    remaining = capacity
+
+    def _round(eligible) -> bool:
+        nonlocal remaining
+        live = [i for i in eligible
+                if alloc[i] < demands[i]]
+        if not live or remaining <= 0:
+            return False
+        total_w = sum(weights[i] for i in live)
+        if total_w <= 0:
+            # Equal-weight split among the (all-zero-weight) survivors.
+            shares = [(i, remaining / len(live)) for i in live]
+        else:
+            shares = [(i, remaining * weights[i] / total_w) for i in live]
+        granted = 0
+        fracs = []
+        for i, ideal in shares:
+            want = demands[i] - alloc[i]
+            g = min(int(ideal), want)
+            alloc[i] += g
+            granted += g
+            fracs.append((ideal - int(ideal), i, want - g))
+        remaining -= granted
+        if granted == 0 and remaining > 0:
+            # Flooring stranded every slot: hand out single units in
+            # descending fractional-remainder order (index-ascending on
+            # ties) to tenants with unmet demand.
+            for _frac, i, headroom in sorted(
+                    fracs, key=lambda e: (-e[0], e[1])):
+                if remaining <= 0:
+                    break
+                if headroom > 0:
+                    alloc[i] += 1
+                    remaining -= 1
+                    granted += 1
+        return granted > 0
+
+    weighted = [i for i in range(t) if weights[i] > 0]
+    while _round(weighted):
+        pass
+    # Whatever the weighted tenants could not absorb goes to zero-weight
+    # tenants (weight 0 = "no guaranteed share", not "never served").
+    zeroed = [i for i in range(t) if weights[i] <= 0]
+    while _round(zeroed):
+        pass
+    return alloc
+
+
 @dataclass
 class QueuedPodInfo:
     """reference framework.QueuedPodInfo: pod + queue bookkeeping."""
@@ -571,6 +645,15 @@ class SchedulingQueue:
     def unschedulable_keys(self) -> Set[str]:
         with self._cond:
             return set(self._unschedulable)
+
+    def pending_count(self) -> int:
+        """Pods poppable RIGHT NOW (live activeQ entries) — the demand
+        signal the tenant fusion coordinator feeds ``weighted_gather``.
+        Backoff/shed/unschedulable parks are excluded: they are not
+        servable this round, and counting them would grant a tenant
+        batch slots it cannot fill (slots the gather exists to share)."""
+        with self._cond:
+            return self._active_live
 
     # ---- internals ------------------------------------------------------
 

@@ -215,7 +215,7 @@ class _InflightBatch:
                  "h2d0", "fetch0", "h2d1", "fetch1", "sl_repairs", "gap",
                  "step_share", "index_packed_dev", "index_free_after",
                  "index_served", "scored_rows", "loop_slot",
-                 "index_mode")
+                 "index_mode", "tenant_ticket")
 
     def __init__(self):
         self.failures: List[tuple] = []  # (qpi, plugins, message, retryable)
@@ -278,6 +278,12 @@ class _InflightBatch:
         # the maintained index treated it ("off" | "hit" | "fallback").
         self.loop_slot: Optional[int] = None
         self.index_mode = "off"
+        # Fused multi-tenant lane ticket (encode/cache.TenantCacheMux):
+        # non-None between the prepare-phase submit and the mux's fused
+        # dispatch, which fills packed_dev/index_free_after and clears
+        # it. A lane must never reach resolve with the ticket still
+        # armed — the resolve phase guards it.
+        self.tenant_ticket = None
 
 
 # Fuse the per-pod step outputs into one (6+F, P) i32 array so the
@@ -539,8 +545,9 @@ class _ArbIndex:
 
     __slots__ = ("listener", "k_base", "k_target", "n_built",
                  "c_max", "registry", "rows", "reg_version", "state",
-                 "pending", "pending_inval", "inval_seen", "needs_rebuild",
-                 "rebuild_streak", "drain_version", "_stack_memo")
+                 "pending", "fresh_rows", "pending_inval", "inval_seen",
+                 "needs_rebuild", "rebuild_streak", "drain_version",
+                 "_stack_memo")
 
     def __init__(self, listener, k: int, c_max: int):
         self.listener = listener
@@ -553,6 +560,7 @@ class _ArbIndex:
         self.reg_version = 0
         self.state = None                      # ops.index.IndexState
         self.pending: Set[int] = set()         # node rows awaiting rescore
+        self.fresh_rows: List[int] = []        # class rows awaiting append
         self.pending_inval = 0   # listener.inval at the LAST drain
         self.inval_seen = -1     # listener.inval the live state covers
         self.needs_rebuild = True
@@ -591,7 +599,6 @@ class _ArbIndex:
             for f in pf._fields]
         blob = np.concatenate(mats, axis=1)
         cls = np.empty(length, dtype=np.int32)
-        fresh = False
         for i in range(length):
             key = blob[i].tobytes()
             row = self.registry.get(key)
@@ -603,10 +610,12 @@ class _ArbIndex:
                 self.rows.append({f: np.copy(getattr(pf, f)[i])
                                   for f in pf._fields})
                 self.reg_version += 1
-                fresh = True
+                # A fresh class no longer forces the O(C·N) rebuild:
+                # its row is APPENDED incrementally (ops/index.append)
+                # unless the registry crossed the class-pad bucket —
+                # _index_dispatch decides, this just records the debt.
+                self.fresh_rows.append(row)
             cls[i] = row
-        if fresh:
-            self.needs_rebuild = True
         return cls
 
     def class_pf(self, template):
@@ -1292,6 +1301,15 @@ class Scheduler:
         # index for probation_batches resolved batches.
         self._index_cooldown = 0
         self._idx_check_tick = 0
+        # Fused multi-tenant arbitration (MINISCHED_TENANTS_FUSE;
+        # encode/cache.TenantCacheMux): installed by the service's
+        # fusion coordinator on each tenant engine it serves. When
+        # armed, a fusable batch's prepare SUBMITS its fully-staged
+        # step inputs to the mux instead of dispatching, and the
+        # coordinator's one vmapped dispatch per tranche fills the
+        # lane's decision planes before resolve. None = solo engine
+        # (every existing path, bit-identical).
+        self._tenant_mux = None
         # Compile-cache bootstrap (MINISCHED_COMPILE_CACHE; ROADMAP
         # cold-start item, first slice): arm jax's persistent
         # compilation cache BEFORE the first step compile so restarts
@@ -1463,7 +1481,21 @@ class Scheduler:
             "index_uncertified": 0, "index_checks": 0,
             "index_desyncs": 0, "index_cooldowns": 0,
             "index_races": 0,
+            # index_appends counts fresh CLASS ROWS evaluated by the
+            # incremental per-class ADD (ops/index.append) — each one
+            # an O(N) row insert that replaces an O(C·N) rebuild.
+            "index_appends": 0,
             "scored_rows_total": 0, "last_scored_rows": 0,
+            # Fused multi-tenant arbitration (MINISCHED_TENANTS_FUSE):
+            # tenant_fused_lanes counts batches this engine served as
+            # one LANE of a fused tenant dispatch (the coordinator's
+            # mux books the single dispatch/fetch per tranche on its
+            # own counters); tenant_solo_fallbacks counts fusion-
+            # submitted batches re-dispatched solo — bit-identically —
+            # after a mid-tranche cache mutation raced the collect
+            # window; tenant_races counts those races.
+            "tenant_fused_lanes": 0, "tenant_solo_fallbacks": 0,
+            "tenant_races": 0,
         }
         # Rolling time-series ring of metrics() snapshots
         # (MINISCHED_TIMELINE; obs/timeseries.py). The tracker always
@@ -1488,7 +1520,10 @@ class Scheduler:
         # process-wide enabled flag or the controller's level, so the
         # disarmed hot-path cost is one attribute/int test and
         # decisions stay bit-identical (tests/test_overload.py).
-        self._overload = overload_mod.OverloadController()
+        # Named for the serving profile so per-tenant shed_priority
+        # overrides (MINISCHED_OVERLOAD ...;profile:shed_priority=N)
+        # resolve against THIS engine's tenant.
+        self._overload = overload_mod.OverloadController(name=self.profile)
         # Base shortlist width the tuner retunes around; a permanent
         # certification revert (_disable_shortlist → None) wins over
         # any tuner target. Revisited widths cost no recompile:
@@ -1665,50 +1700,80 @@ class Scheduler:
         rebuild = (idx.state is None or idx.needs_rebuild
                    or idx.pending_inval != idx.inval_seen
                    or idx.n_built != n_pad)
-        build_fn, refresh_fn, assign_fn = build_index_ops(
+        build_fn, refresh_fn, append_fn, assign_fn = build_index_ops(
             self.plugin_set, k_eff, cfg=self.cache.cfg)
         class_pf = idx.class_pf(eb.pf)
         c_pad = int(class_pf.valid.shape[0])
+        if (not rebuild and idx.fresh_rows
+                and c_pad != int(idx.state.score.shape[0])):
+            # Fresh classes crossed the class-pad bucket: the maintained
+            # (C,N) matrix cannot hold the appended rows in place — the
+            # ONE fresh-class case that still pays the full rebuild.
+            rebuild = True
         if rebuild:
             # Cause precedence: a moved inval epoch wins (the widening
             # mutation forced this rebuild regardless of what else is
             # pending); a never-built index (n_built sentinel) is cold;
             # a dropped state with a prior build is an explicit
-            # invalidate() (residency desync / attach error); then pad
-            # growth; else the classify() fresh-class path.
+            # invalidate() (residency desync / attach error); then
+            # node-pad growth; else the class-pad growth above (an
+            # IN-BUCKET fresh class appends instead — index_appends).
             cause = ("widening-invalidation"
                      if idx.pending_inval != idx.inval_seen
                      else "cold" if idx.n_built == -1
                      else "invalidated" if idx.state is None
                      else "node-pad" if idx.n_built != n_pad
-                     else "fresh-classes")
+                     else "class-pad")
             with span("index.build", classes=len(idx.rows), n=n_pad):
                 idx.state = build_fn(class_pf, nf, af)
             idx.n_built = n_pad
             idx.inval_seen = idx.pending_inval
             idx.pending.clear()
+            idx.fresh_rows.clear()
             idx.needs_rebuild = False
             self._sup_count("index_rebuilds")
             jnote("index.rebuild", profile=self.profile, replica=self.replica, cause=cause,
                   classes=len(idx.rows), n=n_pad, batch=self._batch_seq)
             inf.scored_rows += c_pad * n_pad
-        elif idx.pending:
-            rows = np.fromiter(idx.pending, dtype=np.int64,
-                               count=len(idx.pending))
-            rows.sort()
-            rows = rows[rows < n_pad]  # node-pad growth forces rebuild
-            idx.pending.clear()
-            if rows.size:
-                rb = bucket_for(int(rows.size), 16)
-                rows_pad = np.full((rb,), n_pad, dtype=np.int32)
-                rows_pad[:rows.size] = rows
-                with span("index.refresh", rows=int(rows.size)):
-                    idx.state = refresh_fn(idx.state, class_pf, nf, af,
-                                           rows_pad)
-                self._sup_count("index_repair_rows", int(rows.size))
-                jnote("index.repair", profile=self.profile, replica=self.replica,
-                      rows=int(rows.size), batch=self._batch_seq)
-                inf.scored_rows += c_pad * rb
+        else:
+            if idx.pending:
+                rows = np.fromiter(idx.pending, dtype=np.int64,
+                                   count=len(idx.pending))
+                rows.sort()
+                rows = rows[rows < n_pad]  # pad growth forces rebuild
+                idx.pending.clear()
+                if rows.size:
+                    rb = bucket_for(int(rows.size), 16)
+                    rows_pad = np.full((rb,), n_pad, dtype=np.int32)
+                    rows_pad[:rows.size] = rows
+                    with span("index.refresh", rows=int(rows.size)):
+                        idx.state = refresh_fn(idx.state, class_pf, nf,
+                                               af, rows_pad)
+                    self._sup_count("index_repair_rows", int(rows.size))
+                    jnote("index.repair", profile=self.profile, replica=self.replica,
+                          rows=int(rows.size), batch=self._batch_seq)
+                    inf.scored_rows += c_pad * rb
+            if idx.fresh_rows:
+                # Incremental per-class ADD (the ROADMAP's named cheap
+                # win): evaluate only the fresh class rows over the
+                # full node axis and scatter them in — the refresh
+                # above (if any) already brought every PRE-EXISTING
+                # row's changed columns to current truth, and a fresh
+                # row's full-axis evaluation against THIS snapshot
+                # matches what the rebuild would have computed for it.
+                n_fresh = len(idx.fresh_rows)
+                rb = bucket_for(n_fresh, 16)
+                rows_pad = np.full((rb,), c_pad, dtype=np.int32)
+                rows_pad[:n_fresh] = np.asarray(idx.fresh_rows,
+                                                dtype=np.int32)
+                idx.fresh_rows.clear()
+                with span("index.append", rows=n_fresh):
+                    idx.state = append_fn(idx.state, class_pf, nf, af,
+                                          rows_pad)
+                self._sup_count("index_appends", n_fresh)
+                jnote("index.append", profile=self.profile, replica=self.replica,
+                      rows=n_fresh, batch=self._batch_seq)
+                inf.scored_rows += rb * n_pad
         if act == "corrupt" and idx.state is not None:
             # Scribbled index entries: one node column per class handed
             # an unbeatable cached score (alternating columns 0/1 per
@@ -2568,6 +2633,28 @@ class Scheduler:
                 return False
         return True
 
+    def _tenant_fusable(self, batch: List[QueuedPodInfo], hard_spread: bool,
+                        fail_closed) -> bool:
+        """Per-batch fusion gates for the multi-tenant vmapped step —
+        the index/loop posture: fast rung only (a degraded engine drops
+        speculation first), no nominations (their debits modify the
+        step's free input outside the fused staging), no explain
+        recorder, no armed shortlist/index cross-checks (their
+        attribution must stay per-batch), no fail-closed verdicts, no
+        hard-spread host arbitration, and the shared per-pod safety
+        walk (no gangs / topology / volumes / ports / pod-affinity /
+        owner groups — which also keeps spread_dev None, matching the
+        sequential engine). Gated-out batches dispatch solo inside
+        prepare: the coordinator's per-profile fallback."""
+        if (self.config.assignment != "greedy" or self._mesh is not None
+                or self.config.explain or self.recorder is not None):
+            return False
+        if (self._sup.level != 0 or self._nominations or fail_closed
+                or hard_spread or self.config.shortlist_check_every
+                or self.config.index_check_every):
+            return False
+        return self._ring_safe_pods(batch)
+
     def _maybe_run_tranche(self, batch: List[QueuedPodInfo], *,
                            checked: bool = False) -> bool:
         """Try to consume ``batch`` — plus up to depth-1 further READY
@@ -3107,8 +3194,15 @@ class Scheduler:
             self._prep_window = (t0, None)
         # Encode pods FIRST: constraints may register new topology keys,
         # which the node snapshot's domain tables must reflect.
-        vol_memo, fail_closed, eb = self._encode_batch(
-            batch, pods, step_bucket(len(pods), cfg.pod_bucket_min))
+        p_req = step_bucket(len(pods), cfg.pod_bucket_min)
+        if self._tenant_mux is not None:
+            # Ragged tenant batches harmonize to the fusion round's
+            # common pod pad (the vmapped lanes must share one P).
+            # Masked-row padding: the extra rows are invalid, so the
+            # real rows' decisions are unchanged — the invariant the
+            # device loop's _stage_slot already leans on.
+            p_req = max(p_req, self._tenant_mux.round_pods)
+        vol_memo, fail_closed, eb = self._encode_batch(batch, pods, p_req)
         if self._index is not None:
             # Baseline-drain the index listener BEFORE the snapshot the
             # refresh evaluates against (encode/cache.drain_index_rows
@@ -3260,17 +3354,34 @@ class Scheduler:
         # Fault gate: jitted step dispatch (err → supervised retry down
         # the ladder; stall → lands in the watchdog's step window).
         FAULTS.hit("step")
+        # Fused multi-tenant arbitration (MINISCHED_TENANTS_FUSE): when
+        # the fusion coordinator armed this engine's lane on the tenant
+        # cache mux, a fusable batch SUBMITS its fully-staged step
+        # inputs instead of dispatching — the mux issues ONE vmapped
+        # step over every submitted lane (encode/cache.TenantCacheMux.
+        # dispatch) and fills this lane's decision planes before the
+        # coordinator resolves it. Checked BEFORE the index seam: the
+        # fused full step is bit-identical to the indexed serve
+        # (invariant I3), so decisions match the sequential engine in
+        # index mode too — and the index listener keeps draining above,
+        # so its protocol is untouched for batches that fall back.
+        if (self._tenant_mux is not None and sample_k is None
+                and self._tenant_fusable(batch, hard_spread, fail_closed)):
+            inf.tenant_ticket = self._tenant_mux.submit(
+                self, inf, eb, nf, af, key)
+            decision = None
+            packed_dev = None
+            spread_dev = None
         # Maintained arbitration index (MINISCHED_INDEX): serve the
         # batch's arbitration from the device-resident (C,N) class rows
         # — repaired from this prepare's drained deltas — instead of
         # dispatching the full (P,N) filter+score pass. Speculative: the
         # resolve phase settles it and re-dispatches the full step with
         # the SAME PRNG draw on any unassigned live row.
-        indexed = (self._index is not None and sample_k is None
-                   and self._mesh is None
-                   and self._index_dispatch(inf, batch, eb, nf, af, key,
-                                            fail_closed))
-        if indexed:
+        elif (self._index is not None and sample_k is None
+              and self._mesh is None
+              and self._index_dispatch(inf, batch, eb, nf, af, key,
+                                       fail_closed)):
             decision = None
             packed_dev = None
             spread_dev = None
@@ -3681,6 +3792,14 @@ class Scheduler:
         # dispatch and this fetch; stamping the fetch start keeps that
         # host-side gap out of the step metric (it books as gap time).
         inf.t_fetch_start = time.perf_counter()
+        if inf.tenant_ticket is not None:
+            # A fused tenant lane must be dispatched by the mux before
+            # the coordinator resolves it — reaching here with the
+            # ticket armed is a coordinator sequencing defect, and
+            # np.array(None) below would fail unintelligibly instead.
+            raise EngineDesync(
+                "fused tenant lane reached resolve with its ticket "
+                "still armed (mux.dispatch did not run)")
         if inf.index_packed_dev is not None:
             # Settle the speculative indexed scan: serve (index hit — no
             # full pass ran this batch) or discard + full-step

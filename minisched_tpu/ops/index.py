@@ -105,12 +105,12 @@ _INDEX_CACHE: dict = {}
 
 def build_index_ops(plugin_set: PluginSet, k_eff: int, *,
                     cfg: EncodingConfig = DEFAULT_ENCODING):
-    """Compile (build, refresh, assign) for one profile at indexed-scan
-    width ``k_eff`` (the K-dial — any width is exact: the certified
-    scan's in-scan repairs absorb a narrow one, so dial moves in either
-    direction cost no rebuild). Memoized on the profile's traced
-    behavior like ops/pipeline._STEP_CACHE, so tuner revisits and
-    engine restarts reuse compiles."""
+    """Compile (build, refresh, append, assign) for one profile at
+    indexed-scan width ``k_eff`` (the K-dial — any width is exact: the
+    certified scan's in-scan repairs absorb a narrow one, so dial moves
+    in either direction cost no rebuild). Memoized on the profile's
+    traced behavior like ops/pipeline._STEP_CACHE, so tuner revisits
+    and engine restarts reuse compiles."""
     if k_eff < 1:
         raise ValueError(f"index scan width {k_eff} must be >= 1")
     cache_key = (
@@ -174,6 +174,27 @@ def build_index_ops(plugin_set: PluginSet, k_eff: int, *,
         return IndexState(
             score=state.score.at[:, rows_pad].set(new_sc, mode="drop"))
 
+    def append(state: IndexState, class_pf, nf, af,
+               rows_pad) -> IndexState:
+        """Incremental per-class ADD: evaluate ONLY the fresh class
+        rows (``rows_pad`` (Rb,) i32 CLASS-row indices, sentinel ≥ C
+        for padding) against the full node axis and scatter them into
+        the maintained matrix — O(|fresh|·N) instead of the O(C·N)
+        rebuild a new pod class used to force. Every pre-existing row
+        kept its value (its class features are immutable by
+        construction — classes key on bit-identical feature rows), so
+        the result equals a fresh build against the same snapshot."""
+        c = class_pf.valid.shape[0]
+        live_row = rows_pad < c
+        safe = jnp.clip(rows_pad, 0, c - 1)
+        pf_sub = jax.tree_util.tree_map(lambda a: a[safe], class_pf)
+        pf_sub = pf_sub._replace(valid=pf_sub.valid & live_row)
+        new_sc = evaluate(pf_sub, nf, af)                    # (Rb,N)
+        # Same raw-index + mode="drop" discipline as refresh: pad
+        # slots fall outside [0, C) and write nothing.
+        return IndexState(
+            score=state.score.at[rows_pad, :].set(new_sc, mode="drop"))
+
     def assign(state: IndexState, cls, valid, requests, free0, key):
         """The certified shortlist-compressed scan over class rows
         gathered per pod — zero plugin evaluations. Identical inputs,
@@ -196,7 +217,8 @@ def build_index_ops(plugin_set: PluginSet, k_eff: int, *,
         ])
         return packed, r.free_after
 
-    ops = (jax.jit(build), jax.jit(refresh), jax.jit(assign))
+    ops = (jax.jit(build), jax.jit(refresh), jax.jit(append),
+           jax.jit(assign))
     _INDEX_CACHE[cache_key] = ops
     return ops
 

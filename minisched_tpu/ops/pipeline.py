@@ -142,7 +142,8 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
                assignment: str = "greedy",
                assign_fn=None, assign_key=None,
                sample_nodes: Optional[int] = None,
-               shortlist: Optional[int] = None):
+               shortlist: Optional[int] = None,
+               _raw: bool = False):
     """Compile the scheduling step for a plugin profile.
 
     Returns jitted ``step(eb, nf, af, key) -> Decision`` where eb is an
@@ -196,6 +197,13 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
     bench's kernel-vs-scan comparison depends on it); the auto-selected
     pallas kernel is gated off — the shortlist scan is the narrower
     sequential path the kernel existed to accelerate.
+
+    ``_raw``: return the UN-JITTED trace function (and skip the step
+    cache) — the tenant-fused builder vmaps it over a tenant axis and
+    jits the vmapped program itself (build_tenant_step). The raw step
+    additionally accepts ``w_vec``, an optional (S,) traced scorer
+    weight vector replacing the python-float weights baked at build
+    time; ``None`` (every existing caller) yields an identical jaxpr.
     """
     if assignment not in ("greedy", "auction"):
         raise ValueError(
@@ -225,9 +233,10 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
         explain, cfg, pallas, assignment, assign_key, sample_nodes,
         shortlist,
     )
-    cached = _STEP_CACHE.get(cache_key)
-    if cached is not None:
-        return cached
+    if not _raw:
+        cached = _STEP_CACHE.get(cache_key)
+        if cached is not None:
+            return cached
     filters = plugin_set.filter_plugins
     scorers = plugin_set.score_plugins
     weights = [plugin_set.weight_of(p) for p in scorers]
@@ -235,7 +244,7 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
     needs_topology = any(p.needs_topology for p in active)
     needs_node_affinity = any(p.needs_node_affinity for p in active)
 
-    def step(eb, nf, af, key) -> Decision:
+    def step(eb, nf, af, key, w_vec=None) -> Decision:
         pf = eb.pf
         P = pf.valid.shape[0]
         N = nf.valid.shape[0]
@@ -354,11 +363,15 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
 
             total = jnp.zeros_like(valid_pair, dtype=jnp.float32)
             raws, norms = [], []
-            for p, w in zip(scorers, weights):
+            for i, (p, w) in enumerate(zip(scorers, weights)):
                 with jax.named_scope(f"minisched.score.{p.name}"):
                     raw = p.score(pf_sub, nf, ctx).astype(jnp.float32)
                     norm = p.normalize(raw, feasible).astype(jnp.float32)
-                total = total + w * norm
+                # Traced per-lane weight (tenant fusion) or the baked
+                # python float — multiplying equal f32 values is
+                # deterministic, so the two paths stay bit-identical.
+                wv = w if w_vec is None else w_vec[i]
+                total = total + wv * norm
                 if explain:
                     raws.append(raw)
                     norms.append(norm)
@@ -578,6 +591,8 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             norm_scores=norm_stack,
         )
 
+    if _raw:
+        return step
     jitted = jax.jit(step)
     if pallas is not None or assign_fn is not None or assignment != "greedy":
         # An EXPLICIT pallas choice must fail loudly (bench.py's
@@ -748,6 +763,71 @@ def build_loop_step(plugin_set: PluginSet, *,
     jitted = jax.jit(loop)
     _LOOP_CACHE[cache_key] = jitted
     return jitted
+
+
+_TENANT_CACHE: dict = {}
+
+
+def build_tenant_step(plugin_set: PluginSet, *,
+                      cfg: EncodingConfig = DEFAULT_ENCODING,
+                      shortlist: Optional[int] = None):
+    """Compile the FUSED MULTI-TENANT step: one jitted program that
+    vmaps the per-batch step over a leading tenant axis, so one
+    dispatch serves T independent virtual clusters at the cost of one
+    big one.
+
+    Returns ``tenant_step(eb_stack, nf_stack, af_stack, keys, w_stack)
+    -> (packed_stack, free_stack)`` where every leaf of ``eb_stack`` /
+    ``af_stack`` carries a leading (T,) axis, ``keys`` is the (T, ...)
+    stack of each tenant's per-batch PRNG key, and ``w_stack`` is the
+    (T, S) per-tenant scorer weight matrix (threaded through the raw
+    step's ``w_vec`` seam — weight-differing tenants share this one
+    compile, the cache below keys WITHOUT weights). ``nf_stack`` maps
+    only the DYNAMIC node leaves (free / used_ports) over the tenant
+    axis; every static leaf is passed ONCE and broadcast — the fusion
+    coordinator only groups tenants whose static node encodings are
+    content-identical, which is the whole point: T tenants, one static
+    upload.
+
+    Per-lane outputs are bit-identical to the solo step on the same
+    (inputs, key): the body is the SAME trace (vmap of elementwise /
+    scan / gather ops on CPU preserves per-lane values; ``lax.cond``
+    becomes a select of two deterministically-computed branches), and
+    each lane's decision is packed with the i32 layout so the host
+    fetches the whole tranche in one (T, 6+F, P) transfer. Greedy
+    scan only (pallas=False — a Mosaic kernel can't be vmapped), no
+    explain, no node sampling (the only in-step key split would break
+    lane purity).
+    """
+    if shortlist is not None and shortlist < 1:
+        shortlist = None
+    cache_key = (
+        tuple(p.trace_key() for p in plugin_set.filter_plugins),
+        tuple(p.trace_key() for p in plugin_set.score_plugins),
+        cfg, shortlist, "tenant_step",
+    )
+    cached = _TENANT_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
+    inner = build_step(plugin_set, explain=False, cfg=cfg, pallas=False,
+                       assignment="greedy", shortlist=shortlist, _raw=True)
+    from ..encode.cache import NodeFeatureCache
+    from ..encode.features import NodeFeatures
+    from .residency import pack_decision_i32
+
+    def lane(eb, nf, af, key, w_vec):
+        d = inner(eb, nf, af, key, w_vec)
+        packed = pack_decision_i32(
+            d.chosen, d.assigned, d.gang_rejected, d.feasible_counts,
+            d.feasible_static, d.reject_counts, d.shortlist_repaired)
+        return packed, d.free_after
+
+    dyn = NodeFeatureCache.DYNAMIC_NF_FIELDS
+    nf_axes = NodeFeatures(**{f: (0 if f in dyn else None)
+                              for f in NodeFeatures._fields})
+    fused = jax.jit(jax.vmap(lane, in_axes=(0, nf_axes, 0, 0, 0)))
+    _TENANT_CACHE[cache_key] = fused
+    return fused
 
 
 _COMPILE_CACHE: dict = {"dir": None}

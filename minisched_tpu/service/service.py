@@ -15,12 +15,17 @@ every bind.
 from __future__ import annotations
 
 import logging
+import os
+import threading
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Union
 
 from ..config import SchedulerConfig
 from ..engine.clusterstate import SharedClusterState
+from ..engine.queue import weighted_gather
 from ..engine.scheduler import Scheduler
 from ..explain.resultstore import ResultStore
+from ..faults import FaultWorkerDeath
 from .config import SchedulerConfiguration
 from .defaultconfig import Profile, default_scheduler_profile
 
@@ -363,3 +368,232 @@ class SchedulerService:
 
     def get_scheduler_profiles(self) -> List[Profile]:
         return list(self._profiles)
+
+
+# ---- fused multi-tenant arbitration (ISSUE 16) --------------------------
+
+
+def tenants_fuse_from_env() -> int:
+    """``MINISCHED_TENANTS_FUSE``: the fused-tranche width cap (how many
+    tenants one vmapped dispatch may serve). 0/1/unset = fusion off —
+    the coordinator then steps each tenant sequentially, which is also
+    the bit-identity baseline the fused mode is measured against."""
+    try:
+        return int(os.environ.get("MINISCHED_TENANTS_FUSE", "0") or 0)
+    except ValueError:
+        return 0
+
+
+@dataclass
+class Tenant:
+    """One virtual cluster in a fused multi-tenant serving group: its
+    OWN ClusterStore (tenants share no objects, unlike profiles, which
+    partition one store's pods), its fair-share weight for the fused
+    batch-slot gather, and an optional plugin profile."""
+
+    name: str
+    store: object
+    weight: float = 1.0
+    profile: Optional[Profile] = None
+
+
+class TenantFusionCoordinator:
+    """Serve T virtual clusters from ONE arbitration dispatch per round
+    (ROADMAP "fused multi-tenant arbitration").
+
+    Each tenant gets a full private engine — own store, own
+    SharedClusterState/feature cache (so per-tenant sparse deltas route
+    to the owning tenant's slab by construction), own queue, own
+    overload controller (``OverloadController(name=profile)``, so the
+    per-profile shed_priority overrides land per tenant) — but NO run
+    thread: the coordinator drives every engine's prepare/resolve/commit
+    phases from one serve thread, with a ``TenantCacheMux``
+    (encode/cache.py) installed at the dispatch seam when fusion is on.
+
+    One round:
+
+      1. ``pending_count`` per tenant → ``weighted_gather`` splits the
+         config's ``max_batch_size`` batch slots by tenant weight (one
+         hot tenant cannot starve the fused slot).
+      2. Pop each tenant's quota; ``mux.round_pods`` is set to the
+         round's common pod bucket so ragged tenant batches harmonize
+         by masked-row padding (the pinned pad invariant: pad rows are
+         invalid and change no real row's decision).
+      3. Each engine's prepare runs — a fusable batch SUBMITS its
+         staged step inputs to the mux; anything gated out (gangs,
+         nominations, degraded rungs, sampling, explain, mesh, spread)
+         dispatches solo inside prepare exactly as before.
+      4. ``mux.dispatch()`` fires one vmapped step per compatibility
+         group and hands every lane its decision planes.
+      5. Resolve + commit per tenant, in tenant order — each engine's
+         own settlement machinery, journal/provenance attribution
+         riding the engine's profile label as always.
+
+    With ``fuse < 2`` (``MINISCHED_TENANTS_FUSE`` unset) no mux is
+    installed and the same loop steps each tenant's batch through its
+    own full dispatch — the sequential baseline. Decisions are
+    bit-identical between the two modes in every engine config
+    (tests/test_tenants.py pins it); only the dispatch/fetch counts
+    differ, which is the whole point (BENCH_TENANTS.json's >=5x claim).
+    """
+
+    def __init__(self, tenants: Sequence[Tenant],
+                 config: Optional[SchedulerConfig] = None,
+                 fuse: Optional[int] = None):
+        from ..encode.cache import TenantCacheMux
+
+        if fuse is None:
+            fuse = tenants_fuse_from_env()
+        self.fuse = max(0, int(fuse))
+        self.fused = self.fuse >= 2
+        names = [t.name for t in tenants]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate tenant names: {names}")
+        if not tenants:
+            raise ValueError("at least one tenant required")
+        self._tenants = list(tenants)
+        self._config = config or SchedulerConfig()
+        self._weights = [float(t.weight) for t in self._tenants]
+        self.mux = TenantCacheMux() if self.fused else None
+        if self.mux is not None:
+            self.mux.max_lanes = self.fuse
+        self._engines: Dict[str, Scheduler] = {}
+        for t in self._tenants:
+            pset = (t.profile or default_scheduler_profile()).build()
+            eng = Scheduler(t.store, pset, self._config, profile=t.name)
+            if self.mux is not None:
+                eng._tenant_mux = self.mux
+            self._engines[t.name] = eng
+        self._rounds = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def engines(self) -> Dict[str, Scheduler]:
+        return dict(self._engines)
+
+    def engine(self, name: str) -> Scheduler:
+        return self._engines[name]
+
+    def store(self, name: str):
+        for t in self._tenants:
+            if t.name == name:
+                return t.store
+        raise KeyError(name)
+
+    # ---- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        """Sync every tenant's informers, then start ONE serve thread.
+        Engines never get their own run loop (``Scheduler.start`` is not
+        called) — the coordinator owns the phase sequencing, which is
+        what lets one round's prepares rendezvous at the mux."""
+        for eng in self._engines.values():
+            eng._shared.ensure_started()
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="tenant-coordinator")
+        self._thread.start()
+        log.info("tenant coordinator started (%d tenants, fuse=%d)",
+                 len(self._tenants), self.fuse)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        for eng in self._engines.values():
+            eng.shutdown()
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                served = self.serve_round()
+            except Exception:
+                log.exception("tenant round failed")
+                served = False
+            if not served:
+                self._stop.wait(0.01)
+
+    # ---- one fused round ------------------------------------------------
+
+    def serve_round(self) -> bool:
+        """Drive one coordinated round across every tenant. Returns
+        False when no tenant had poppable work (the serve thread then
+        idles briefly). Public so tests can single-step rounds without
+        the thread."""
+        from ..encode.cache import step_bucket
+
+        engines = [self._engines[t.name] for t in self._tenants]
+        demands = [eng.queue.pending_count() for eng in engines]
+        if not any(demands):
+            return False
+        quotas = weighted_gather(demands, self._weights,
+                                 self._config.max_batch_size)
+        work = []
+        for eng, quota in zip(engines, quotas):
+            if quota <= 0:
+                continue
+            batch = eng.queue.pop_batch(quota, timeout=0.05)
+            if batch:
+                work.append((eng, batch))
+        if not work:
+            return False
+        if self.mux is not None:
+            # The round's common pod pad: every fused lane encodes at
+            # the widest tenant's bucket so the stacked (T, P, ...)
+            # batch is rectangular. Solo-dispatched lanes harmonize
+            # too — harmless (the pad invariant) and keeps their pad
+            # buckets from fragmenting the compile cache.
+            self.mux.round_pods = step_bucket(
+                max(len(b) for _eng, b in work),
+                self._config.pod_bucket_min)
+        lanes = []
+        for eng, batch in work:
+            lanes.append((eng, eng._prepare_batch(batch)))
+        if self.mux is not None:
+            self.mux.dispatch()
+        for eng, inf in lanes:
+            eng._resolve_batch(inf)
+            try:
+                eng._commit_batch(inf)
+            except FaultWorkerDeath:
+                # Same containment as the engine's synchronous cycle:
+                # requeue the flush tranche, keep the coordinator alive.
+                for qpi, _plugins, _msg, _retry in inf.failures:
+                    eng.queue.requeue_backoff(qpi)
+        self._rounds += 1
+        return True
+
+    # ---- observability --------------------------------------------------
+
+    def metrics(self) -> Dict[str, float]:
+        """Tenant-prefixed engine metrics + the mux's fusion ledger +
+        the cross-tenant dispatch/fetch totals the bench's >=5x claim
+        compares between fused and sequential modes."""
+        out: Dict[str, float] = {}
+        total_disp = 0.0
+        total_fetch = 0.0
+        for name, eng in self._engines.items():
+            m = eng.metrics()
+            for k, v in m.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    out[f"{name}_{k}"] = v
+            total_disp += m.get("steps_dispatched", 0)
+            total_fetch += m.get("decision_fetches", 0)
+        if self.mux is not None:
+            out.update(self.mux.counters)
+            total_disp += self.mux.counters["tenant_dispatches"]
+            total_fetch += self.mux.counters["tenant_fetches"]
+        out["steps_dispatched_total"] = total_disp
+        out["decision_fetches_total"] = total_fetch
+        out["tenant_rounds_served"] = self._rounds
+        return out
+
+    def provenance(self, pod_key: str):
+        """First tenant engine holding a record answers (tenants share
+        no pods — disjoint stores)."""
+        for eng in self._engines.values():
+            rec = eng.provenance(pod_key)
+            if rec is not None:
+                return rec
+        return None

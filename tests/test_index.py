@@ -168,7 +168,8 @@ def test_raw_op_build_assign_matches_full_step():
     indexed scan is the PR 4 certified machinery over them."""
     from minisched_tpu.ops.index import unpack_index_decision
 
-    pset, eb, nf, af, (build, _refresh, assign), key, _c = _raw_setup()
+    pset, eb, nf, af, (build, _refresh, _append, assign), key, _c = (
+        _raw_setup())
     state = build(eb.pf, nf, af)  # classes == the pod rows themselves
     cls = np.arange(16, dtype=np.int32)
     packed, free_after = assign(state, cls, eb.pf.valid,
@@ -195,8 +196,8 @@ def test_raw_op_refresh_repairs_changed_columns_exactly():
     # sentinels in rows_pad exercise the duplicate-scatter hazard (a
     # clipped sentinel would collide with the genuine last-column
     # repair; refresh must drop out-of-range slots instead).
-    pset, eb, nf, af, (build, refresh, assign), key, _c = _raw_setup(
-        n_nodes=16, k=3)
+    pset, eb, nf, af, (build, refresh, _append, assign), key, _c = (
+        _raw_setup(n_nodes=16, k=3))
     state0 = build(eb.pf, nf, af)
     free = np.array(nf.free)
     # Narrow two columns (debits) and widen two (eviction credits that
@@ -230,10 +231,10 @@ def test_raw_op_any_scan_width_is_exact():
     from minisched_tpu.ops.index import (build_index_ops,
                                          unpack_index_decision)
 
-    pset, eb, nf, af, (build, _r, _a), key, _c = _raw_setup(k=6)
+    pset, eb, nf, af, (build, _r, _ap, _a), key, _c = _raw_setup(k=6)
     state = build(eb.pf, nf, af)
     for k_eff in (1, 2, 16):
-        _b2, _r2, assign_k = build_index_ops(pset, k_eff)
+        _b2, _r2, _ap2, assign_k = build_index_ops(pset, k_eff)
         cls = np.arange(16, dtype=np.int32)
         packed, _fa = assign_k(state, cls, eb.pf.valid,
                                eb.pf.requests, nf.free, key)
@@ -562,4 +563,102 @@ def test_k_dial_moves_are_live_exact_and_rebuild_free():
     # class/churn machinery did before the first dial move
     assert int(m_on["index_rebuilds"]) == dial["narrowed"] == (
         dial["widened"]), (dial, m_on["index_rebuilds"])
+    assert m_on["index_desyncs"] == 0
+
+
+# ---- incremental per-class ADD (ops/index.append) -------------------------
+
+
+def test_raw_op_append_extends_build_exactly():
+    """The append invariant: building from a class subset and APPENDING
+    the remaining rows yields the bitwise-identical matrix a full build
+    computes — a fresh class costs O(|fresh|·N) evaluations, never the
+    O(C·N) rebuild, and pre-existing rows keep their values untouched.
+    The rows_pad sentinels (>= C) exercise the same raw-index +
+    mode="drop" scatter discipline refresh pins."""
+    pset, eb, nf, af, (build, _refresh, append, assign), key, _c = (
+        _raw_setup())
+    full = build(eb.pf, nf, af)
+    split = 5
+    part_valid = np.array(eb.pf.valid).copy()
+    part_valid[split:] = False
+    state0 = build(eb.pf._replace(valid=part_valid), nf, af)
+    # the subset build genuinely differs where the missing rows live
+    assert not np.array_equal(np.asarray(state0.score),
+                              np.asarray(full.score))
+    rows_pad = np.full((16,), 16, dtype=np.int32)   # sentinel == C
+    rows_pad[:16 - split] = np.arange(split, 16, dtype=np.int32)
+    state1 = append(state0, eb.pf, nf, af, rows_pad)
+    np.testing.assert_array_equal(np.asarray(state1.score),
+                                  np.asarray(full.score))
+    # and the appended matrix serves the full step's decisions
+    from minisched_tpu.ops.index import unpack_index_decision
+
+    cls = np.arange(16, dtype=np.int32)
+    packed, _fa = assign(state1, cls, eb.pf.valid, eb.pf.requests,
+                         nf.free, key)
+    chosen, assigned, _rep = unpack_index_decision(np.array(packed), 16)
+    ref_c, ref_a, _ = _full_reference(pset, eb, nf, af, key)
+    np.testing.assert_array_equal(chosen, ref_c)
+    np.testing.assert_array_equal(assigned, ref_a)
+
+
+def test_fresh_class_in_bucket_appends_without_rebuild():
+    """A later burst introducing NEW pod classes inside the current
+    class-pad bucket is served by the incremental ADD: index_appends
+    counts the fresh rows, the rebuild total stays at the single cold
+    build, and decisions equal the index-off engine's."""
+    bursts = [_pods(12, shapes=2), _pods(12, shapes=4)]
+    for i, b in enumerate(bursts):
+        for p in b:
+            p.metadata.name = f"b{i}{p.metadata.name}"
+    cfg = _config(True, pipeline=False, max_batch_size=24,
+                  index_classes=32)
+    on, m_on = _run(cfg, bursts)
+    off_bursts = [[obj.Pod(metadata=obj.ObjectMeta(
+        name=p.metadata.name, namespace="default"),
+        spec=obj.PodSpec(requests=dict(p.spec.requests),
+                         priority=p.spec.priority)) for p in b]
+        for b in bursts]
+    off, _m = _run(_config(False, pipeline=False, max_batch_size=24),
+                   off_bursts)
+    assert on == off
+    # shapes=4 ⊃ shapes=2: burst 1 brings exactly 2 fresh class rows,
+    # both inside the 16-row class-pad bucket
+    assert m_on["index_appends"] >= 1, m_on
+    assert m_on["index_rebuilds"] == 1, m_on   # the cold build only
+    assert m_on["index_desyncs"] == 0
+
+
+def test_class_pad_crossing_rebuilds_with_pinned_cause():
+    """Fresh classes that CROSS the class-pad bucket cannot append (the
+    maintained matrix must grow) — that one rebuild is taken, and its
+    journal event pins the cause chain: kind index.rebuild with
+    cause == "class-pad", not "cold"/"invalidated"/"node-pad"."""
+    from minisched_tpu.obs import journal as journal_mod
+
+    # burst 0: 10 classes (class pad 16); burst 1: +12 disjoint classes
+    # → 22 total crosses to pad 32 partway through the burst, so BOTH
+    # the in-bucket append path and the crossing rebuild fire.
+    bursts = [_pods(10, shapes=10), _pods(12, shapes=12, cpu0=4000)]
+    for i, b in enumerate(bursts):
+        for p in b:
+            p.metadata.name = f"b{i}{p.metadata.name}"
+    journal_mod.configure("1")
+    try:
+        on, m_on = _run(_config(True, pipeline=False, index_classes=32),
+                        bursts)
+        causes = [e.get("cause") for e in journal_mod.JOURNAL.entries()
+                  if e["kind"] == "index.rebuild"]
+    finally:
+        journal_mod.configure("")
+    off_bursts = [[obj.Pod(metadata=obj.ObjectMeta(
+        name=p.metadata.name, namespace="default"),
+        spec=obj.PodSpec(requests=dict(p.spec.requests),
+                         priority=p.spec.priority)) for p in b]
+        for b in bursts]
+    off, _m = _run(_config(False, pipeline=False), off_bursts)
+    assert on == off
+    assert "class-pad" in causes, (causes, m_on)
+    assert m_on["index_rebuilds"] == len(causes) >= 2
     assert m_on["index_desyncs"] == 0

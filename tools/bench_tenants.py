@@ -1,0 +1,380 @@
+"""Fused multi-tenant arbitration before/after comparison at CPU shapes.
+
+Runs T small virtual clusters through the TenantFusionCoordinator —
+where the ISSUE-16 tentpole fuses the per-tenant arbitration step over
+a vmapped tenant axis, so one jitted dispatch serves every fusable
+tenant lane per round — under MINISCHED_TENANTS_FUSE=0 (sequential
+per-tenant stepping, the bit-identity baseline) and =8. Measurement is
+INTERLEAVED (off, on, off, on), min-of-N per mode, the same
+drift-cancelling discipline as the other CPU artifacts.
+
+The CPU artifact proves the claims the TPU capture will lean on:
+
+  * dispatch fusion — step dispatches per served batch drop >=5x at
+    T=8: the sequential coordinator pays one dispatch (and one decision
+    fetch) per tenant batch, the fused coordinator pays one per ROUND
+    for the whole compat group (mid-tranche races fall back solo and
+    are counted, never hidden);
+  * decision equality — every paired run replays the identical
+    per-tenant workload through both modes and diffs every pod->node
+    placement PER TENANT (also pinned per engine mode by
+    tests/test_tenants.py, including ragged tenant batches and forced
+    mid-tranche races);
+  * zero cross-tenant leakage — a journal-armed probe checks every
+    bound pod's provenance record carries the OWNING tenant's profile
+    and no other engine holds the record;
+  * per-tenant shed budgets — a one-tenant overload burst sheds only
+    the noisy tenant's low-priority arrivals
+    (MINISCHED_OVERLOAD profile override) while the quiet tenant binds
+    everything.
+
+    JAX_PLATFORMS=cpu python tools/bench_tenants.py [> BENCH_TENANTS.json]
+
+    # the `make bench-check` slice: the same claim contract in one
+    # round at a smaller per-tenant backlog (the >=5x dispatch bar is
+    # structural in T, so it does NOT scale down), advisory key diff vs
+    # the committed BENCH_LEDGER.json entry (source bench-tenants)
+    JAX_PLATFORMS=cpu python tools/bench_tenants.py --check
+    JAX_PLATFORMS=cpu python tools/bench_tenants.py --check --update
+
+MINISCHED_BENCH_TENANTS / MINISCHED_BENCH_TENANT_PODS override the
+8 x 40 shape.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MODES = (("fused_off", 0), ("fused_on", 8))
+
+#: stable keys for the cross-run regression ledger
+LEDGER_KEYS = ("tenants_sched_s", "tenants_pods_per_sec",
+               "dispatches_per_batch", "fetches_per_batch",
+               "tenant_lanes_fused", "tenant_rounds")
+
+PLUGINS = ("NodeUnschedulable", "NodeResourcesFit",
+           "NodeResourcesLeastAllocated")
+
+
+def _mk_store(node_cpus=(64000, 48000, 40000, 36000)):
+    """One tenant's virtual cluster. Node NAMES are identical across
+    tenants — name_hash is a static feature leaf, so shared names are
+    what lets the mux land every tenant in ONE compat group."""
+    from minisched_tpu.state import objects as obj
+    from minisched_tpu.state.store import ClusterStore
+
+    s = ClusterStore()
+    for i, cpu in enumerate(node_cpus):
+        s.create(obj.Node(
+            metadata=obj.ObjectMeta(name=f"vn-n{i}"),
+            spec=obj.NodeSpec(),
+            status=obj.NodeStatus(allocatable={
+                "cpu": float(cpu), "memory": float(64 << 30),
+                "pods": 500.0})))
+    return s
+
+
+def _pods(n, tag, *, cpu0=100, prio=None):
+    from minisched_tpu.state import objects as obj
+
+    return [obj.Pod(
+        metadata=obj.ObjectMeta(name=f"{tag}-p{i}", namespace="default"),
+        spec=obj.PodSpec(requests={"cpu": float(cpu0 + 7 * (i % 40))},
+                         priority=(100000 - i if prio is None else prio)))
+        for i in range(n)]
+
+
+def _coordinator(t, fuse, *, config=None):
+    from minisched_tpu.config import SchedulerConfig
+    from minisched_tpu.service.service import (Tenant,
+                                               TenantFusionCoordinator)
+
+    tenants = [Tenant(name=f"t{i}", store=_mk_store()) for i in range(t)]
+    cfg = config or SchedulerConfig(max_batch_size=16 * t,
+                                    batch_window_s=0.2,
+                                    batch_idle_s=0.05, seed=0)
+    return TenantFusionCoordinator(tenants, cfg, fuse=fuse)
+
+
+def run_mode(fuse: int, t: int, p: int) -> dict:
+    """One coordinator run: T tenants x P pods -> wall clock + the
+    fusion ledger + per-tenant placements."""
+    coord = _coordinator(t, fuse)
+    try:
+        coord.start()
+        t0 = time.perf_counter()
+        for i in range(t):
+            coord.store(f"t{i}").create_many(_pods(p, f"t{i}"))
+        want = t * p
+        deadline = time.time() + 240
+        placements = {}
+        while time.time() < deadline:
+            placements = {
+                f"t{i}": {q.metadata.name: q.spec.node_name
+                          for q in coord.store(f"t{i}").list("Pod")
+                          if q.spec.node_name}
+                for i in range(t)}
+            if sum(len(v) for v in placements.values()) == want:
+                break
+            time.sleep(0.02)
+        sched_s = time.perf_counter() - t0
+        m = coord.metrics()
+    finally:
+        coord.shutdown()
+    bound = sum(len(v) for v in placements.values())
+    batches = sum(m.get(f"t{i}_batches", 0) for i in range(t))
+    out = {
+        "tenants_sched_s": round(sched_s, 4),
+        "tenants_bound": bound,
+        "tenants_pods_per_sec": round(bound / sched_s, 1) if sched_s
+        else 0.0,
+        "tenant_batches": int(batches),
+        "steps_dispatched_total": float(m.get("steps_dispatched_total",
+                                              0)),
+        "decision_fetches_total": float(m.get("decision_fetches_total",
+                                              0)),
+        "dispatches_per_batch": round(
+            m.get("steps_dispatched_total", 0) / max(1, batches), 4),
+        "fetches_per_batch": round(
+            m.get("decision_fetches_total", 0) / max(1, batches), 4),
+        "tenant_rounds": float(m.get("tenant_rounds",
+                                     m.get("tenant_rounds_served", 0))),
+        "tenant_lanes_fused": float(m.get("tenant_lanes_fused", 0)),
+        "tenant_races": float(m.get("tenant_races", 0)),
+        "tenant_solo_fallbacks": float(m.get("tenant_solo_fallbacks", 0)),
+        "_placements": placements,
+    }
+    return out
+
+
+def leakage_probe(t: int = 2, p: int = 6) -> dict:
+    """Journal-armed fused run: every bound pod's provenance record
+    must carry the OWNING tenant's profile and live on no other
+    engine."""
+    from minisched_tpu.obs import journal as journal_mod
+
+    journal_mod.configure("1")
+    coord = _coordinator(t, 8)
+    checked = leaks = missing = 0
+    try:
+        coord.start()
+        for i in range(t):
+            coord.store(f"t{i}").create_many(_pods(p, f"t{i}"))
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if all(len([q for q in coord.store(f"t{i}").list("Pod")
+                        if q.spec.node_name]) == p for i in range(t)):
+                break
+            time.sleep(0.05)
+        for i in range(t):
+            for j in range(p):
+                key = f"default/t{i}-p{j}"
+                rec = coord.engine(f"t{i}").provenance(key)
+                checked += 1
+                if rec is None:
+                    missing += 1
+                    continue
+                if rec.get("profile") != f"t{i}":
+                    leaks += 1
+                for k in range(t):
+                    if k != i and (coord.engine(f"t{k}")
+                                   .provenance(key)) is not None:
+                        leaks += 1
+    finally:
+        coord.shutdown()
+        journal_mod.configure("")
+    return {"records_checked": checked, "cross_tenant_leaks": leaks,
+            "records_missing": missing,
+            "ok": leaks == 0 and missing == 0 and checked == t * p}
+
+
+def shed_probe() -> dict:
+    """One-tenant overload burst: the noisy tenant's low-priority
+    arrivals shed under its profile-scoped budget; the quiet tenant's
+    identical-priority pods all bind."""
+    from minisched_tpu.config import SchedulerConfig
+    from minisched_tpu.engine import overload
+    from minisched_tpu.service.service import (Tenant,
+                                               TenantFusionCoordinator)
+
+    overload.configure("shed_priority=0,hold=99,probation=99;"
+                       "noisy:shed_priority=500")
+    tenants = [Tenant(name="quiet", store=_mk_store()),
+               Tenant(name="noisy", store=_mk_store())]
+    coord = TenantFusionCoordinator(
+        tenants, SchedulerConfig(max_batch_size=32, batch_window_s=0.2,
+                                 batch_idle_s=0.05, seed=0), fuse=8)
+    try:
+        coord.start()
+        coord.engine("noisy")._overload.level = 2   # shedding rung
+        coord.store("quiet").create_many(_pods(6, "quiet", prio=0))
+        coord.store("noisy").create_many(_pods(6, "noisy", prio=0))
+        coord.store("noisy").create_many(_pods(2, "hi", prio=1000,
+                                               cpu0=200))
+        deadline = time.time() + 60
+        quiet_bound = noisy_hi_bound = 0
+        while time.time() < deadline:
+            quiet_bound = len([q for q in
+                               coord.store("quiet").list("Pod")
+                               if q.spec.node_name])
+            noisy_hi_bound = len(
+                [q for q in coord.store("noisy").list("Pod")
+                 if q.spec.node_name
+                 and q.metadata.name.startswith("hi-")])
+            if quiet_bound == 6 and noisy_hi_bound == 2:
+                break
+            time.sleep(0.05)
+        m = coord.metrics()
+    finally:
+        coord.shutdown()
+        overload.configure("")
+    return {"quiet_bound": quiet_bound, "noisy_hi_bound": noisy_hi_bound,
+            "noisy_shed_total": float(m.get("noisy_shed_total", 0)),
+            "quiet_shed_total": float(m.get("quiet_shed_total", 0)),
+            "ok": (quiet_bound == 6 and noisy_hi_bound == 2
+                   and m.get("noisy_shed_total", 0) >= 1
+                   and m.get("quiet_shed_total", 0) == 0)}
+
+
+def claims(doc: dict, *, dispatch_bar: float) -> list:
+    """The artifact's acceptance contract -> list of failure strings."""
+    bad = []
+    red = doc.get("dispatch_reduction_x") or 0
+    if red < dispatch_bar:
+        bad.append(f"dispatches per served batch down {red}x < "
+                   f"{dispatch_bar}x")
+    on = doc["modes"]["fused_on"]
+    if not on.get("tenant_lanes_fused"):
+        bad.append("fused round never served a fused lane")
+    off = doc["modes"]["fused_off"]
+    if off.get("tenant_lanes_fused"):
+        bad.append("sequential round recorded fused lanes")
+    eq = doc.get("decision_equality") or {}
+    if not eq.get("decisions_identical"):
+        bad.append(f"per-tenant decision equality failed: {eq}")
+    leak = doc.get("leakage") or {}
+    if not leak.get("ok"):
+        bad.append(f"cross-tenant attribution leaked: {leak}")
+    shed = doc.get("shed_budget") or {}
+    if not shed.get("ok"):
+        bad.append(f"quiet-tenant shed budget failed: {shed}")
+    return bad
+
+
+def capture(t: int, p: int, rounds: int, *, dispatch_bar: float) -> dict:
+    doc = {"tenants": t, "pods_per_tenant": p, "platform": "cpu",
+           "methodology":
+               f"interleaved off/on rounds; time keys are min-of-"
+               f"{rounds} runs per mode; dispatch/fetch/lane counters "
+               "come from the coordinator ledger and are per-mode "
+               "exact; dispatches per served batch divides the total "
+               "dispatch count (engine solo steps + fused tranches) by "
+               "the total per-tenant batches; the equality block diffs "
+               "every pod->node placement PER TENANT between one "
+               "sequential and one fused replay of the identical "
+               "workload; leakage and shed probes run fused with the "
+               "journal / a profile-scoped MINISCHED_OVERLOAD armed",
+           "modes": {}}
+    runs = {label: [] for label, _ in MODES}
+    for _round in range(rounds):
+        for label, fuse in MODES:  # interleaved: off, on, off, on, ...
+            runs[label].append(run_mode(fuse, t, p))
+    pl = {}
+    for label, _ in MODES:
+        merged = dict(runs[label][0])
+        for rep in runs[label][1:]:
+            for k, v in rep.items():
+                if (k.endswith("_s") and isinstance(v, (int, float))
+                        and isinstance(merged.get(k), (int, float))):
+                    merged[k] = min(merged[k], v)
+        bound = merged.get("tenants_bound")
+        sched_s = merged.get("tenants_sched_s")
+        if bound and sched_s:
+            merged["tenants_pods_per_sec"] = round(bound / sched_s, 1)
+        pl[label] = merged.pop("_placements")
+        doc["modes"][label] = merged
+    off, on = doc["modes"]["fused_off"], doc["modes"]["fused_on"]
+    doc["dispatch_reduction_x"] = (
+        round(off["dispatches_per_batch"] / on["dispatches_per_batch"], 2)
+        if on["dispatches_per_batch"] else float("inf"))
+    doc["fetch_reduction_x"] = (
+        round(off["fetches_per_batch"] / on["fetches_per_batch"], 2)
+        if on["fetches_per_batch"] else float("inf"))
+    # per-tenant decision equality between the LAST off/on replays
+    seq_pl, fus_pl = pl["fused_off"], pl["fused_on"]
+    diffs = sum(1 for tn in seq_pl for pod in seq_pl[tn]
+                if fus_pl.get(tn, {}).get(pod) != seq_pl[tn][pod])
+    compared = sum(len(v) for v in seq_pl.values())
+    unbound = (t * p - compared) + (t * p - sum(len(v)
+                                                for v in fus_pl.values()))
+    doc["decision_equality"] = {
+        "decisions_compared": compared,
+        "decisions_identical": diffs == 0 and unbound == 0,
+        "decision_diffs": diffs, "unbound_in_either_run": unbound,
+    }
+    doc["leakage"] = leakage_probe()
+    doc["shed_budget"] = shed_probe()
+    doc["claims_failed"] = claims(doc, dispatch_bar=dispatch_bar)
+    doc["ok"] = not doc["claims_failed"]
+    return doc
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="one-round claim-contract gate + advisory key "
+                         "diff vs the committed ledger (exit 1 on a "
+                         "claim failure)")
+    ap.add_argument("--update", action="store_true",
+                    help="append this capture to the ledger as the new "
+                         "bench-tenants baseline")
+    ap.add_argument("--ledger",
+                    default=os.path.join(REPO, "BENCH_LEDGER.json"))
+    args = ap.parse_args()
+    t = int(os.environ.get("MINISCHED_BENCH_TENANTS", "8"))
+    # --check shrinks the per-tenant backlog to stay minutes-class; the
+    # >=5x dispatch bar is structural in T (one fused tranche serves
+    # ~T lanes), so it does not scale down with the backlog.
+    p = int(os.environ.get("MINISCHED_BENCH_TENANT_PODS",
+                           "10" if args.check else "40"))
+    rounds = int(os.environ.get("MINISCHED_BENCH_ROUNDS",
+                                "1" if args.check else "4"))
+    doc = capture(t, p, rounds, dispatch_bar=5.0)
+
+    # ---- ledger + (advisory) regression diff ---------------------------
+    import bench
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from bench_compare import compare, latest_baseline
+
+    keys = {k: v for k in LEDGER_KEYS
+            for v in [doc["modes"]["fused_on"].get(k)]
+            if isinstance(v, (int, float)) and v}
+    entry = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+             "source": "bench-tenants", "platform": "cpu",
+             "nodes": t, "pods": t * p, "keys": keys}
+    try:
+        with open(args.ledger, encoding="utf-8") as f:
+            ledger = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        ledger = {"schema": 1, "runs": []}
+    base = latest_baseline(ledger, t, t * p, "cpu",
+                           source="bench-tenants")
+    if base is not None:
+        # Advisory: CPU wall-clock varies several-fold between hosts;
+        # the hard gate is the claim contract (counters + equality).
+        doc["ledger_diff"] = compare(keys, base.get("keys") or {})
+    if args.update or (not args.check and base is None):
+        bench.append_ledger(entry, args.ledger)
+        doc["ledger_appended"] = True
+    print(json.dumps(doc))
+    if args.check and not doc["ok"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

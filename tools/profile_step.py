@@ -58,6 +58,16 @@ def main() -> None:
                          "batch claim at raw-op level, plus the loop "
                          "depth/iteration/break counters an engine run "
                          "exposes via metrics()")
+    ap.add_argument("--tenants", type=int, default=0, metavar="T",
+                    help="also profile fused multi-tenant arbitration "
+                         "(ops/pipeline.build_tenant_step) at T tenants: "
+                         "one vmapped dispatch + one stacked fetch over "
+                         "T copies of the batch vs T per-tenant "
+                         "dispatch/fetch cycles — the dispatches-per-"
+                         "served-batch claim at raw-op level "
+                         "(MINISCHED_TENANTS_FUSE; engine counters "
+                         "tenant_dispatches / tenant_fetches / "
+                         "tenant_fused_lanes on the live coordinator)")
     ap.add_argument("--passes", action="store_true",
                     help="per-pass attribution ladder: time the step "
                          "with an increasing plugin subset; successive "
@@ -226,13 +236,16 @@ def main() -> None:
         c_model = min(64, p_pad)
         class_pf = type(eb.pf)(*[np.asarray(getattr(eb.pf, f))[:c_model]
                                  for f in eb.pf._fields])
-        b_fn, r_fn, a_fn = build_index_ops(pset, cfg_env.index_k)
+        b_fn, r_fn, ap_fn, a_fn = build_index_ops(pset, cfg_env.index_k)
         state = timed("index_build_s", lambda: b_fn(class_pf, nf, af))
         rb = min(64, n_pad)
         rows_pad = np.arange(rb, dtype=np.int32)
         timed("index_refresh_s",
               lambda: r_fn(state, class_pf, nf, af, rows_pad))
         cls = (np.arange(p_pad) % c_model).astype(np.int32)
+        ap_rows = np.arange(min(16, c_model), dtype=np.int32)
+        timed("index_append_s",
+              lambda: ap_fn(state, class_pf, nf, af, ap_rows))
         timed("index_assign_s",
               lambda: a_fn(state, cls, eb.pf.valid, eb.pf.requests,
                            nf.free, key)[0])
@@ -297,6 +310,62 @@ def main() -> None:
               f"({pb_s / max(fused_s, 1e-9):.2f}x — dispatch overhead "
               "is the TPU-tunnel prize; CPU mostly proves the ledger)",
               flush=True)
+
+    if args.tenants > 1:
+        # Fused multi-tenant arbitration (MINISCHED_TENANTS_FUSE): T
+        # tenants' batches through ONE vmapped dispatch + ONE (T,6+F,P)
+        # stacked fetch, vs T per-tenant dispatch/fetch cycles. Statics
+        # broadcast (in_axes=None) — T tenants, one node encoding.
+        from minisched_tpu.encode.cache import NodeFeatureCache as _NFC
+        from minisched_tpu.ops.pipeline import build_tenant_step
+        from minisched_tpu.ops.residency import pack_decision_i32
+
+        t = args.tenants
+        fused_fn = build_tenant_step(pset, shortlist=sl_k)
+        eb_stack = jax.tree_util.tree_map(
+            lambda a: np.broadcast_to(a, (t,) + a.shape).copy(), eb)
+        af_stack = jax.tree_util.tree_map(
+            lambda a: np.broadcast_to(a, (t,) + a.shape).copy(), af)
+        nf_stack = nf._replace(**{
+            f: np.broadcast_to(np.asarray(getattr(nf, f)),
+                               (t,) + getattr(nf, f).shape).copy()
+            for f in _NFC.DYNAMIC_NF_FIELDS})
+        keys = np.stack([np.asarray(jax.random.fold_in(key, i))
+                         for i in range(t)])
+        w_row = np.asarray([pset.weight_of(p) for p in pset.score_plugins],
+                           dtype=np.float32)
+        w_stack = np.broadcast_to(w_row, (t,) + w_row.shape).copy()
+
+        def fused_tenants():
+            packs, _free = fused_fn(eb_stack, nf_stack, af_stack, keys,
+                                    w_stack)
+            return np.array(packs)   # ONE stacked d2h transfer
+
+        stack_t = timed(f"tenants_fused_s[{t}]", fused_tenants)
+
+        def sequential_tenants():
+            bufs = []
+            for i in range(t):       # T dispatches + T fetches
+                dd = step(eb, nf, af, jax.random.fold_in(key, i))
+                bufs.append(np.array(pack_decision_i32(
+                    dd.chosen, dd.assigned, dd.gang_rejected,
+                    dd.feasible_counts, dd.feasible_static,
+                    dd.reject_counts, dd.shortlist_repaired)))
+            return bufs
+
+        seq_bufs = timed(f"tenants_seq_s[{t}]", sequential_tenants)
+        ident = all(np.array_equal(stack_t[i], seq_bufs[i])
+                    for i in range(t))
+        fused_s = stages[f"tenants_fused_s[{t}]"]
+        seq_s = stages[f"tenants_seq_s[{t}]"]
+        print(f"tenants: T={t} dispatches 1 fused vs {t} sequential "
+              f"({t:.1f}x fewer); fetches 1 ({stack_t.nbytes} B stacked) "
+              f"vs {t}; bit-identical per tenant: "
+              f"{'yes' if ident else 'NO'}", flush=True)
+        print(f"tenants: wall {fused_s:.4f} s fused vs {seq_s:.4f} s "
+              f"sequential ({seq_s / max(fused_s, 1e-9):.2f}x — dispatch "
+              "overhead is the TPU-tunnel prize; CPU mostly proves the "
+              "ledger)", flush=True)
 
     if d.spread_pre.shape[0]:
         timed("sp_fetch_s", lambda: np.array(_pack_spread(
