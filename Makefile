@@ -14,13 +14,14 @@ SHELL := /bin/bash
 
 .PHONY: test tier1 fault-smoke shortlist-smoke trace-smoke slo-smoke \
         churn-smoke overload-smoke loop-smoke index-smoke journal-smoke \
-        fleet-smoke tenant-smoke profile-smoke start start-remote \
+        fleet-smoke tenant-smoke auction-smoke profile-smoke start \
+        start-remote \
         start-client-engine \
         demo docs \
         bench bench_sharded bench-cpu bench-pipeline bench-residency \
         bench-shortlist bench-trace bench-slo bench-churn bench-overload \
         bench-deviceloop bench-index bench-coldstart bench-journal \
-        bench-fleet bench-tenants \
+        bench-fleet bench-tenants bench-auction \
         bench-check dryrun dryrun-dcn soak soak-faults soak-churn \
         soak-overload
 
@@ -145,6 +146,20 @@ tenant-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tenants.py -x -q \
 	  -p no:cacheprovider -p no:randomly
 
+# Fast deterministic auction-unification suite (~60 s): auction
+# decisions bit-identical with the order-free debit mirror carrying
+# ``free`` across batches (sync/pipelined × upload/resident), auction
+# tranches fusing into the work ring (ragged tails + fault break-outs
+# recovered bit-identically), the bid shortlist's certify-or-repair
+# contract at the op and engine level (plateau zero-repair, adversarial
+# contention repairs counted), and the nomination-window carry. A
+# tier-1 prerequisite after tenant-smoke: the auction path now rides
+# the same carry/ring/shortlist seams the greedy path does, and none
+# of them may change a decision.
+auction-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_auction.py -x -q \
+	  -p no:cacheprovider -p no:randomly
+
 # The EXACT ROADMAP tier-1 verify command (dots count + exit code
 # preserved) — what the driver runs after every PR; run it locally
 # before shipping. shortlist-smoke runs first: the arbitration
@@ -160,9 +175,12 @@ tenant-smoke:
 # a decision); fleet-smoke after journal-smoke (lease takeovers journal
 # their provenance through the recorder); tenant-smoke after
 # fleet-smoke (the fused-tenant mux must never change a decision
-# either).
+# either); auction-smoke after tenant-smoke (the auction path now
+# shares the carry/ring/shortlist seams and must stay bit-identical
+# across them).
 tier1: shortlist-smoke trace-smoke slo-smoke overload-smoke loop-smoke \
-       index-smoke journal-smoke fleet-smoke tenant-smoke churn-smoke
+       index-smoke journal-smoke fleet-smoke tenant-smoke auction-smoke \
+       churn-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -305,6 +323,7 @@ bench-check:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_journal.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_fleet.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_tenants.py --check
+	JAX_PLATFORMS=cpu $(PY) tools/bench_auction.py --check
 
 # Persistent device-loop before/after (the committed
 # BENCH_DEVICELOOP.json): interleaved off/on min-of-4 rounds of the
@@ -366,6 +385,21 @@ bench-fleet:
 # bench-tenants) so `make bench-check` gates them.
 bench-tenants:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_tenants.py
+
+# Auction-mode unification before/after (the committed
+# BENCH_AUCTION.json): interleaved split/unified min-of-4 rounds of the
+# streaming phase with MINISCHED_ASSIGNMENT=auction in both — the
+# order-free debit mirror's residency carry (steady-state dynamic h2d
+# per batch down ≥10×, batch 0 excluded), auction tranches fusing into
+# the depth-8 ring (steps_dispatched per bound pod down ≥2×), the bid
+# shortlist engaged with zero certification desyncs, a paired
+# identical-workload run diffing every placement, and an
+# auction_mirror:corrupt round proving the carry cross-check detects a
+# scribbled mirror with placements unchanged. Stable stream keys append
+# to BENCH_LEDGER.json (source bench-auction) so `make bench-check`
+# gates them.
+bench-auction:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_auction.py
 
 # Cross-process compile-cache proof (the committed BENCH_COLDSTART.json;
 # ROADMAP cold-start item): two child processes share one

@@ -1087,6 +1087,11 @@ def engine_bench(n_nodes, n_pods, make_nodes, make_pods, plugins,
                               # (tools/bench_deviceloop.py toggles them)
                               device_loop=os.environ.get(
                                   "MINISCHED_DEVICE_LOOP", "0") == "1",
+                              # assignment strategy likewise
+                              # (tools/bench_auction.py runs the
+                              # auction path through the same harness)
+                              assignment=os.environ.get(
+                                  "MINISCHED_ASSIGNMENT", "greedy"),
                               loop_depth=int(os.environ.get(
                                   "MINISCHED_LOOP_DEPTH", "8")),
                               # maintained-index knobs likewise

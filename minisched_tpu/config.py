@@ -44,7 +44,10 @@ class SchedulerConfig:
     # Host-selection strategy: "greedy" (priority-faithful sequential
     # semantics; scan or pallas kernel) or "auction" (parallel bidding
     # rounds, aggregate-score-seeking — ops/auction.py docstring lists
-    # the semantic deviations).
+    # the semantic deviations). Both families ride the same residency
+    # carry, work ring, and shortlist seams (the order-free debit
+    # mirror — engine/scheduler._DeviceResidency — made the host
+    # mirror assignment-order-independent); MINISCHED_ASSIGNMENT.
     assignment: str = "greedy"
     seed: int = 0                    # PRNG seed for tie-breaking parity
     bind_workers: int = 16           # async binding-cycle pool size
@@ -130,8 +133,11 @@ class SchedulerConfig:
     # rescan where it fails — decisions are bit-identical to the full
     # scan (tests/test_shortlist.py). False (MINISCHED_SHORTLIST=0)
     # restores the PR-2 full-width scan — the regression-triage
-    # fallback. Greedy-only; auction, mesh, and enforced-domain-caps
-    # batches keep full rows regardless.
+    # fallback. The auction path takes its own analog
+    # (ops/bid_select.auction_assign_shortlist: per-pod top-K bid rows
+    # with the same certify-or-repair contract over the price
+    # dynamics); mesh and enforced-domain-caps batches keep full rows
+    # regardless.
     shortlist: bool = True
     # Shortlist width K (MINISCHED_SHORTLIST_K): per-step sequential
     # argmax width, clamped to the node pad. 128 cuts the 50k-node
@@ -216,7 +222,11 @@ class SchedulerConfig:
     # mismatch counts a desync, forces a full re-upload, and signals the
     # supervisor. 0 disables (the versioned delta protocol already makes
     # host-side desync structurally impossible — this check covers the
-    # DEVICE side of the carry, e.g. a defective scatter/backend).
+    # DEVICE side of the carry, e.g. a defective scatter/backend, plus
+    # the two cases the order-free debit mirror cannot prove or heal:
+    # mirror arithmetic OUTSIDE the integer-valued-f32 resource grammar,
+    # and a mis-TARGETED mirror write on a row no correction delta will
+    # ever visit — what the auction_mirror fault gate exercises).
     resident_check_every: int = 0
 
 
@@ -252,6 +262,7 @@ def config_from_env() -> SchedulerConfig:
         batch_window_s=float(_req("MINISCHED_BATCH_WINDOW", "0.0")),
         batch_idle_s=float(_req("MINISCHED_BATCH_IDLE", "0.0")),
         explain=_req("MINISCHED_EXPLAIN", "0") == "1",
+        assignment=_req("MINISCHED_ASSIGNMENT", "greedy"),
         seed=int(_req("MINISCHED_SEED", "0")),
         backoff_initial_s=float(_req("MINISCHED_BACKOFF_INITIAL", "1.0")),
         backoff_max_s=float(_req("MINISCHED_BACKOFF_MAX", "10.0")),

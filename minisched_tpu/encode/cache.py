@@ -632,7 +632,8 @@ class NodeFeatureCache:
         which bind accounting must instead route through the claim table.
 
         Pods without volumes or host ports take a vectorized fast path:
-        one unbuffered ``np.subtract.at`` for the free-capacity update and
+        one order-free per-node debit aggregate for the free-capacity
+        update (the residency mirror's I1 form) and
         array-indexed fills of the assigned-pod corpus, with namespace
         hashes and label-pair rows memoized per distinct value (a 10k-pod
         deployment shares one label signature, so the per-pod Python work
@@ -678,9 +679,17 @@ class NodeFeatureCache:
                                  count=len(fast))
                 ii = np.fromiter((i for _, i, _, _ in fast), dtype=np.int64,
                                  count=len(fast))
-                # Several pods may land on one node row — unbuffered
-                # subtract so duplicates accumulate.
-                np.subtract.at(self._feats.free, ii, reqs[kk])
+                # Several pods may land on one node row — fold them as
+                # the ORDER-FREE per-node aggregate (sum the debits per
+                # node, one subtract per node), the same form the
+                # residency mirror replays (_DeviceResidency I1). Host
+                # truth and mirror then perform the identical op
+                # sequence by construction, independent of batch order.
+                uniq = np.unique(ii)
+                agg = np.zeros((uniq.shape[0], reqs.shape[1]),
+                               dtype=self._feats.free.dtype)
+                np.add.at(agg, np.searchsorted(uniq, ii), reqs[kk])
+                self._feats.free[uniq] -= agg
                 self._mark_dyn_locked(ii)
                 a_rows = self._a_free[-len(fast):]
                 del self._a_free[-len(fast):]

@@ -215,7 +215,7 @@ class _InflightBatch:
                  "h2d0", "fetch0", "h2d1", "fetch1", "sl_repairs", "gap",
                  "step_share", "index_packed_dev", "index_free_after",
                  "index_served", "scored_rows", "loop_slot",
-                 "index_mode", "tenant_ticket")
+                 "index_mode", "tenant_ticket", "nom_reserved")
 
     def __init__(self):
         self.failures: List[tuple] = []  # (qpi, plugins, message, retryable)
@@ -252,6 +252,11 @@ class _InflightBatch:
         # chain (_DeviceResidency) — its free_after must be carried and
         # its debits replayed into the host mirror at resolve time.
         self.res_carried = False
+        # Nomination-window carry (device (N,R) or None): the
+        # reservation correction the prepare phase subtracted from the
+        # carried free INPUT; note_debits adds it back before adopting
+        # free_after so the chain keeps un-nominated cache truth.
+        self.nom_reserved = None
         # Maintained-index batch (engine._ArbIndex): the fused
         # [chosen|assigned|repaired] device buffer the resolve phase
         # settles, and the indexed scan's carried free_after (adopted by
@@ -331,14 +336,24 @@ class _DeviceResidency:
     tests/test_device_residency.py):
 
       I1. the host mirrors equal the device arrays numerically at all
-          times (±0.0 aside): the replay of the greedy scan's debits —
-          ``np.subtract.at`` in pod order over the same f32 values —
-          performs the identical IEEE op sequence the scan's
-          ``free.at[row].add(-req)`` carry performs. This is why
-          residency is gated to the greedy assignment family (lax.scan,
-          pallas kernel, sharded chunked-gather scan — all debit in pod
-          order); the auction's parallel bidding rounds have no such
-          order.
+          times (±0.0 aside): the mirror replay is an ORDER-FREE
+          per-node commutative debit aggregate — the batch's requests
+          are summed per debited node column (``np.add.at`` into a
+          zeroed aggregate) and applied as ONE subtract per node.
+          Under the system's resource grammar every request/capacity
+          component is an integer-valued f32 well inside the 2**24
+          exact-integer window, so the aggregate equals ANY
+          application order bitwise: the greedy scan's sequential
+          pod-order ``free.at[row].add(-req)`` carry, and the
+          auction's round-order one-winner-per-node einsum subtracts
+          alike. This is what lifts the old greedy-only residency
+          gate — the auction's parallel bidding rounds have no pod
+          order, and with a commutative mirror they don't need one.
+          Outside the exact-integer grammar the equality is verified
+          rather than structural: the MINISCHED_RESIDENT_CHECK_EVERY
+          cross-check compares mirror against device at cadence, and
+          a mismatch walks the repair ladder (counted desync → full
+          re-upload → supervised replay), never a silent divergence.
       I2. after ``attach`` the device arrays equal the cache's truth on
           every row, so the step consumes exactly what the
           MINISCHED_DEVICE_RESIDENT=0 upload-every-batch path would
@@ -452,22 +467,46 @@ class _DeviceResidency:
         eng._res_count(resync=False, h2d=h2d)
         return nf._replace(free=self.free_dev, used_ports=self.ports_dev)
 
-    def note_debits(self, chosen, assigned, requests, free_after_dev):
-        """Record the step's device-side debits: replay them into the
-        host mirror (exact — see I1) and adopt ``free_after`` as the
-        carried device array. Must run on the PRE-residual-merge
-        chosen/assigned (the carried array is the MAIN step's output;
-        residual/repair placements reach the device as next-batch
-        corrections via the cache listener)."""
+    def note_debits(self, chosen, assigned, requests, free_after_dev,
+                    add_back=None):
+        """Record the step's device-side debits: fold them into the
+        host mirror as the per-node commutative aggregate (exact — see
+        I1) and adopt ``free_after`` as the carried device array. Must
+        run on the PRE-residual-merge chosen/assigned (the carried
+        array is the MAIN step's output; residual/repair placements
+        reach the device as next-batch corrections via the cache
+        listener). ``add_back`` (device (N,R), optional) reverses a
+        pre-step nomination-reservation correction (the carry subtracted
+        it from the step's ``free`` input only): it is added back on
+        device so the adopted array returns to un-nominated cache truth
+        — the plane the mirror tracks."""
         rows = chosen[assigned].astype(np.int64)
         if rows.size:
             reqs = requests[assigned]
             uniq = np.unique(rows)
             self.pending_pre = self.mirror_free[uniq].copy()
             self.pending_rows = uniq
-            # Unbuffered subtract applies per index IN ORDER — the same
-            # f32 op sequence as the scan's sequential carry.
-            np.subtract.at(self.mirror_free, rows, reqs)
+            # Order-free commutative aggregate: sum each node's debits,
+            # then ONE subtract per debited node. Bitwise equal to the
+            # device's own application order under the exact-integer
+            # grammar (I1); the cadence cross-check covers the rest.
+            agg = np.zeros((uniq.shape[0], reqs.shape[1]),
+                           dtype=self.mirror_free.dtype)
+            np.add.at(agg, np.searchsorted(uniq, rows), reqs)
+            self.mirror_free[uniq] -= agg
+            if FAULTS.hit("auction_mirror") == "corrupt":
+                # Mis-TARGETED aggregate: a phantom debit lands on a
+                # node row the batch never debited (the scatter
+                # off-by-one failure mode of the order-free replay).
+                # Deliberately NOT a mis-valued debit on a debited row —
+                # the host touches those rows at bind, so the next
+                # attach overwrites the mirror from delta truth and the
+                # scribble self-heals (the delta protocol working, not a
+                # detector gap). The mis-target hits a row no delta will
+                # ever correct; it is invisible to every per-decision
+                # certificate and ONLY the MINISCHED_RESIDENT_CHECK_EVERY
+                # carry cross-check can see it.
+                self.mirror_free[-1, 0] -= 1.0
             if not np.isfinite(self.mirror_free[uniq]).all():
                 # Supervisor NaN detector: a non-finite request/feature
                 # reached the carried chain — abort before the poisoned
@@ -477,6 +516,10 @@ class _DeviceResidency:
                     "non-finite free capacity after device-debit replay")
         else:
             self.pending_rows = self.pending_pre = None
+        if add_back is not None:
+            # Exact under the integer grammar: (carried - reserved)
+            # - batch_debits + reserved == carried - batch_debits.
+            free_after_dev = free_after_dev + add_back
         self.free_dev = free_after_dev
 
     def note_ports(self, rows: np.ndarray, ports: np.ndarray) -> int:
@@ -1136,16 +1179,17 @@ class Scheduler:
                     f"{self.config.assignment!r}; expected 'greedy' or "
                     "'auction'")
         self._sharded_step = None
-        # Shortlist-compressed arbitration (ops/select.py): greedy-only
-        # and single-device-only — the auction's bidding rounds and the
-        # mesh's static shardings keep full (P,N) rows (documented gates;
-        # decisions are knob-independent there by construction). None =
-        # off. Mutated only on the scheduling thread: the certification
-        # cross-check (_check_shortlist) permanently reverts a desynced
-        # engine to the full-width scan.
+        # Shortlist-compressed arbitration: single-device-only — the
+        # mesh's static shardings keep full (P,N) rows (documented
+        # gate; decisions are knob-independent there by construction).
+        # The greedy scan takes ops/select.greedy_assign_shortlist; the
+        # auction takes the bid shortlist (ops/bid_select) with the
+        # same certify-or-repair contract. None = off. Mutated only on
+        # the scheduling thread: the certification cross-check
+        # (_check_shortlist) permanently reverts a desynced engine to
+        # the full-width scan.
         self._shortlist_k = (self.config.shortlist_k
                              if (self.config.shortlist
-                                 and self.config.assignment == "greedy"
                                  and self._mesh is None)
                              else None)
         self._sl_check_tick = 0
@@ -1246,20 +1290,22 @@ class Scheduler:
         self._slim = bool(self.config.device_resident)
         self._slim_verified = False
         # Device-resident DYNAMIC leaves (free/used_ports loop-carried
-        # as the next batch's input; see _DeviceResidency). Gated to the
-        # greedy assignment family — the host replay that keeps the
-        # mirror exact depends on the scan's pod-order debit sequence,
-        # which the auction's parallel bidding rounds don't have.
-        # Touched only by the scheduling thread.
+        # as the next batch's input; see _DeviceResidency). Open to the
+        # greedy scan AND the auction: the mirror replay is an
+        # order-free per-node debit aggregate (I1), so no assignment
+        # order is assumed. Touched only by the scheduling thread.
         self._residency = None
-        if self.config.device_resident and self.config.assignment == "greedy":
+        if self.config.device_resident:
             self._residency = _DeviceResidency(
                 self.cache.register_dyn_listener())
         # Persistent on-device engine loop (MINISCHED_DEVICE_LOOP): the
         # multi-batch fused-dispatch tranche machinery
-        # (_maybe_run_tranche). Gated to the greedy single-device
-        # non-explain engine — the same family as residency, for the
-        # same carry-replay reason. The loop-private dyn listener feeds
+        # (_maybe_run_tranche). Gated to the single-device non-explain
+        # engine; the greedy scan and the auction are both ring-eligible
+        # — the between-slot validator replays debits with the same
+        # order-free aggregate as residency's I1 (auction slot k+1's
+        # prices start fresh, but its ``free`` input IS slot k's
+        # ``free_after``). The loop-private dyn listener feeds
         # the between-slot divergence validator (cache.drain_dyn_rows);
         # it is never handed to snapshot_resident, so the residency
         # epoch protocol is untouched. _loop_cooldown is the ladder's
@@ -1267,7 +1313,6 @@ class Scheduler:
         # engagement for probation_batches considerations (slot-level
         # batch faults ride the existing degradation ladder unchanged).
         self._loop_enabled = (self.config.device_loop
-                              and self.config.assignment == "greedy"
                               and self._mesh is None
                               and not self.config.explain)
         self._loop_listener = (self.cache.register_dyn_listener()
@@ -1279,8 +1324,11 @@ class Scheduler:
         # (encode/cache.register_index_listener). Gated to the greedy
         # single-device non-explain engine — the same family as
         # residency/loop — AND to index-eligible profiles: every active
-        # plugin column-local, no topology/affinity state, scorers on
-        # the identity normalize (ops/index.index_eligible). Decisions
+        # plugin column-local, no topology/affinity state, scorer
+        # normalizes row-local — identity or a declared
+        # normalize_row_local override; the maintained-max split stores
+        # pre-normalize planes and re-derives row reductions from them
+        # (ops/index.index_eligible). Decisions
         # are bit-identical index on/off: an unassigned live row
         # discards the whole batch's speculative result and the
         # original full step re-runs with the same PRNG draw.
@@ -1293,8 +1341,9 @@ class Scheduler:
                     self.config.index_k, self.config.index_classes)
             else:
                 log.info("MINISCHED_INDEX=1 but profile %s is not "
-                         "index-eligible (topology/affinity state or a "
-                         "row-normalizing scorer); keeping the per-batch "
+                         "index-eligible (topology/affinity state, a "
+                         "non-column-local plugin, or an undeclared "
+                         "normalize override); keeping the per-batch "
                          "dataflow", [p.name for p in plugin_set.plugins])
         # Rebuild-ladder cooldown (the index→rebuild→full-rescore rung
         # composed with the PR 3 ladder): a rebuild storm parks the
@@ -1428,6 +1477,11 @@ class Scheduler:
             "supervisor_escalations": 0, "supervisor_recoveries": 0,
             "quarantined_batches": 0, "worker_deaths": 0,
             "resident_checks": 0, "residency_desyncs": 0,
+            # Nomination-window carry: batches whose outstanding
+            # preemption reservations rode the carried chain as an
+            # order-free correction instead of forcing the
+            # upload-every-batch fallback.
+            "residency_nomination_carries": 0,
             # Shortlist-compressed arbitration observability.
             # shortlist_repairs counts full-row repair RESCAN EVENTS —
             # the main step, the residual pass, and every spread-repair
@@ -2916,9 +2970,11 @@ class Scheduler:
                     self.queue.requeue_backoff(qpi)
 
             # Validation: did host truth move off the carried chain?
-            # Replay this slot's device debits into the mirror (pod
-            # order — bitwise the scan's op sequence AND the cache's
-            # bulk-assume subtract), then compare every row the cache
+            # Fold this slot's device debits into the mirror as the
+            # order-free per-node aggregate (_DeviceResidency I1 —
+            # bitwise the greedy scan's sequential carry AND the
+            # auction's round-order einsum subtracts under the
+            # exact-integer grammar), then compare every row the cache
             # mutated since the last slot against it. Any mismatch —
             # assume miss, failed bind, informer churn, revocation —
             # means slot j+1's decisions were computed against inputs
@@ -2927,8 +2983,12 @@ class Scheduler:
             ch, asg = tup[0], tup[1]
             rows_deb = ch[asg].astype(np.int64)
             if rows_deb.size:
-                np.subtract.at(mirror, rows_deb,
-                               inf.eb.pf.requests[asg])
+                uniq_deb = np.unique(rows_deb)
+                agg = np.zeros((uniq_deb.shape[0], mirror.shape[1]),
+                               dtype=mirror.dtype)
+                np.add.at(agg, np.searchsorted(uniq_deb, rows_deb),
+                          inf.eb.pf.requests[asg])
+                mirror[uniq_deb] -= agg
             diverged = bool(
                 rows_deb.size
                 and not np.isfinite(mirror[np.unique(rows_deb)]).all())
@@ -3220,18 +3280,18 @@ class Scheduler:
         # resident free/used_ports arrays are corrected in place.
         cached = self._nf_static_device
         res = self._residency
-        res_live = (res is not None and not self._nominations
-                    and self._sup.allows_residency())
+        res_live = res is not None and self._sup.allows_residency()
         if res is not None and not res_live:
-            # Nominated-capacity debits modify the step's free input;
-            # the carried chain cannot represent a reservation that
-            # expires without any cache mutation — fall back to the
-            # upload-every-batch path until the reservations drain.
-            # Supervisor degradation (level ≥ "upload") drops the carry
-            # the same way; probation re-escalation re-establishes it
-            # through a counted full re-upload.
-            res.drop("nominated-capacity reservations outstanding"
-                     if self._nominations else "supervisor degradation")
+            # Supervisor degradation (level ≥ "upload") drops the carry;
+            # probation re-escalation re-establishes it through a
+            # counted full re-upload. (Nominated-capacity reservations
+            # no longer force this fallback: they ride the carry as an
+            # order-free per-node correction below — subtracted from the
+            # step's free INPUT only, added back before the carried
+            # adoption, so the chain keeps representing un-nominated
+            # cache truth and a reservation that expires without any
+            # cache mutation costs nothing.)
+            res.drop("supervisor degradation")
         if res_live:
             nf, names, static_v, row_incs, dyn_delta = (
                 self.cache.snapshot_resident(
@@ -3309,18 +3369,29 @@ class Scheduler:
         # preemptor — reservations of pods NOT in this batch are debited
         # from the snapshot's free so the batch cannot steal them; a
         # nominee in the batch sees its own reservation as available.
+        nom_reserved_dev = None
         if self._nominations:
             reserved = self._nomination_debits(
                 {q.pod.key for q in batch}, names, nf)
             if reserved is not None:
-                nf = nf._replace(free=nf.free - reserved)
                 if carried:
-                    # Unreachable by thread discipline (nominations are
-                    # granted on this thread, in resolve) — but a debit
-                    # baked into the carried chain would desync it, so
-                    # fail safe.
-                    carried = False
-                    res.drop("nomination debit appeared mid-prepare")
+                    # Nomination-window carry: apply the reservation as
+                    # an order-free per-node correction to the CARRIED
+                    # free — a fresh device array feeds the step while
+                    # res.free_dev keeps the un-nominated truth the
+                    # mirror tracks. The resolve phase adds the same
+                    # correction back (note_debits add_back) before
+                    # adopting free_after, an exact round-trip under
+                    # the integer grammar, so the chain never learns
+                    # the reservation existed. (The cross-check above
+                    # already ran against the pre-correction arrays.)
+                    nom_reserved_dev = jax.device_put(
+                        reserved, self._nf_sharding("free"))
+                    nf = nf._replace(free=nf.free - nom_reserved_dev)
+                    self._sup_count("residency_nomination_carries")
+                    self._count_h2d(reserved.nbytes)
+                else:
+                    nf = nf._replace(free=nf.free - reserved)
         t_encode = time.perf_counter()
 
         self._step_counter += 1
@@ -3424,6 +3495,7 @@ class Scheduler:
         inf.eb, inf.names, inf.row_incs = eb, names, row_incs
         inf.nf, inf.af, inf.key, inf.sample_k = nf, af, key, sample_k
         inf.res_carried = carried
+        inf.nom_reserved = nom_reserved_dev
         inf.decision = decision
         inf.packed_dev, inf.spread_dev = packed_dev, spread_dev
         inf.t0, inf.t_encode = t0, t_encode
@@ -3856,7 +3928,8 @@ class Scheduler:
             res = self._residency
             res.note_debits(chosen, assigned, eb.pf.requests,
                             decision.free_after if decision is not None
-                            else inf.index_free_after)
+                            else inf.index_free_after,
+                            add_back=inf.nom_reserved)
             # ROADMAP residency follow-up (d): model the batch's
             # host-port insertions on the device-resident used_ports
             # (and its mirror, identical integer op order) so a
@@ -4910,7 +4983,12 @@ class Scheduler:
                     drop.append(key)
                     continue
                 if debits is None:
-                    debits = np.zeros_like(nf.free)
+                    # Explicit host allocation: nf.free may be the
+                    # device-carried array (nomination-window carry) and
+                    # zeros_like would round-trip it through the host.
+                    debits = np.zeros(
+                        (int(nf.free.shape[0]), int(nf.free.shape[1])),
+                        dtype=np.float32)
                 debits[j] += req
             for k in drop:
                 del self._nominations[k]

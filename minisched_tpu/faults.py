@@ -115,12 +115,16 @@ log = logging.getLogger(__name__)
 
 #: The gate catalog; hit() rejects unknown names so a typo in a rule or a
 #: call site cannot silently never fire.
-# "lease" appends LAST: per-gate PRNG streams seed by catalog index,
-# so appending (never inserting) keeps every existing gate's firing
-# pattern stable under a fixed seed.
+# "auction_mirror" appends LAST: per-gate PRNG streams seed by catalog
+# index, so appending (never inserting) keeps every existing gate's
+# firing pattern stable under a fixed seed. auction_mirror sits inside
+# _DeviceResidency.note_debits: corrupt scribbles one node's aggregate
+# debit — certificate-invisible by construction (the decision already
+# left the device), so only the MINISCHED_RESIDENT_CHECK_EVERY
+# cross-check can catch it.
 GATES = ("step", "fetch", "residency", "shortlist_repair", "commit",
          "bind", "informer", "http", "checkpoint", "lifecycle",
-         "admission", "index", "journal", "lease")
+         "admission", "index", "journal", "lease", "auction_mirror")
 
 _ACTIONS = ("err", "die", "corrupt", "stall")
 

@@ -48,17 +48,20 @@ per-pod argmax with random tie-break, minisched/minisched.go:304-325);
 this mode exists for the gang/coscheduling scale target (BASELINE.md
 config 5).
 
-SHORTLIST GATE (explicit): the auction keeps full (P,N) rows and does
-NOT compose with the shortlist-compressed arbitration
-(ops/select.greedy_assign_shortlist) — prices are global per-node state
-that every bidder reads and every round mutates, so a per-pod top-K
-gather would change which node wins a contended bid (no certificate can
-patch that after the fact the way the greedy scan's repair rescan can).
-``build_step(assignment="auction", shortlist=K)`` therefore raises
-rather than silently ignoring the knob, and the engine's
-``shortlist_width`` gauge reads 0 in auction mode. The rounds are
-already parallel — the shortlist exists to shrink the greedy scan's
-SEQUENTIAL critical path, which the auction does not have.
+BID SHORTLIST (ops/bid_select.py): the auction composes with the
+shortlist knob through its own certify-or-repair variant,
+``auction_assign_shortlist`` — per-pod top-K candidate compression of
+the round's value rows with a price-plateau certificate (prices are
+>= 0 within a band and masking only lowers values, so a node outside
+the shortlist is worth at most the K-th score; a round whose best or
+second-best cannot be proven inside the shortlist reruns the full row
+under ``lax.cond``, counted per pod). Decisions are bit-identical to
+this function for any K — see that module's docstring for the proof
+sketch. ``build_step(assignment="auction", shortlist=K)`` selects it,
+and the engine's ``shortlist_width`` gauge reports K in auction mode
+like any other. The dense einsum debit/price updates stay (P,N); the
+compression targets the per-round value reductions, which at
+N >> K dominate the round.
 
 Tie-break contract: every random-looking quantity below comes from
 ops/select.tie_noise_from_cols — the single definition of the

@@ -1,0 +1,210 @@
+"""Bid shortlist — certify-or-repair top-K compression for the auction.
+
+``ops/select.greedy_assign_shortlist`` shrinks the greedy scan's
+per-step argmax from N columns to a per-pod top-K candidate gather and
+keeps decisions bit-identical through a certificate: whenever the
+compressed view cannot PROVE it saw the true winner, the full row is
+rescanned under ``lax.cond`` and the repair is counted. This module is
+the auction analog (ISSUE 17 tentpole (c)): the same shortlist, the
+same certify-or-repair contract, applied to the Bertsekas bidding
+rounds of ``ops/auction.auction_assign``.
+
+What compresses and what stays dense
+------------------------------------
+A bidding round's per-pod work is the value row ``score - price`` and
+its top-2 reduction (v_best / argmax / v2). Those are the (P,N) rows
+the shortlist shrinks to (P,K): candidate scores are gathered ONCE
+(``lax.top_k`` over the noise-folded scores — the identical fold
+``auction_assign`` applies, so candidate values are bitwise the full
+row's values at those columns) and each round reduces over K. The
+winner-resolution one-hot and the einsum debit/price updates stay
+dense (P,N): they are MXU-friendly matmuls XLA tiles well, and making
+them sparse is exactly the scatter lowering the auction module's
+NOTE warns against.
+
+The certificate
+---------------
+Let ``kth`` be the K-th largest noise-folded score of the row. Within
+a priority band prices start at 0 and only rise, and the feasibility /
+node-open masking only LOWERS a value (to NEG), so every node outside
+the shortlist is worth at most its raw score <= kth at all times. With
+``m`` the best and ``v2_s`` the second-best candidate value this round
+(second-best = best with the winning COLUMN excluded, the full row's
+v2 rule), the round is certified for a pod iff::
+
+    (m > kth) & (v2_s >= kth)    or    kth <= NEG
+
+* ``m > kth`` (strict): every full-row value outside the shortlist is
+  <= kth < m, so the true argmax lies inside the shortlist; taking the
+  lowest tied candidate COLUMN reproduces the dense argmax's
+  first-occurrence rule exactly.
+* ``v2_s >= kth``: the full row's second-best is
+  max(v2_s, outside-max) and outside-max <= kth <= v2_s, so the
+  Bertsekas margin gamma = v_best - v2 + eps is exact.
+* ``kth <= NEG``: fewer than K feasible columns exist — the shortlist
+  IS the row.
+
+A bid that would land outside its shortlist (an uncertified pod) runs
+the full-row round under ``lax.cond``: the dense (P,N) value matrix is
+computed and the uncertified pods' (v_best, best, v2) are merged from
+it. The repair is per-pod accumulated into ``repaired`` — the same
+plane ``greedy_assign_shortlist`` reports — so the engine's
+``shortlist_repairs`` metrics, the overload tuner's K-dial, and the
+``_check_shortlist`` full-row cross-check all ride unchanged.
+
+Bit-identity (the contract tests/test_auction.py pins): for every
+round, every ACTIVE pod's (v_best, best, v2) equals the dense round's
+— certified pods by the proof above, uncertified pods by direct
+computation — and every other state update (winner ranks, capacity
+check, debits, prices, stale/band control) is the identical op
+sequence on identical inputs. Induction over rounds gives
+``auction_assign_shortlist(..., k) == auction_assign(...)`` bitwise
+for any K.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .auction import STALE_ROUNDS
+from .select import (NEG, ShortlistAssignResult, seed_from_key,
+                     tie_noise_from_cols)
+
+
+def auction_assign_shortlist(scores: jnp.ndarray, requests: jnp.ndarray,
+                             free0: jnp.ndarray, key: jax.Array,
+                             eps: float = 1e-2,
+                             max_rounds: Optional[int] = None,
+                             priority=None,
+                             k: int = 128) -> ShortlistAssignResult:
+    """``auction.auction_assign`` through a per-pod top-K bid shortlist.
+
+    Same signature plus ``k`` (the shortlist width; any K is exact —
+    the certificate repairs a too-narrow one, counted). Returns
+    ShortlistAssignResult so gang_admission and the engine's repair
+    accounting treat it exactly like the greedy shortlist scan.
+    """
+    P, N = scores.shape
+    k = int(min(k, N))
+    if max_rounds is None:
+        max_rounds = max(256, (1 + STALE_ROUNDS) * P + STALE_ROUNDS)
+    seed = seed_from_key(key)
+    rows = jnp.arange(P, dtype=jnp.int32)
+
+    # Identical noise fold to auction_assign — the shared tie-break
+    # lattice, folded ONCE, so gathered candidate values are bitwise
+    # the dense row's values at those columns.
+    pn_noise = tie_noise_from_cols(
+        seed, rows[:, None],
+        jax.lax.broadcasted_iota(jnp.uint32, (1, N), 1))       # (P,N)
+    scores = jnp.where(scores > NEG, scores + pn_noise * eps, NEG)
+    feasible = jnp.any(scores > NEG, axis=1)                   # (P,)
+
+    # The shortlist: top-K noise-folded scores per pod, selected once.
+    # s_vals[p, i] == scores[p, cand[p, i]] bitwise (top_k gathers).
+    s_vals, cand = jax.lax.top_k(scores, k)                    # (P,K)
+    cand = cand.astype(jnp.int32)
+    kth = s_vals[:, -1]                                        # (P,)
+
+    NEG_BAND = jnp.int32(-(2 ** 31) + 1)
+    prio = (jnp.zeros((P,), jnp.int32) if priority is None
+            else priority.astype(jnp.int32))
+
+    def next_band(chosen, below):
+        cand_b = jnp.where(feasible & (chosen < 0) & (prio < below),
+                           prio, NEG_BAND)
+        return jnp.max(cand_b)
+
+    def cond(state):
+        chosen, free, prices, rnd, stale, band, repaired = state
+        return (rnd < max_rounds) & (band > NEG_BAND)
+
+    hi = jax.lax.Precision.HIGHEST
+
+    def body(state):
+        chosen, free, prices, rnd, stale, band, repaired = state
+        active = (chosen < 0) & (prio == band)                 # (P,)
+        bidder = active & feasible
+        min_req = jnp.min(jnp.where(bidder[:, None], requests, jnp.inf),
+                          axis=0)                              # (R,)
+        node_open = jnp.all(free >= min_req, axis=1)           # (N,)
+
+        # -- compressed value rows: (P,K) instead of (P,N) -------------
+        v_cand = jnp.where(
+            (s_vals > NEG) & active[:, None] & node_open[cand],
+            s_vals - prices[cand], NEG)                        # (P,K)
+        m = jnp.max(v_cand, axis=1)                            # (P,)
+        # Dense argmax takes the FIRST maximal column; with every
+        # full-row maximum certified inside the shortlist, the lowest
+        # tied candidate column is that same node.
+        best_s = jnp.min(jnp.where(v_cand == m[:, None], cand,
+                                   jnp.int32(N)), axis=1)      # (P,)
+        v2_s = jnp.max(jnp.where(cand == best_s[:, None], NEG, v_cand),
+                       axis=1)                                 # (P,)
+        cert = ((m > kth) & (v2_s >= kth)) | (kth <= NEG)
+        uncert = active & ~cert                                # (P,)
+
+        def full_round(_):
+            # A bid would (or might) land outside its shortlist: run
+            # the dense round and merge the uncertified pods' results.
+            value = jnp.where(
+                (scores > NEG) & active[:, None] & node_open[None, :],
+                scores - prices[None, :], NEG)                 # (P,N)
+            v_best_f = jnp.max(value, axis=1)
+            best_f = jnp.argmax(value, axis=1).astype(jnp.int32)
+            v2_f = jnp.max(jnp.where(
+                jax.nn.one_hot(best_f, N, dtype=bool), NEG, value),
+                axis=1)
+            return (jnp.where(uncert, v_best_f, m),
+                    jnp.where(uncert, best_f, best_s),
+                    jnp.where(uncert, v2_f, v2_s))
+
+        v_best, best, v2 = jax.lax.cond(
+            jnp.any(uncert), full_round, lambda _: (m, best_s, v2_s),
+            operand=None)
+        repaired = repaired | uncert
+
+        # -- identical to the dense round from here on -----------------
+        bid1h = jax.nn.one_hot(best, N, dtype=bool)            # (P,N)
+        has_bid = active & (v_best > NEG)
+        gamma = jnp.where(v2 > NEG, v_best - v2, 0.0) + eps    # (P,)
+
+        noise = tie_noise_from_cols(seed, rnd, rows.astype(jnp.uint32))
+        strength = jnp.where(has_bid, v_best, NEG) + noise * (eps * 0.5)
+        rank = jnp.argsort(jnp.argsort(strength)).astype(jnp.int32)
+        rank = jnp.where(has_bid, rank, -1)
+        node_best = jnp.max(jnp.where(bid1h, rank[:, None], -1),
+                            axis=0)                            # (N,)
+        win = has_bid & (rank == node_best[best])              # (P,)
+
+        wfits = jnp.all(free[best] >= requests, axis=1)        # (P,)
+        win_ok = win & wfits
+
+        chosen = jnp.where(win_ok, best, chosen)
+        free = free - jnp.einsum(
+            "pn,pr->nr", (bid1h & win_ok[:, None]).astype(jnp.float32),
+            requests, precision=hi)
+        prices = prices + jnp.einsum(
+            "pn,p->n", (bid1h & win[:, None]).astype(jnp.float32),
+            gamma, precision=hi)
+        stale = jnp.where(jnp.any(win_ok), jnp.int32(0), stale + 1)
+
+        band_left = jnp.any((chosen < 0) & feasible & (prio == band))
+        advance = (~band_left) | (stale >= STALE_ROUNDS)
+        band = jnp.where(advance, next_band(chosen, band), band)
+        stale = jnp.where(advance, jnp.int32(0), stale)
+        prices = jnp.where(advance, jnp.zeros_like(prices), prices)
+        return (chosen, free, prices, rnd + 1, stale, band, repaired)
+
+    chosen0 = jnp.full((P,), -1, jnp.int32)
+    prices0 = jnp.zeros((N,), jnp.float32)
+    band0 = jnp.max(jnp.where(feasible, prio, NEG_BAND))
+    repaired0 = jnp.zeros((P,), bool)
+    chosen, free, _p, _r, _s, _b, repaired = jax.lax.while_loop(
+        cond, body,
+        (chosen0, free0, prices0, jnp.int32(0), jnp.int32(0), band0,
+         repaired0))
+    return ShortlistAssignResult(chosen=chosen, assigned=chosen >= 0,
+                                 free_after=free, repaired=repaired)

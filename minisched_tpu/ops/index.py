@@ -55,13 +55,23 @@ column-local (its filter/score at node n reads only node n's feature
 column — the ``BatchedPlugin.column_local`` declaration), no plugin
 needs topology or node-affinity group state (those read the
 assigned-pod corpus / batch group tables, which move every batch), and
-every active SCORER keeps the identity normalize (a row-normalizer such
-as max_normalize_100 couples every column to the row max, so one
-changed node would invalidate the whole row — the maintained-max
-extension is a documented follow-up). Under these gates the cached
-class rows equal the step's ``masked_total`` rows bitwise: the evaluate
-twin below performs the identical op sequence (same AND-reduction over
-filters, same scorer order, same f32 accumulation) on the same inputs.
+every active SCORER's normalize is ROW-LOCAL (row i of its output
+reads only row i of its inputs — identity trivially, and any declared
+``normalize_row_local`` override such as TaintToleration's min-shift).
+Row-normalizers used to be excluded outright: one changed node column
+moves the row max/min and re-values the WHOLE row, which a
+column-scatter repair cannot express. The maintained-max split below
+removes that: the index stores the PRE-normalize planes — per-scorer
+raw scores (S,C,N) and the feasible mask (C,N), both genuinely
+column-local, repaired by the same column scatter — and derives the
+served ``score`` matrix by re-running normalize+weighted-sum over the
+full maintained planes after every repair. That finalize pass is pure
+elementwise math plus row reductions (the "maintained" row max/min —
+recomputed from truth, never incrementally nudged, so a repair that
+LOWERS the row extremum is exact too), zero plugin evaluations, and
+performs the identical op sequence as ops/pipeline's evaluate (same
+scorer order, same f32 accumulation); row-locality of normalize is
+what makes the class row equal the step's per-pod row bitwise.
 """
 from __future__ import annotations
 
@@ -79,10 +89,15 @@ from .select import NEG, greedy_assign_shortlist
 
 class IndexState(NamedTuple):
     """The device-resident index: per registered pod class, the CURRENT
-    masked-total score at every node column (NEG = infeasible), as of
-    the snapshot of the last build/refresh."""
+    pre-normalize truth planes (repaired by column scatter) plus the
+    served masked-total matrix derived from them, as of the snapshot of
+    the last build/refresh. ``score`` is a pure function of
+    ``(raw, feasible)`` — every mutation path re-derives it, so the
+    three planes can never disagree."""
 
-    score: jnp.ndarray  # (C,N) f32 masked_total per class row
+    raw: jnp.ndarray       # (S,C,N) f32 per-scorer raw scores
+    feasible: jnp.ndarray  # (C,N) bool AND-of-filters mask
+    score: jnp.ndarray     # (C,N) f32 masked_total per class row
 
 
 def index_eligible(plugin_set: PluginSet) -> bool:
@@ -95,7 +110,13 @@ def index_eligible(plugin_set: PluginSet) -> bool:
         if not getattr(p, "column_local", False):
             return False
     for p in plugin_set.score_plugins:
-        if type(p).normalize is not BatchedPlugin.normalize:
+        # Overriding normalize is fine iff the override is row-local
+        # (declared, fail-closed like column_local): the finalize pass
+        # recomputes it from the maintained raw planes, so row
+        # reductions (max/min) are exact — but a CROSS-row normalize
+        # would couple class rows the per-pod step never couples.
+        if (type(p).normalize is not BatchedPlugin.normalize
+                and not getattr(p, "normalize_row_local", False)):
             return False
     return True
 
@@ -126,74 +147,105 @@ def build_index_ops(plugin_set: PluginSet, k_eff: int, *,
     scorers = plugin_set.score_plugins
     weights = [plugin_set.weight_of(p) for p in scorers]
 
-    def evaluate(class_pf, nf, af):
-        """(C, Nsub) masked_total for the class batch — the EXACT op
-        sequence of ops/pipeline's evaluate (AND over filters in order,
-        identity-normalized weighted score sum in order, NEG mask), so a
-        gathered column's value equals the step's value at that column
-        bitwise. Eligible plugins read no ctx beyond ``af``."""
+    def evaluate_raw(class_pf, nf, af):
+        """The COLUMN-LOCAL half of ops/pipeline's evaluate for the
+        class batch: AND over filters in order, per-scorer raw scores
+        (post the same .astype(f32)) — everything UP TO normalize, so a
+        gathered column's planes equal the step's planes at that column
+        bitwise. Eligible plugins read no ctx beyond ``af``. Returns
+        (raw (S,C,Nsub), feasible (C,Nsub))."""
         ctx = {"af": af}
         valid_pair = class_pf.valid[:, None] & nf.valid[None, :]
         feasible = valid_pair
         for p in filters:
             with jax.named_scope(f"minisched.index.filter.{p.name}"):
                 feasible = feasible & p.filter(class_pf, nf, ctx)
-        total = jnp.zeros_like(valid_pair, dtype=jnp.float32)
-        for p, w in zip(scorers, weights):
+        raws = []
+        for p in scorers:
             with jax.named_scope(f"minisched.index.score.{p.name}"):
-                raw = p.score(class_pf, nf, ctx).astype(jnp.float32)
-                norm = p.normalize(raw, feasible).astype(jnp.float32)
+                raws.append(p.score(class_pf, nf, ctx)
+                            .astype(jnp.float32))
+        raw = (jnp.stack(raws) if raws else
+               jnp.zeros((0,) + feasible.shape, dtype=jnp.float32))
+        return raw, feasible
+
+    def finalize(raw, feasible):
+        """The ROW-LOCAL half: normalize + weighted f32 accumulation +
+        NEG mask over the FULL maintained planes — the maintained-max
+        pass. Zero plugin evaluations (raw is already stored); the row
+        reductions inside each normalize (max/min) are recomputed from
+        truth every time, so a column repair that moves — or LOWERS —
+        a row extremum re-values the whole row exactly, which the old
+        score-only scatter could not express. Identical op sequence as
+        ops/pipeline's evaluate from the normalize step on (same scorer
+        order, same f32 adds), and normalize row-locality
+        (index_eligible) makes each class row equal the step's per-pod
+        row bitwise."""
+        total = jnp.zeros(feasible.shape, dtype=jnp.float32)
+        for i, (p, w) in enumerate(zip(scorers, weights)):
+            with jax.named_scope(f"minisched.index.norm.{p.name}"):
+                norm = p.normalize(raw[i], feasible).astype(jnp.float32)
             total = total + w * norm
         return jnp.where(feasible, total, NEG)
 
     def build(class_pf, nf, af) -> IndexState:
-        """Full rebuild: one (C, N) evaluate. Pad class rows are
-        all-invalid → NEG everywhere, never chosen."""
-        return IndexState(score=evaluate(class_pf, nf, af))
+        """Full rebuild: one (C, N) evaluate + finalize. Pad class rows
+        are all-invalid → NEG everywhere, never chosen."""
+        raw, feas = evaluate_raw(class_pf, nf, af)
+        return IndexState(raw=raw, feasible=feas,
+                          score=finalize(raw, feas))
 
     def refresh(state: IndexState, class_pf, nf, af,
                 rows_pad) -> IndexState:
-        """Delta repair: re-evaluate ONLY the changed columns
-        (``rows_pad`` (Rb,) i32, sentinel ≥ N for padding) and scatter
-        them in place. Every other column kept its build-time value —
+        """Delta repair: re-evaluate the column-local planes at ONLY
+        the changed columns (``rows_pad`` (Rb,) i32, sentinel ≥ N for
+        padding), scatter them in place, then finalize over the full
+        planes. Every other column kept its build-time raw/feasible —
         its truth did not move (the cache marks EVERY mutation into the
-        IndexDeltaListener), so the whole matrix equals a fresh build
-        against the same snapshot."""
+        IndexDeltaListener) — and ``score`` is a pure function of those
+        planes, so the whole state equals a fresh build against the
+        same snapshot."""
         n = nf.valid.shape[0]
         live_col = rows_pad < n
         safe = jnp.clip(rows_pad, 0, n - 1)
         nf_sub = _gather_nodes(nf, safe)
         nf_sub = nf_sub._replace(valid=nf_sub.valid & live_col)
-        new_sc = evaluate(class_pf, nf_sub, af)              # (C,Rb)
+        new_raw, new_feas = evaluate_raw(class_pf, nf_sub, af)  # (·,C,Rb)
         # Scatter with the RAW (sentinel-carrying) indices and
         # mode="drop": pad slots fall outside [0, N) and write nothing.
         # Clipping them to N-1 instead would create duplicate scatter
         # indices whenever column N-1 is a real repaired node — and a
         # duplicate-index .set() is order-undefined, so the pad slot's
         # value could silently overwrite the genuine repair.
-        return IndexState(
-            score=state.score.at[:, rows_pad].set(new_sc, mode="drop"))
+        raw = state.raw.at[:, :, rows_pad].set(new_raw, mode="drop")
+        feas = state.feasible.at[:, rows_pad].set(new_feas, mode="drop")
+        return IndexState(raw=raw, feasible=feas,
+                          score=finalize(raw, feas))
 
     def append(state: IndexState, class_pf, nf, af,
                rows_pad) -> IndexState:
         """Incremental per-class ADD: evaluate ONLY the fresh class
         rows (``rows_pad`` (Rb,) i32 CLASS-row indices, sentinel ≥ C
-        for padding) against the full node axis and scatter them into
-        the maintained matrix — O(|fresh|·N) instead of the O(C·N)
-        rebuild a new pod class used to force. Every pre-existing row
-        kept its value (its class features are immutable by
-        construction — classes key on bit-identical feature rows), so
-        the result equals a fresh build against the same snapshot."""
+        for padding) against the full node axis, scatter them into the
+        maintained planes, finalize — O(|fresh|·N) plugin evaluations
+        instead of the O(C·N) rebuild a new pod class used to force.
+        Every pre-existing row kept its raw/feasible (its class
+        features are immutable by construction — classes key on
+        bit-identical feature rows), and finalize is row-local, so
+        pre-existing SCORE rows come out bitwise unchanged too and the
+        result equals a fresh build against the same snapshot."""
         c = class_pf.valid.shape[0]
         live_row = rows_pad < c
         safe = jnp.clip(rows_pad, 0, c - 1)
         pf_sub = jax.tree_util.tree_map(lambda a: a[safe], class_pf)
         pf_sub = pf_sub._replace(valid=pf_sub.valid & live_row)
-        new_sc = evaluate(pf_sub, nf, af)                    # (Rb,N)
+        new_raw, new_feas = evaluate_raw(pf_sub, nf, af)     # (·,Rb,N)
         # Same raw-index + mode="drop" discipline as refresh: pad
         # slots fall outside [0, C) and write nothing.
-        return IndexState(
-            score=state.score.at[rows_pad, :].set(new_sc, mode="drop"))
+        raw = state.raw.at[:, rows_pad, :].set(new_raw, mode="drop")
+        feas = state.feasible.at[rows_pad, :].set(new_feas, mode="drop")
+        return IndexState(raw=raw, feasible=feas,
+                          score=finalize(raw, feas))
 
     def assign(state: IndexState, cls, valid, requests, free0, key):
         """The certified shortlist-compressed scan over class rows

@@ -184,13 +184,18 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
     pass). Not supported with explain mode (per-node annotation columns
     would misalign) or a custom assign_fn.
 
-    ``shortlist``: run the greedy assignment as the SHORTLIST-COMPRESSED
-    scan (ops/select.greedy_assign_shortlist) with this top-K width —
-    the sequential P-step scan consults per-pod top-K candidate columns
-    instead of the full node axis, with an exactness certificate per
-    step and a counted full-row repair rescan where it fails; decisions
-    are bit-identical to the full scan. Greedy-only, composes with node
-    sampling (the shortlist then compresses the sampled axis), and
+    ``shortlist``: run the assignment SHORTLIST-COMPRESSED with this
+    top-K width. Greedy takes the compressed scan
+    (ops/select.greedy_assign_shortlist): the sequential P-step scan
+    consults per-pod top-K candidate columns instead of the full node
+    axis, with an exactness certificate per step and a counted full-row
+    repair rescan where it fails. Auction takes the bid shortlist
+    (ops/bid_select.auction_assign_shortlist): the bidding rounds'
+    value reductions run over the same per-pod top-K candidates with a
+    price-plateau certificate, and an uncertified bid reruns the full
+    row under lax.cond, counted through the same repaired plane. Both
+    are bit-identical to their full-row step for any K. Composes with
+    node sampling (the shortlist then compresses the sampled axis), and
     yields to the full caps-scan at run time when enforced domain caps
     are present (lax.cond on ``caps.any_enforced``, like the pallas
     gate). An EXPLICIT ``pallas=True`` wins over the shortlist (the
@@ -214,14 +219,14 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
             "sample_nodes is incompatible with explain mode / assign_fn")
     if shortlist is not None and shortlist < 1:
         shortlist = None
-    if shortlist is not None and (assignment != "greedy"
-                                  or assign_fn is not None):
-        # The auction's parallel bidding rounds and the sharded
-        # chunked-gather scan keep full (P,N) rows — a silently ignored
+    if shortlist is not None and assign_fn is not None:
+        # A custom assign_fn keeps full (P,N) rows — a silently ignored
         # knob would let a config claim shortlist numbers it never ran.
+        # (greedy takes ops/select.greedy_assign_shortlist; auction
+        # takes the bid shortlist, ops/bid_select — both certified.)
         raise ValueError(
-            "shortlist compression applies to the greedy scan only "
-            "(auction bidding and custom assign_fn keep full rows)")
+            "shortlist compression applies to the built-in assignments "
+            "only (a custom assign_fn keeps full rows)")
     if assign_fn is not None and assign_key is None:
         # Without an explicit identity the cache would collide with the
         # default-assignment step and silently drop the custom stage.
@@ -428,8 +433,21 @@ def build_step(plugin_set: PluginSet, *, explain: bool = False,
                 # Priority-tiered bidding: the batch rows carry real
                 # priorities; banded rounds keep the greedy contract's
                 # cross-priority faithfulness (ops/auction.py docstring).
-                greedy_fn = functools.partial(auction_assign,
-                                              priority=pf.priority)
+                if shortlist is not None:
+                    # Bid shortlist (ops/bid_select): per-pod top-K
+                    # compression of the bidding rounds' value rows
+                    # under the same certify-or-repair contract as the
+                    # greedy shortlist scan — bit-identical to the
+                    # full-row auction for any K, repairs counted
+                    # through the shared ShortlistAssignResult plane.
+                    from .bid_select import auction_assign_shortlist
+
+                    greedy_fn = functools.partial(
+                        auction_assign_shortlist, priority=pf.priority,
+                        k=min(shortlist, N))
+                else:
+                    greedy_fn = functools.partial(auction_assign,
+                                                  priority=pf.priority)
             else:
                 use_pallas = pallas
                 if use_pallas is None:
@@ -713,22 +731,29 @@ def build_loop_step(plugin_set: PluginSet, *,
     multi-host loop follow-up pins the carry to
     ``parallel.mesh.leaf_sharding`` explicitly.
 
-    Constraints mirror the engine's loop gates: greedy-only (the carry
-    replay contract), no explain (per-batch matrices would have to stack
-    D-deep), and ``used_ports`` rides along un-carried — the engine
-    stages only port-free batches into the ring, so the tranche's port
-    table is invariant by construction.
+    Constraints mirror the engine's loop gates: no explain (per-batch
+    matrices would have to stack D-deep), and ``used_ports`` rides
+    along un-carried — the engine stages only port-free batches into
+    the ring, so the tranche's port table is invariant by construction.
+    Both built-in assignments are ring-eligible: the greedy scan
+    carries its sequential free chain, and the auction's banded bidding
+    starts slot k+1's prices fresh while its ``free`` input IS slot k's
+    ``free_after`` — exactly the per-batch residency carry, fused. The
+    between-slot validator replays debits with the order-free per-node
+    aggregate (_DeviceResidency I1), which both assignment orders equal
+    bitwise under the exact-integer resource grammar.
     """
-    if assignment != "greedy":
-        raise ValueError("the device loop carries the greedy scan's "
-                         "free chain; auction keeps per-batch dispatch")
+    if assignment not in ("greedy", "auction"):
+        raise ValueError(
+            f"unknown assignment strategy {assignment!r}; "
+            "expected 'greedy' or 'auction'")
     if shortlist is not None and shortlist < 1:
         shortlist = None
     cache_key = (
         tuple(p.trace_key() for p in plugin_set.filter_plugins),
         tuple((p.trace_key(), plugin_set.weight_of(p))
               for p in plugin_set.score_plugins),
-        cfg, shortlist, slim, "device_loop",
+        cfg, assignment, shortlist, slim, "device_loop",
     )
     cached = _LOOP_CACHE.get(cache_key)
     if cached is not None:

@@ -20,6 +20,21 @@ dominate, not compute):
 Both are dtype/shape-generic jitted functions; each distinct
 (state shape, rows bucket) pair compiles once, and the rows bucket
 rides the same pow2 ladder as every other engine shape.
+
+Order contract of the two carried planes (the auction-unification
+split, _DeviceResidency I1):
+
+  * the FREE plane is tracked as an order-free per-node commutative
+    debit aggregate — no assignment order is assumed, which is what
+    admits the auction's round-order einsum subtracts next to the
+    greedy scan's pod-order carry;
+  * the PORT plane needs no such generalization: ``insert_ports`` and
+    ``replay_ports_host`` run AFTER assignment, in pod order, on both
+    sides — pure integer first-zero-slot writes whose op sequence is
+    identical device and host by construction, for every assignment
+    mode. Port insertion order is batch-row order, not
+    assignment-decision order, so the auction's unordered wins change
+    nothing here.
 """
 from __future__ import annotations
 

@@ -127,7 +127,11 @@ def greedy_assign(scores: jnp.ndarray, requests: jnp.ndarray,
 
 
 class ShortlistAssignResult(NamedTuple):
-    """AssignResult plus the repair ledger of the shortlist scan."""
+    """AssignResult plus the repair ledger of a certified shortlist
+    scan — shared by the greedy variant below and the auction's bid
+    shortlist (ops/bid_select.auction_assign_shortlist), so
+    gang_admission and the engine's repair accounting treat both
+    identically."""
 
     chosen: jnp.ndarray      # (P,) i32 node row, -1 if unassigned
     assigned: jnp.ndarray    # (P,) bool

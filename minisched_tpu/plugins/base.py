@@ -60,6 +60,16 @@ class BatchedPlugin:
     # degrade to the per-batch dataflow, never to stale certified
     # decisions.
     column_local: bool = False
+    # ``normalize`` row i reads ONLY row i of (scores, feasible) — any
+    # in-row reduction (max/min/sum) is fine, coupling ACROSS pod rows
+    # is not. The maintained index (ops/index.py) recomputes normalize
+    # from its stored raw planes, so row-local overrides stay
+    # index-eligible; a cross-row normalize would make one class row's
+    # cached value depend on which OTHER classes share the matrix.
+    # FAIL-CLOSED like column_local: the flag only matters for plugins
+    # that OVERRIDE normalize (the inherited identity is trivially
+    # row-local), and such a plugin must explicitly declare True.
+    normalize_row_local: bool = False
 
     # -- event interest (drives requeue gating, reference
     #    minisched/initialize.go:140-157 + nodenumber.go:66-70)

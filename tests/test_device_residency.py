@@ -164,10 +164,11 @@ def test_steady_state_uploads_only_deltas():
 
 def test_preemption_burst_bit_identical_and_resyncs():
     """Preemption exercises the two hardest protocol paths: evictions
-    mutate free outside any batch (informer-side corrections) and
-    nominated-capacity reservations force residency to stand down (the
-    reservation debit cannot ride the carried chain) and re-establish
-    after the nominee binds."""
+    mutate free outside any batch (informer-side corrections), and
+    nominated-capacity reservations ride the carried chain as an
+    order-free per-node correction (the nomination-window carry) —
+    subtracted from the step's free INPUT only and added back before
+    the carried adoption, so residency never stands down for them."""
     def run(resident: bool):
         c = Cluster()
         try:
@@ -200,8 +201,65 @@ def test_preemption_burst_bit_identical_and_resyncs():
     node_rs, low_rs, m_rs = run(resident=True)
     assert node_rs == node_fb
     assert low_rs == low_fb
-    # the nomination window forced at least one stand-down + re-establish
-    assert m_rs["residency_resyncs"] >= 2, m_rs
+    # The nomination window no longer forces a stand-down: ONE resync
+    # (the establish) for the whole run — the eviction churn rides the
+    # delta corrections and the reservation rides the carried chain.
+    assert m_rs["residency_resyncs"] == 1, m_rs
+
+
+def test_nomination_window_carry_is_order_free_and_counted():
+    """A batch prepared while ANOTHER pod's nomination is outstanding
+    keeps the carry: the reservation is applied as a per-node
+    correction to the step's free input (the batch cannot steal the
+    nominated capacity) and reversed before the carried adoption, so
+    the chain still equals un-nominated cache truth bitwise."""
+    c = Cluster()
+    sched = None
+    try:
+        c.start(profile=_profile(), config=_config(True),
+                with_pv_controller=False)
+        c.create_node("nc-n0", cpu=1000)
+        c.create_node("nc-n1", cpu=1000)
+        # Establish the carry.
+        c.create_pod("warm", cpu=100)
+        c.wait_for_pod_bound("warm", timeout=30)
+        sched = c.service.scheduler
+        # Outstanding reservation for a pod that is NOT in any batch:
+        # 900 cpu on nc-n0 — with warm's 100 already bound there (or
+        # not), the reservation makes nc-n0 unable to take 300-cpu pods.
+        from minisched_tpu.encode import features as F
+        from minisched_tpu.state.objects import pod_requests
+        ghost = obj.Pod(metadata=obj.ObjectMeta(name="ghost",
+                                                namespace="default"),
+                        spec=obj.PodSpec(requests={"cpu": 900}))
+        with sched._nom_lock:
+            sched._nominations["default/ghost"] = (
+                "nc-n0", F.resources_vector(pod_requests(ghost)),
+                time.monotonic() + 60.0)
+        for i in range(3):
+            c.create_pod(f"bys-{i}", cpu=300)
+        for i in range(3):
+            p = c.wait_for_pod_bound(f"bys-{i}", timeout=30)
+            # the reservation held: nothing lands on the nominated node
+            assert p.spec.node_name == "nc-n1", p.spec.node_name
+        m = sched.metrics()
+        assert m["residency_nomination_carries"] >= 1, m
+        # the carry NEVER stood down: establish-only resyncs, and the
+        # chain still matches cache truth (clean cross-check would have
+        # counted a desync otherwise)
+        assert m["residency_resyncs"] == 1, m
+        assert m["residency_desyncs"] == 0, m
+        res = sched._residency
+        if res is not None and res.epoch >= 0:
+            # white-box: the carried device array equals the
+            # UN-nominated mirror (the add-back round-tripped exactly)
+            np.testing.assert_array_equal(
+                np.asarray(res.free_dev), res.mirror_free)
+    finally:
+        if sched is not None:
+            with sched._nom_lock:
+                sched._nominations.pop("default/ghost", None)
+        c.shutdown()
 
 
 def test_failed_bind_divergence_corrects_without_resync():

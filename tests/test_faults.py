@@ -348,6 +348,31 @@ def test_residency_corrupt_trips_desync_detector_and_resyncs():
     assert placed == ref_placed
 
 
+def test_auction_mirror_corrupt_trips_desync_detector_and_resyncs():
+    """Auction-unification detector: ``auction_mirror:corrupt``
+    scribbles ONE node's aggregate debit inside the order-free host
+    mirror replay (_DeviceResidency.note_debits) — certificate-invisible
+    by construction (the mirror is pure host bookkeeping; no in-step
+    check ever sees it). With the carry cross-check armed
+    (resident_check_every=1) on an AUCTION engine, the mirror-vs-device
+    comparison must catch the divergence before a step consumes the
+    carry, count a residency desync, heal by full re-upload, and the
+    supervised replay must land every pod on the fault-free run's
+    node."""
+    cfg = _config(pipeline=False, assignment="auction",
+                  resident_check_every=1)
+    ref_placed, ref_m = _run_burst("", cfg)
+    assert ref_m["resident_checks"] >= 2  # the detector genuinely ran
+    assert ref_m["residency_desyncs"] == 0
+
+    placed, m = _run_burst("auction_mirror:corrupt@2", cfg)
+    assert m["fault_fires_auction_mirror"] == 1
+    assert m["residency_desyncs"] >= 1
+    assert m["supervisor_escalations"] >= 1
+    assert m["degradation_state"] == "resident"
+    assert placed == ref_placed
+
+
 def test_shortlist_corrupt_caught_by_certification_cross_check():
     """Shortlist tentpole detector: ``shortlist_repair:corrupt``
     re-points an assigned pod's fetched chosen row at a DIFFERENT valid

@@ -78,7 +78,7 @@ def _pods(n: int, *, shapes: int = 0, cpu0: int = 100, pri0: int = 1000):
 
 def _run(config, pods, *, plugins=None, node_cpus=(64000, 48000, 40000,
                                                    36000),
-         fault_spec="", between=None, timeout=120.0):
+         node_taints=None, fault_spec="", between=None, timeout=120.0):
     """One engine run → (placements {pod: node}, final metrics).
     ``pods`` may be a list of bursts; ``between(cluster, i)`` runs after
     burst i settles (cordon/uncordon hooks for the narrowing/widening
@@ -91,7 +91,8 @@ def _run(config, pods, *, plugins=None, node_cpus=(64000, 48000, 40000,
         if fault_spec:
             faults.configure(fault_spec)
         for i, cpu in enumerate(node_cpus):
-            c.create_node(f"n{i}", cpu=cpu)
+            c.create_node(f"n{i}", cpu=cpu,
+                          taints=(node_taints or {}).get(i))
         placements = {}
         want = 0
         for bi, burst in enumerate(bursts):
@@ -247,24 +248,60 @@ def test_raw_op_any_scan_width_is_exact():
 
 
 def test_index_eligibility_gates():
-    """Topology/affinity state and row-normalizing scorers are exactly
+    """Topology/affinity state and non-column-local plugins are exactly
     what the column-local certificate cannot cover — those profiles
-    must never engage."""
+    must never engage. Row-LOCAL normalize overrides are covered since
+    the maintained-max split (pre-normalize planes + full finalize);
+    an UNDECLARED override stays fail-closed out."""
     from minisched_tpu.ops.index import index_eligible
+    from minisched_tpu.plugins.base import PluginSet
+    from minisched_tpu.plugins.tainttoleration import TaintToleration
 
     assert index_eligible(_profile().build())
     assert not index_eligible(_profile(
         PLUGINS + ["PodTopologySpread"]).build())
     assert not index_eligible(_profile(
         PLUGINS + ["NodeAffinity"]).build())
-    # TaintToleration's row-normalized score couples every column to
-    # the row max — one changed node would invalidate the whole row.
-    assert not index_eligible(_profile(
+    # TaintToleration's min-shift normalize reads only its own row and
+    # declares normalize_row_local — since the maintained-max split the
+    # index stores its raw untolerated counts per column and re-derives
+    # the row shift in finalize, so the profile is eligible.
+    assert index_eligible(_profile(
         PLUGINS + ["TaintToleration"]).build())
+
+    # A normalize override WITHOUT the row-local declaration must stay
+    # out (fail-closed, like a forgotten column_local).
+    class _Undeclared(TaintToleration):
+        name = "UndeclaredNormalize"
+        normalize_row_local = False
+
+    base = _profile().build()
+    assert not index_eligible(
+        PluginSet(base.plugins + [_Undeclared()], base.weights))
     # NodeNumber (suffix equality, identity normalize) IS column-local:
     # the reference's own demo profile can ride the index.
     assert index_eligible(_profile(
         ["NodeUnschedulable", "NodeResourcesFit", "NodeNumber"]).build())
+
+
+def test_index_serves_row_normalized_profile_bit_identical():
+    """Maintained-max in action end to end: TaintToleration's min-shift
+    normalize rides the index — the raw untolerated counts are
+    maintained per node column, the row shift is re-derived by the
+    finalize pass — and with a PreferNoSchedule taint skewing one
+    column the indexed engine commits exactly the index-off
+    placements."""
+    taints = {0: [obj.Taint(key="ded", value="gpu",
+                            effect="PreferNoSchedule")]}
+    kw = dict(plugins=PLUGINS + ["TaintToleration"], node_taints=taints)
+    pods = _pods(18)
+    off, m_off = _run(_config(False), _pods(18), **kw)
+    on, m_on = _run(_config(True), pods, **kw)
+    assert on == off
+    assert m_off["index_hits"] == 0 and m_off["index_width"] == 0
+    assert m_on["index_hits"] >= 1, m_on
+    # the taint genuinely skewed decisions away from n0's capacity win
+    assert any(v != "n0" for v in off.values())
 
 
 # ---- engine bit-identity across modes -------------------------------------
