@@ -14,7 +14,8 @@ SHELL := /bin/bash
 
 .PHONY: test tier1 fault-smoke shortlist-smoke trace-smoke slo-smoke \
         churn-smoke overload-smoke loop-smoke index-smoke journal-smoke \
-        fleet-smoke tenant-smoke auction-smoke profile-smoke start \
+        fleet-smoke fleet-proc-smoke tenant-smoke auction-smoke \
+        profile-smoke start \
         start-remote \
         start-client-engine \
         demo docs \
@@ -160,6 +161,20 @@ auction-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_auction.py -x -q \
 	  -p no:cacheprovider -p no:randomly
 
+# Out-of-process fleet suite (~40 s): real replica PROCESSES over
+# RemoteStore against one apiserver — spawn/census/respawn lifecycle,
+# SIGKILL failover exactly-once with the takeover journaled in the
+# merged cross-process stream, elastic ShardMove handoff executing
+# donor-release/recipient-adopt across processes, provenance fan-out
+# with per-replica attribution, plus the rebalancer's structural
+# no-flap hysteresis and the directive protocol unit tests. Includes
+# the slow-marked integration tests tier-1's `-m 'not slow'`
+# deselects. A tier-1 prerequisite after auction-smoke: process
+# supervision rides every seam below it.
+fleet-proc-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet_proc.py -x -q \
+	  -p no:cacheprovider -p no:randomly
+
 # The EXACT ROADMAP tier-1 verify command (dots count + exit code
 # preserved) — what the driver runs after every PR; run it locally
 # before shipping. shortlist-smoke runs first: the arbitration
@@ -177,10 +192,12 @@ auction-smoke:
 # fleet-smoke (the fused-tenant mux must never change a decision
 # either); auction-smoke after tenant-smoke (the auction path now
 # shares the carry/ring/shortlist seams and must stay bit-identical
-# across them).
+# across them); fleet-proc-smoke after auction-smoke (process
+# supervision is the outermost layer — replicas run the full engine
+# stack, so every seam below must already hold).
 tier1: shortlist-smoke trace-smoke slo-smoke overload-smoke loop-smoke \
        index-smoke journal-smoke fleet-smoke tenant-smoke auction-smoke \
-       churn-smoke
+       fleet-proc-smoke churn-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -322,6 +339,7 @@ bench-check:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_coldstart.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_journal.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_fleet.py --check
+	JAX_PLATFORMS=cpu $(PY) tools/bench_fleet_proc.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_tenants.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_auction.py --check
 

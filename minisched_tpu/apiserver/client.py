@@ -459,6 +459,28 @@ class RemoteStore:
         persist_path."""
         return self._call("POST", "/checkpoint")
 
+    def journal(self, since: int = 0) -> dict:
+        """GET /journal?since= — the serving process's decision-journal
+        document (obs/journal.to_doc shape: ``entries`` + ``next_seq``).
+        The out-of-process fleet supervisor polls each replica's own
+        apiserver here to aggregate a cross-process causal narrative."""
+        return self._call("GET", f"/journal?since={int(since)}")
+
+    def provenance(self, pod_key: str) -> Optional[dict]:
+        """GET /provenance/<pod> — the serving process's decision
+        provenance record for one pod, None when it holds none (the
+        fleet supervisor fans this out across replicas; shards are
+        disjoint so at most one replica answers)."""
+        from urllib.parse import quote
+
+        # Keep '/' literal: the server splits the path and rejoins the
+        # tail, so a namespaced key travels as /provenance/<ns>/<name>.
+        try:
+            return self._call("GET",
+                              f"/provenance/{quote(pod_key, safe='/')}")
+        except NotFoundError:
+            return None
+
     def healthz(self) -> bool:
         try:
             return bool(self._call("GET", "/healthz").get("ok"))

@@ -282,11 +282,19 @@ def _make_handler(store: ClusterStore, token: str | None = None,
             return json.loads(self.rfile.read(n)) if n else None
 
         def _route(self):
-            """(kind, key, query) from the request path; key may be ''."""
+            """(kind, key, query) from the request path; key may be ''.
+
+            Interior empty segments are PRESERVED: an empty-namespace
+            object's key is "/name", so its per-object routes carry a
+            double slash (POST /bind//name, GET /apis/Pod//name) —
+            collapsing it would look up "name" and 404, and the engine
+            treats a bind 404 as pod-deleted and forgets the pod."""
             u = urlparse(self.path)
-            parts = [p for p in u.path.split("/") if p]
+            parts = u.path.split("/")[1:]  # absolute path: drop leading ''
+            while parts and parts[-1] == "":  # tolerate trailing slashes
+                parts.pop()
             q = parse_qs(u.query)
-            if not parts:
+            if not parts or not parts[0]:
                 return None, None, q
             if parts[0] == "apis" and len(parts) >= 2:
                 return parts[1], "/".join(parts[2:]), q

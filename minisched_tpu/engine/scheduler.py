@@ -1274,6 +1274,11 @@ class Scheduler:
         # WFFC candidate-zone memo: pvc key → (zones, computed_at).
         self._wffc_memo: Dict[str, tuple] = {}
         self._stop = threading.Event()
+        # Crash-stop flag (abandon()): checked BETWEEN device-loop slots
+        # so a "killed" replica leaves its staged-but-unresolved ring
+        # tranche as debris for the adopter, instead of committing it on
+        # the way down like the graceful shutdown() path does.
+        self._abandoned = False
         self._thread: Optional[threading.Thread] = None
         self.filter_names = [p.name for p in plugin_set.filter_plugins]
         # Device-resident static node features, keyed on
@@ -2206,6 +2211,38 @@ class Scheduler:
                                         name="scheduling-loop")
         self._thread.start()
 
+    def abandon(self) -> None:
+        """Crash-stop: the SIGKILL model for an in-process replica. Sets
+        the abandon flag (honoured between device-loop slots — staged
+        slots past the crash point are dropped WITHOUT committing, the
+        debris an adopter's ``adopt_shards`` re-gather must drain) and
+        stops the loop, but deliberately skips every graceful drain:
+        no commit-flush wait, no recorder drain, no broadcaster flush.
+        Whatever was in flight stays wherever the crash left it —
+        exactly what a dead process leaves behind. The caller (fleet
+        supervisor's crash kill) drops leases FIRST so peers can claim
+        the debris through the epoch fence."""
+        self._abandoned = True
+        self._stop.set()
+        self.queue.close()
+        jnote("engine.abandon", profile=self.profile,
+              replica=self.replica)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        # Cut the executors loose without waiting: a real SIGKILL would
+        # not flush them either. The binder threads that already hold a
+        # bind will finish it (kernel-level in-flight RPCs land too);
+        # queued-but-unstarted work is dropped.
+        self._binder.shutdown(wait=False)
+        self._committer.shutdown(wait=False)
+        self._gatherer.shutdown(wait=False)
+        if self._owns_shared:
+            self._shared.shutdown()
+        if self.recorder is not None:
+            self.recorder.close()
+        self.broadcaster.close()
+
     def shutdown(self) -> None:
         self._stop.set()
         self.queue.close()
@@ -2929,6 +2966,20 @@ class Scheduler:
         # ---- per-slot resolve + commit + between-slot validation ------
         n_filters = len(self.filter_names)
         for j, inf in enumerate(infs):
+            if self._abandoned:
+                # Crash-stop (abandon()): slots [j:] are STAGED — their
+                # decisions exist only in this process's memory — but
+                # never resolved or committed, so their pods stay
+                # unbound in the store. That is the debris an adopting
+                # replica's adopt_shards re-gather drains. No replay
+                # tail, no carry adoption: a dead process does neither.
+                self._sup_count("loop_abandoned_slots", n_slots - j)
+                jnote("loop.abandon", profile=self.profile,
+                      replica=self.replica, slot=j,
+                      slots_staged=n_slots,
+                      pods_staged=sum(len(b)
+                                      for b in slot_batches[j:]))
+                return
             buf = stack[j]
             tup = (unpack_decision_slim(buf, P_ring, n_filters)
                    if self._slim else unpack_decision_i32(buf))

@@ -55,6 +55,18 @@ production scheduler's failure domain spans:
                 it (a zombie replica writing with an old fencing token;
                 the rejection proves a corrupted lease can never mint
                 two live owners of one shard).
+    proc        process-fleet lifecycle seam (fleet/procfleet.py) —
+                ``err`` fails a replica-process SPAWN (the supervisor
+                counts it and respawns on the capped backoff — a fork
+                bomb guard / crashloop model), ``die`` SIGKILLs the
+                replica process mid-batch when consulted inside one
+                (outside a replica it raises like any worker death —
+                the genuine-debris crash: staged ring tranches and the
+                lease records are simply abandoned for peers to claim
+                through the epoch fence), ``corrupt`` scribbles the
+                ReplicaStatus heartbeat payload with a REWOUND
+                resource_version before the CAS so the store must
+                reject it (counted; supervisor census stays truthful).
 
 Configured once per process from ``MINISCHED_FAULTS`` (tests reconfigure
 via :func:`configure`), a comma-separated list of ``gate:action@trigger``
@@ -115,16 +127,19 @@ log = logging.getLogger(__name__)
 
 #: The gate catalog; hit() rejects unknown names so a typo in a rule or a
 #: call site cannot silently never fire.
-# "auction_mirror" appends LAST: per-gate PRNG streams seed by catalog
-# index, so appending (never inserting) keeps every existing gate's
-# firing pattern stable under a fixed seed. auction_mirror sits inside
+# New gates append LAST: per-gate PRNG streams seed by catalog index,
+# so appending (never inserting) keeps every existing gate's firing
+# pattern stable under a fixed seed. auction_mirror sits inside
 # _DeviceResidency.note_debits: corrupt scribbles one node's aggregate
 # debit — certificate-invisible by construction (the decision already
 # left the device), so only the MINISCHED_RESIDENT_CHECK_EVERY
-# cross-check can catch it.
+# cross-check can catch it. proc sits on the process-fleet lifecycle
+# seams (fleet/procfleet.py): spawn, replica heartbeat, and the
+# replica-side batch seam where ``die`` becomes a real SIGKILL.
 GATES = ("step", "fetch", "residency", "shortlist_repair", "commit",
          "bind", "informer", "http", "checkpoint", "lifecycle",
-         "admission", "index", "journal", "lease", "auction_mirror")
+         "admission", "index", "journal", "lease", "auction_mirror",
+         "proc")
 
 _ACTIONS = ("err", "die", "corrupt", "stall")
 

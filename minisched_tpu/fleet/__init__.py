@@ -7,10 +7,19 @@ compare-and-swap against store truth — no coordination on the hot path —
 and lease-based failover so a peer claims a dead replica's shards with
 an epoch bump and drains its pending pods.
 
+Two supervisors share the duck type (``start/kill/restart/shutdown/
+census/metrics``): ``supervisor.FleetSupervisor`` runs replicas as
+threads in one process (fast, shared store object), while
+``procfleet.ProcFleetSupervisor`` promotes each replica to its own OS
+process over ``RemoteStore`` — real crash isolation, SIGKILL fault
+injection, exit-code census, elastic shard handoff via ``ShardMove``
+directives, and warm takeover through a boot-time pre-warm pass.
+
 Import the pieces directly (``fleet.shardmap`` is dependency-free so the
 engine's wants_pod hot path can use it without an import cycle):
 
     from minisched_tpu.fleet.shardmap import shard_of, lease_name
     from minisched_tpu.fleet.lease import LeaseManager
     from minisched_tpu.fleet.supervisor import FleetSupervisor
+    from minisched_tpu.fleet.procfleet import ProcFleetSupervisor
 """

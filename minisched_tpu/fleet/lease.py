@@ -66,7 +66,7 @@ class LeaseManager:
         self.counters: Dict[str, int] = {
             "renewals": 0, "heartbeats_dropped": 0,
             "stale_heartbeats_rejected": 0, "claim_conflicts": 0,
-            "acquires": 0, "losses": 0,
+            "acquires": 0, "losses": 0, "releases": 0,
         }
 
     # ---- local views (hot path: no store round-trip) --------------------
@@ -200,6 +200,38 @@ class LeaseManager:
     def renew_all(self) -> None:
         for shard in sorted(self.held()):
             self.renew(shard)
+
+    def release(self, shard: int) -> bool:
+        """VOLUNTARY handoff (elastic rebalance): clear the holder field
+        through the CAS — epoch untouched, the next claimant bumps it —
+        and forget the shard locally. Unlike the crash model the store
+        object immediately reads unheld, so the nominated recipient can
+        claim without waiting out a TTL. Returns False when the CAS
+        lost (a peer already superseded us — nothing left to release)."""
+        my_epoch = self._held.get(shard)
+        if my_epoch is None:
+            return False
+        name = lease_name(shard)
+        try:
+            lease = self.store.get("Lease", name)
+        except NotFoundError:
+            self._lose(shard, my_epoch, "lease object deleted")
+            return False
+        if lease.holder != self.replica or lease.epoch != my_epoch:
+            self._lose(shard, my_epoch,
+                       f"superseded by {lease.holder}@{lease.epoch}")
+            return False
+        lease.holder = ""
+        try:
+            self.store.update(lease, check_version=True)
+        except (ConflictError, NotFoundError):
+            return False
+        with self._lock:
+            self._held.pop(shard, None)
+            self.counters["releases"] += 1
+        jnote("lease.release", replica=self.replica, shard=shard,
+              epoch=my_epoch)
+        return True
 
     def drop_all(self) -> None:
         """Forget every held shard locally WITHOUT touching the store —

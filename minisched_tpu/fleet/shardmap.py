@@ -21,6 +21,11 @@ import zlib
 FLEET_ENV = "MINISCHED_FLEET"
 SHARDS_ENV = "MINISCHED_SHARDS"
 LEASE_TTL_ENV = "MINISCHED_LEASE_TTL"
+#: Out-of-process fleet (fleet/procfleet.py): N replica PROCESSES over
+#: RemoteStore instead of N in-process engine threads.
+FLEET_PROC_ENV = "MINISCHED_FLEET_PROC"
+#: Elastic shard handoff spec (fleet/procfleet.ShardRebalancer).
+REBALANCE_ENV = "MINISCHED_REBALANCE"
 
 
 def shard_of(pod_key: str, n_shards: int) -> int:
@@ -55,3 +60,21 @@ def lease_ttl_from_env(default: float = 2.0) -> float:
     except ValueError:
         t = default
     return max(0.05, t)
+
+
+def fleet_proc_from_env(default: int = 0) -> int:
+    try:
+        return int(os.environ.get(FLEET_PROC_ENV, "") or default)
+    except ValueError:
+        return default
+
+
+def status_name(replica: str) -> str:
+    """The store key of a replica's ReplicaStatus heartbeat object."""
+    return f"replica-{replica}"
+
+
+def move_name(shard: int) -> str:
+    """The store key of a shard's elastic-handoff directive (at most one
+    in-flight move per shard by construction — the name IS the lock)."""
+    return f"move-{shard}"

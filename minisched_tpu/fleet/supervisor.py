@@ -138,21 +138,34 @@ class FleetSupervisor:
 
     # ---- failure injection / recovery ----------------------------------
 
-    def kill(self, rid: str) -> bool:
+    def kill(self, rid: str, *, crash: bool = False) -> bool:
         """Crash one replica: the engine stops, its leases are FORGOTTEN
         locally but left in the store to expire — a peer claims them
         within ~one lease TTL via the tick's takeover scan. Returns
-        True iff a live replica was actually taken down."""
+        True iff a live replica was actually taken down.
+
+        ``crash=True`` is the harsher SIGKILL model: leases are dropped
+        FIRST and the engine is ``abandon()``ed rather than shut down —
+        no commit flush, and a device-loop mid-tranche stops BETWEEN
+        slots, leaving staged-but-unresolved ring slots as unbound
+        debris for the adopter (the fleet × device-loop drain test
+        rides this). The default stays the gentler stop the existing
+        failover tests pin."""
         with self._lock:
             rep = self._replicas.get(rid)
             if rep is None or not rep.alive:
                 return False
             rep.alive = False
-        jnote("fleet.kill", replica=rid,
+        jnote("fleet.kill", replica=rid, crash=crash,
               shards=",".join(str(s) for s in sorted(rep.lease.held())))
-        rep.engine.shutdown()
-        rep.lease.drop_all()
-        log.warning("fleet: replica %s killed", rid)
+        if crash:
+            rep.lease.drop_all()
+            rep.engine.abandon()
+        else:
+            rep.engine.shutdown()
+            rep.lease.drop_all()
+        log.warning("fleet: replica %s killed%s", rid,
+                    " (crash)" if crash else "")
         return True
 
     def restart(self, rid: str) -> bool:

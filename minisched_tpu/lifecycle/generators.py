@@ -290,20 +290,26 @@ class KillScheduler(Generator):
     claim the dead replica's shards after the TTL expires, exactly as a
     dead process leaves the world. Pods the victim had queued are
     re-derived from the store by the claimant's takeover sweep, so the
-    no_pod_lost / stable_bindings oracle certifies the failover."""
+    no_pod_lost / stable_bindings oracle certifies the failover.
+
+    ``crash=True`` hardens the kill: an in-process replica is abandoned
+    mid-tranche (staged device-loop slots never commit, leaving debris
+    for the adopter's takeover sweep); a process replica is SIGKILLed —
+    there the flag is implicit, every proc kill is a crash."""
 
     def __init__(self, name: str = "kill-sched", *, replica: str = "r1",
-                 after_s: float = 1.0):
+                 after_s: float = 1.0, crash: bool = False):
         self.name = name
         self.replica = replica
         self.after = float(after_s)
+        self.crash = bool(crash)
 
     def run(self, env):
         yield self.after
         fleet = _fleet_of(env)
         if fleet is None:
             return  # single-engine run: nothing to kill
-        if fleet.kill(self.replica):
+        if fleet.kill(self.replica, crash=self.crash):
             env.view.count("scheduler_kills")
 
 

@@ -57,6 +57,11 @@ class SchedulerService:
         # crossing the two routing schemes is undefined and refused.
         self._fleet = None
         self._fleet_n = 0
+        # Out-of-process fleet (fleet/procfleet.py): N replica PROCESSES
+        # over RemoteStore; the service owns a main apiserver when the
+        # store is in-process (replicas need a wire to reach it).
+        self._fleet_proc_n = 0
+        self._proc_api = None
         # RemoteStore also has a snapshot() (the /snapshot verb), so the
         # duck check must be the checkpointer's ACTUAL surface —
         # resource_version() is the store-local half RemoteStore lacks.
@@ -178,7 +183,12 @@ class SchedulerService:
         """The ``GET /journal`` payload (``APIServer.journal_providers``
         feed): the process-wide decision journal — one causal event log
         shared by every profile engine, each event tagged with its
-        serving profile. Empty-but-valid with MINISCHED_JOURNAL unset."""
+        serving profile. Empty-but-valid with MINISCHED_JOURNAL unset.
+        Under a PROCESS fleet the supervisor's merged cross-process
+        stream answers instead (source-tagged, re-sequenced), so one
+        ``GET /journal`` narrates the whole fleet."""
+        if self._fleet is not None and hasattr(self._fleet, "journal"):
+            return self._fleet.journal(since)
         from ..obs.journal import JOURNAL
 
         return JOURNAL.to_doc(since)
@@ -188,7 +198,11 @@ class SchedulerService:
         (``APIServer.provenance_providers`` feed): the first profile
         engine holding a decision-provenance record for the pod answers
         (profiles share no pods, replicas share no shards); None = no
-        record."""
+        record. A process fleet fans the lookup out to the replica
+        sidecars (record attributed with the serving replica)."""
+        if self._fleet is not None and hasattr(self._fleet,
+                                               "provenance"):
+            return self._fleet.provenance(pod_key)
         for engine in self.schedulers.values():
             rec = engine.provenance(pod_key)
             if rec is not None:
@@ -197,10 +211,17 @@ class SchedulerService:
 
     def start_scheduler(self, profile: ProfileSpec = None,
                         config: Optional[SchedulerConfig] = None,
-                        fleet: Optional[int] = None) -> Scheduler:
+                        fleet: Optional[int] = None,
+                        fleet_proc: Optional[int] = None) -> Scheduler:
         """``fleet``: run N replicated engines with shard leases instead
         of one (fleet/supervisor.py); None reads ``MINISCHED_FLEET``
-        (0/1 = off). Fleet mode is single-profile only."""
+        (0/1 = off). ``fleet_proc``: run N replica PROCESSES over
+        RemoteStore instead (fleet/procfleet.py); None reads
+        ``MINISCHED_FLEET_PROC`` and wins over ``fleet`` when both are
+        set — process isolation subsumes thread isolation. Both fleet
+        modes are single-profile only. In process-fleet mode there is
+        no in-process engine: this returns None and the fleet surfaces
+        live on :attr:`fleet`."""
         if self._scheds or self._fleet is not None:
             raise RuntimeError("scheduler already running")
         if isinstance(profile, SchedulerConfiguration):
@@ -235,8 +256,17 @@ class SchedulerService:
             # reference's off-hot-path informer-event flush pattern).
             self.result_store = recorder = ResultStore(self._store,
                                                        async_flush=True)
-        from ..fleet.shardmap import fleet_from_env
+        from ..fleet.shardmap import fleet_from_env, fleet_proc_from_env
 
+        n_proc = (int(fleet_proc) if fleet_proc is not None
+                  else fleet_proc_from_env())
+        if n_proc >= 2:
+            if self._multi:
+                raise ValueError(
+                    "fleet mode is single-profile: profiles partition "
+                    "pods by scheduler_name, fleet shards by pod-key "
+                    "hash — one routing scheme at a time")
+            return self._start_proc_fleet(profiles[0], n_proc)
         n_fleet = int(fleet) if fleet is not None else fleet_from_env()
         if n_fleet >= 2:
             if self._multi:
@@ -331,11 +361,51 @@ class SchedulerService:
                  "%d shards)", n, p.name, self._fleet.n_shards)
         return self.scheduler
 
+    def _start_proc_fleet(self, p: Profile, n: int):
+        """Out-of-process fleet wiring: N replica processes, each a full
+        engine over RemoteStore. With an in-process store the service
+        boots (and owns) the main apiserver the replicas dial; with a
+        RemoteStore the replicas dial its address directly — the serving
+        side already exists."""
+        import dataclasses as _dc
+
+        from ..fleet.procfleet import (ProcFleetSupervisor,
+                                       rebalance_from_env)
+        from ..fleet.shardmap import shards_from_env
+
+        if self._checkpoint_path:
+            from ..state.persistence import Checkpointer
+
+            self._checkpointer = Checkpointer(
+                self._store, self._checkpoint_path,
+                interval_s=self._checkpoint_interval_s)
+        addr = getattr(self._store, "address", None)
+        if addr is None or hasattr(self._store, "resource_version"):
+            # In-process store: serve it over the wire ourselves.
+            from ..apiserver.server import APIServer
+
+            self._proc_api = APIServer(self._store).start()
+            addr = self._proc_api.address
+        self._fleet = ProcFleetSupervisor(
+            self._store, addr, replicas=n,
+            n_shards=shards_from_env(n),
+            config_overrides=_dc.asdict(self._config),
+            profile=p, rebalance=rebalance_from_env())
+        self._fleet_proc_n = n
+        self._fleet.start()
+        log.info("out-of-process scheduler fleet started (%d replica "
+                 "processes, profile=%s, %d shards, apiserver=%s)",
+                 n, p.name, self._fleet.n_shards, addr)
+        return None
+
     def shutdown_scheduler(self) -> None:
         if self._fleet is not None:
             self._fleet.shutdown()
             self._fleet = None
             log.info("scheduler fleet shut down")
+        if self._proc_api is not None:
+            self._proc_api.shutdown()
+            self._proc_api = None
         for name, sched in list(self._scheds.items()):
             sched.shutdown()
             log.info("scheduler %s shut down", name)
@@ -355,12 +425,14 @@ class SchedulerService:
         RestartScheduler scheduler.go:40-47). Queue/cache state is rebuilt
         from surviving store state, same as the reference."""
         profiles, config, multi = self._profiles, self._config, self._multi
-        fleet_n = self._fleet_n
+        fleet_n, proc_n = self._fleet_n, self._fleet_proc_n
         self.shutdown_scheduler()
         self._profiles, self._config = [], None
+        self._fleet_proc_n = 0
         spec: ProfileSpec = profiles if multi else (profiles[0] if profiles
                                                     else None)
-        return self.start_scheduler(spec, config, fleet=fleet_n or None)
+        return self.start_scheduler(spec, config, fleet=fleet_n or None,
+                                    fleet_proc=proc_n or None)
 
     def get_scheduler_profile(self) -> Optional[Profile]:
         """reference GetSchedulerConfig (scheduler.go:89-91)."""

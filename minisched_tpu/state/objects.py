@@ -471,6 +471,60 @@ class Lease:
         return not self.holder or now - self.renewed_at > self.ttl_s
 
 
+@dataclass
+class ReplicaStatus:
+    """One process-fleet replica's heartbeat record (fleet/procfleet.py):
+    the replica CAS-writes it every lease tick so the supervisor's
+    census, the elastic rebalancer's load signals, and the warm-takeover
+    readiness gate all read ONE store object per replica instead of
+    scraping N processes. ``incarnation`` bumps on every respawn (the
+    exit-code census keys on it); ``ready`` flips only after the
+    bucket-ladder pre-warm completes (the admission-gate analog: a
+    replica that is still compiling must not claim shards it cannot
+    serve at full speed); the load fields feed the rebalancer's
+    donor/recipient nomination."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    pid: int = 0
+    incarnation: int = 0
+    ready: bool = False
+    warm: bool = False           # pre-warm completed (compile cache hot)
+    queue_depth: int = 0         # pending pods in the replica's queue
+    overload_level: int = 0      # overload-ladder rung (burn signal)
+    pods_bound: int = 0
+    renewed_at: float = 0.0      # replica's time.time() heartbeat stamp
+    address: str = ""            # replica's own journal/provenance server
+
+    @property
+    def key(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class ShardMove:
+    """One elastic-handoff directive (fleet/procfleet.py): the
+    supervisor's rebalancer nominates ``shard`` to move from ``donor``
+    to ``recipient``; the donor voluntarily releases the lease (holder
+    cleared by CAS, epoch untouched) and flips ``state`` to released;
+    the recipient claims through the ordinary lease protocol (epoch+1
+    CAS) and deletes the directive. Ownership itself only ever moves
+    through the Lease object — the directive is routing intent, so a
+    crashed recipient merely leaves a stale directive any peer may
+    ignore after ``ttl_s``."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    shard: int = 0
+    donor: str = ""
+    recipient: str = ""
+    state: str = "nominated"     # nominated -> released -> (deleted)
+    nominated_at: float = 0.0
+    ttl_s: float = 10.0
+
+    @property
+    def key(self) -> str:
+        return self.metadata.name
+
+
 KIND_OF = {
     Pod: "Pod",
     Node: "Node",
@@ -479,11 +533,14 @@ KIND_OF = {
     Event: "Event",
     PodDisruptionBudget: "PodDisruptionBudget",
     Lease: "Lease",
+    ReplicaStatus: "ReplicaStatus",
+    ShardMove: "ShardMove",
 }
 
 NAMESPACED = {"Pod": True, "Node": False, "PersistentVolume": False,
               "PersistentVolumeClaim": True, "Event": True,
-              "PodDisruptionBudget": True, "Lease": False}
+              "PodDisruptionBudget": True, "Lease": False,
+              "ReplicaStatus": False, "ShardMove": False}
 
 
 def kind_of(obj: Any) -> str:

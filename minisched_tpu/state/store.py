@@ -124,7 +124,8 @@ class ClusterStore:
     """Thread-safe typed object store with versioned watch log."""
 
     KINDS = ("Pod", "Node", "PersistentVolume", "PersistentVolumeClaim",
-             "Event", "PodDisruptionBudget", "Lease")
+             "Event", "PodDisruptionBudget", "Lease", "ReplicaStatus",
+             "ShardMove")
 
     def __init__(self, max_log: int = 100_000):
         self._cond = threading.Condition()

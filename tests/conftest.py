@@ -34,6 +34,14 @@ assert enforce_cpu_only()
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running suites (process-fleet spawns) — deselected "
+        "by the tier-1 run's -m 'not slow'; `make fleet-proc-smoke` "
+        "runs them explicitly")
+
+
 def cpu_devices(n: int = 8):
     devs = jax.devices("cpu")
     return devs[:n] if len(devs) >= n else None

@@ -57,6 +57,29 @@ def test_crud_round_trip_over_the_wire(remote):
         rs.get("Pod", "default/w-p0")
 
 
+def test_empty_namespace_key_survives_per_object_routes(remote):
+    """An empty-namespace object's key is "/name", so its per-object
+    URLs carry a double slash (GET /apis/Pod//name, POST /bind//name).
+    The route parser must preserve that interior empty segment:
+    collapsing it looks up "name", 404s, and the engine's bind path
+    treats the 404 as pod-deleted — silently forgetting a live pod
+    (the out-of-process replicas bind permit-delayed pods through
+    exactly this route)."""
+    store, rs = remote
+    rs.create(_node("ns-n0"))
+    rs.create(obj.Pod(metadata=obj.ObjectMeta(name="bare"),
+                      spec=obj.PodSpec(requests={"cpu": 100})))
+    assert store.get("Pod", "/bare").metadata.name == "bare"
+    got = rs.get("Pod", "/bare")          # GET /apis/Pod//bare
+    assert got.metadata.name == "bare"
+    bound = rs.bind_pod("/bare", "ns-n0")  # POST /bind//bare
+    assert bound.spec.node_name == "ns-n0"
+    assert store.get("Pod", "/bare").spec.node_name == "ns-n0"
+    rs.delete("Pod", "/bare")             # DELETE /apis/Pod//bare
+    with pytest.raises(NotFoundError):
+        rs.get("Pod", "/bare")
+
+
 def test_error_mapping(remote):
     _store, rs = remote
     rs.create(_node("e-n0"))

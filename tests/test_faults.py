@@ -856,6 +856,86 @@ def test_corrupted_lease_cannot_mint_two_owners(registry):
     assert peer.epoch_of(0) == 2 and zombie.epoch_of(0) == 0
 
 
+def test_proc_gate_err_fails_spawn_with_capped_backoff(registry):
+    """``proc:err`` fails a replica-process SPAWN before fork: counted,
+    journaled, and the respawn backoff doubles up to its cap — the
+    crashloop / fork-bomb guard. No OS process is ever created."""
+    from minisched_tpu.fleet.procfleet import ProcFleetSupervisor, _Proc
+
+    sup = ProcFleetSupervisor(ClusterStore(), "http://127.0.0.1:1",
+                              replicas=1, respawn=False, prewarm=False,
+                              backoff0_s=0.25, backoff_cap_s=1.0)
+    sup._procs["p0"] = _Proc(rid="p0")
+    _configure("proc:err@1,proc:err@2,proc:err@3")
+    for _ in range(3):
+        assert sup._spawn("p0") is False
+    assert sup.counters["spawn_failures"] == 3
+    assert sup.counters["spawns"] == 0
+    p = sup._procs["p0"]
+    assert p.popen is None and not p.alive
+    assert p.backoff_s == 1.0  # 0.25 -> 0.5 -> 1.0 (capped)
+
+
+def test_proc_gate_err_drops_heartbeat(registry):
+    """``proc:err`` on the heartbeat seam: the CAS write never leaves
+    the replica — counted, journaled, census object untouched. Miss
+    enough and the supervisor's census reads the replica stale, which
+    is the intended degraded-network failure mode."""
+    from minisched_tpu.fleet.procfleet import push_heartbeat
+
+    store = ClusterStore()
+    counters = {}
+    assert push_heartbeat(store, "pX", {"ready": True, "renewed_at": 1.0},
+                          counters=counters)
+    _configure("proc:err@1")
+    assert push_heartbeat(store, "pX", {"renewed_at": 2.0},
+                          counters=counters) is False
+    assert counters["heartbeats_dropped"] == 1
+    assert store.get("ReplicaStatus", "replica-pX").renewed_at == 1.0
+    # Gate consumed: the next heartbeat lands cleanly.
+    assert push_heartbeat(store, "pX", {"renewed_at": 2.0},
+                          counters=counters)
+    assert store.get("ReplicaStatus", "replica-pX").renewed_at == 2.0
+
+
+def test_proc_gate_corrupt_heartbeat_loses_cas(registry):
+    """``proc:corrupt`` sends the heartbeat with a REWOUND
+    resource_version: the store CAS rejects it by construction (the
+    lease:corrupt proof applied to the census object) — the supervisor's
+    census can be starved by corruption, never poisoned."""
+    from minisched_tpu.fleet.procfleet import push_heartbeat
+
+    store = ClusterStore()
+    counters = {}
+    assert push_heartbeat(store, "pY", {"ready": True, "renewed_at": 1.0,
+                                        "queue_depth": 2},
+                          counters=counters)
+    _configure("proc:corrupt@1")
+    assert push_heartbeat(store, "pY", {"renewed_at": 9.0,
+                                        "queue_depth": 99},
+                          counters=counters) is False
+    assert counters["stale_heartbeats_rejected"] == 1
+    st = store.get("ReplicaStatus", "replica-pY")
+    assert (st.renewed_at, st.queue_depth) == (1.0, 2)
+    assert push_heartbeat(store, "pY", {"renewed_at": 9.0},
+                          counters=counters)
+    assert store.get("ReplicaStatus", "replica-pY").renewed_at == 9.0
+
+
+def test_proc_gate_die_outside_replica_is_distinguishable(registry,
+                                                          monkeypatch):
+    """``proc:die`` consulted OUTSIDE a replica process propagates as
+    FaultWorkerDeath (never a SIGKILL of the test runner); the spawn
+    seam treats it as a spawn failure. Inside a real replica the same
+    rule is a genuine SIGKILL — pinned by the process-level suite."""
+    from minisched_tpu.fleet.procfleet import proc_gate
+
+    monkeypatch.delenv("MINISCHED_PROC_REPLICA", raising=False)
+    _configure("proc:die@once")
+    with pytest.raises(FaultWorkerDeath):
+        proc_gate()
+
+
 # ---- whole-suite coverage ------------------------------------------------
 
 

@@ -1,0 +1,512 @@
+"""Out-of-process fleet suite (fleet/procfleet.py).
+
+Two layers. The UNIT layer drives the elastic-handoff machinery
+synchronously against an in-process store: the rebalancer's hysteresis
+contract (a move needs the SAME donor hottest for ``hold`` consecutive
+windows — oscillating skew produces ZERO moves structurally, not by
+tuning), the ShardMove directive protocol (donor voluntary release →
+recipient epoch-bump claim → directive deleted, with released shards
+reserved against bystander claims), heartbeat CAS, and the
+MINISCHED_REBALANCE grammar. The INTEGRATION layer (marked ``slow``;
+``make fleet-proc-smoke`` runs it) spawns REAL replica processes over
+RemoteStore and pins the robustness claims: clean partition and binds,
+SIGKILL failover with exactly-once placement and a journaled takeover
+within ~one lease TTL, exit-code census + capped-backoff respawn,
+cross-process journal merge (postmortem's monotone-seq contract holds
+over the re-sequenced stream), provenance fan-out with replica
+attribution, and a live directive-driven shard handoff between two
+running processes.
+
+The fleet × device-loop composition test is UNMARKED (in-process, runs
+in tier-1): crash a replica with staged ring tranches and the adopter
+must drain to placements bit-identical to a fault-free run.
+"""
+import threading
+import time
+
+import pytest
+
+from minisched_tpu.config import SchedulerConfig
+from minisched_tpu.fleet.lease import LeaseManager
+from minisched_tpu.fleet.procfleet import (ProcFleetSupervisor,
+                                           RebalanceSpec, ShardRebalancer,
+                                           _reserved_shards,
+                                           handle_move_directives,
+                                           parse_rebalance_spec,
+                                           push_heartbeat, replica_tick)
+from minisched_tpu.fleet.shardmap import lease_name, move_name, shard_of
+from minisched_tpu.obs import journal as journal_mod
+from minisched_tpu.scenario import Cluster
+from minisched_tpu.service.defaultconfig import Profile
+from minisched_tpu.state import objects as obj
+from minisched_tpu.state.store import ClusterStore
+
+PROFILE = Profile(plugins=["NodeUnschedulable", "NodeResourcesFit",
+                           "NodeResourcesLeastAllocated"])
+
+#: Small-but-honest engine shape for the end-to-end runs (the
+#: test_fleet.py shape, tightened for process replicas on a CPU host).
+PROC_CONFIG = dict(max_batch_size=16, batch_window_s=0.05,
+                   batch_idle_s=0.02, backoff_initial_s=0.05,
+                   backoff_max_s=0.2)
+
+
+def _pod(name, cpu=100, priority=0):
+    return obj.Pod(metadata=obj.ObjectMeta(name=name, namespace="default"),
+                   spec=obj.PodSpec(requests={"cpu": cpu},
+                                    priority=priority))
+
+
+def _status(rid, queue_depth=0, overload_level=0, ready=True,
+            renewed_at=None):
+    return obj.ReplicaStatus(
+        metadata=obj.ObjectMeta(name=f"replica-{rid}"),
+        queue_depth=queue_depth, overload_level=overload_level,
+        ready=ready,
+        renewed_at=time.time() if renewed_at is None else renewed_at)
+
+
+class _FakeEngine:
+    """Records the adopt/release protocol the real engine implements."""
+
+    def __init__(self, n_shards=2, owned=()):
+        self.n_shards = n_shards
+        self.owned = set(owned)
+        self.calls = []
+
+    @property
+    def shard_view(self):
+        return (self.n_shards, frozenset(self.owned), 0)
+
+    def release_shards(self, shards, *, epoch=0, reason=""):
+        self.owned -= set(shards)
+        self.calls.append(("release", sorted(shards), epoch, reason))
+
+    def adopt_shards(self, shards, *, epoch=0, reason=""):
+        self.owned |= set(shards)
+        self.calls.append(("adopt", sorted(shards), epoch, reason))
+        return 0
+
+
+# ---- MINISCHED_REBALANCE grammar ----------------------------------------
+
+
+def test_parse_rebalance_spec_grammar():
+    assert parse_rebalance_spec(None) is None
+    assert parse_rebalance_spec("") is None
+    assert parse_rebalance_spec("0") is None
+    assert parse_rebalance_spec("1") == RebalanceSpec()
+    spec = parse_rebalance_spec("skew=2.5,hold=5,cooldown=1,"
+                                "burn_weight=4,max_moves=0,stale_s=3")
+    assert (spec.skew, spec.hold, spec.cooldown) == (2.5, 5, 1)
+    assert (spec.burn_weight, spec.max_moves, spec.stale_s) == (4.0, 0, 3.0)
+
+
+@pytest.mark.parametrize("bad", [
+    "frobnicate=1",      # unknown knob
+    "skew",              # not name=value
+    "hold=three",        # unparsable value
+])
+def test_parse_rebalance_spec_rejects_junk(bad):
+    with pytest.raises(ValueError):
+        parse_rebalance_spec(bad)
+
+
+# ---- heartbeat CAS -------------------------------------------------------
+
+
+def test_push_heartbeat_creates_then_cas_updates():
+    store = ClusterStore()
+    counters = {}
+    assert push_heartbeat(store, "p7", {"pid": 123, "ready": True,
+                                        "renewed_at": 1.0},
+                          counters=counters)
+    st = store.get("ReplicaStatus", "replica-p7")
+    assert st.pid == 123 and st.ready
+    assert push_heartbeat(store, "p7", {"queue_depth": 5,
+                                        "renewed_at": 2.0},
+                          counters=counters)
+    st = store.get("ReplicaStatus", "replica-p7")
+    # CAS update merged the new fields over the surviving old ones.
+    assert st.queue_depth == 5 and st.pid == 123
+    assert st.renewed_at == 2.0
+    assert counters["heartbeats"] == 2
+
+
+# ---- rebalancer hysteresis -----------------------------------------------
+
+
+def test_rebalancer_nominates_only_after_sustained_skew():
+    """hold=3: the same donor must stay hottest with skew >= threshold
+    for three CONSECUTIVE windows before a directive appears."""
+    store = ClusterStore()
+    clk = [100.0]
+    reb = ShardRebalancer(store, RebalanceSpec(skew=4.0, hold=3,
+                                               cooldown=2),
+                          clock=lambda: clk[0])
+    hot = {"p0": _status("p0", queue_depth=20),
+           "p1": _status("p1", queue_depth=0)}
+    holders = {0: "p0", 1: "p1"}
+    assert reb.observe(hot, holders) is None   # streak 1
+    assert reb.observe(hot, holders) is None   # streak 2
+    assert list(store.list("ShardMove")) == []
+    moved = reb.observe(hot, holders)          # streak 3 -> nominate
+    assert moved is not None
+    mv = store.get("ShardMove", move_name(0))
+    assert (mv.donor, mv.recipient, mv.state) == ("p0", "p1", "nominated")
+    assert reb.counters["moves_nominated"] == 1
+    # Cooldown: the next `cooldown` windows are quiet even under skew.
+    assert reb.observe(hot, holders) is None
+    assert reb.observe(hot, holders) is None
+    assert reb.counters["moves_nominated"] == 1
+
+
+def test_rebalancer_skew_collapse_resets_streak():
+    store = ClusterStore()
+    reb = ShardRebalancer(store, RebalanceSpec(skew=4.0, hold=3,
+                                               cooldown=2))
+    hot = {"p0": _status("p0", queue_depth=20), "p1": _status("p1")}
+    calm = {"p0": _status("p0", queue_depth=1), "p1": _status("p1")}
+    holders = {0: "p0", 1: "p1"}
+    assert reb.observe(hot, holders) is None
+    assert reb.observe(hot, holders) is None
+    assert reb.observe(calm, holders) is None   # collapse: streak -> 0
+    assert reb.observe(hot, holders) is None    # streak restarts at 1
+    assert reb.observe(hot, holders) is None
+    assert reb.counters["moves_nominated"] == 0
+    assert reb.counters["streak_resets"] >= 1
+
+
+def test_rebalancer_oscillating_skew_never_flaps():
+    """The acceptance pin: A-hot, B-hot, A-hot ... for many windows
+    nominates NOTHING — the donor-identity streak reset makes flapping
+    structurally impossible, not merely improbable."""
+    store = ClusterStore()
+    reb = ShardRebalancer(store, RebalanceSpec(skew=4.0, hold=3,
+                                               cooldown=2))
+    a_hot = {"p0": _status("p0", queue_depth=30), "p1": _status("p1")}
+    b_hot = {"p0": _status("p0"), "p1": _status("p1", queue_depth=30)}
+    holders = {0: "p0", 1: "p1"}
+    for i in range(24):
+        reb.observe(a_hot if i % 2 == 0 else b_hot, holders)
+    assert reb.counters["moves_nominated"] == 0
+    assert list(store.list("ShardMove")) == []
+    assert reb.counters["streak_resets"] >= 10
+
+
+def test_rebalancer_burn_signal_weights_overload_rung():
+    store = ClusterStore()
+    reb = ShardRebalancer(store, RebalanceSpec(burn_weight=8.0))
+    st = _status("p0", queue_depth=3, overload_level=2)
+    assert reb.load_of(st) == 3 + 8.0 * 2
+
+
+def test_rebalancer_reaps_stale_directives():
+    store = ClusterStore()
+    clk = [100.0]
+    reb = ShardRebalancer(store, RebalanceSpec(stale_s=5.0),
+                          clock=lambda: clk[0])
+    store.create(obj.ShardMove(metadata=obj.ObjectMeta(name=move_name(0)),
+                               shard=0, donor="p0", recipient="p1",
+                               state="released", nominated_at=100.0,
+                               ttl_s=5.0))
+    assert reb.reap_stale() == 0
+    clk[0] = 106.0
+    assert reb.reap_stale() == 1
+    assert list(store.list("ShardMove")) == []
+    assert reb.counters["moves_reaped"] == 1
+
+
+# ---- directive protocol --------------------------------------------------
+
+
+def test_move_directive_protocol_donor_release_recipient_adopt():
+    """The full handoff, driven synchronously: donor releases the lease
+    VOLUNTARILY (holder cleared, epoch untouched, immediately
+    claimable), recipient claims with the usual epoch bump and deletes
+    the directive. While the directive is live, the released shard is
+    reserved against everyone but the recipient."""
+    store = ClusterStore()
+    clk = [0.0]
+    mgr_a = LeaseManager(store, "p0", ttl_s=10.0, clock=lambda: clk[0])
+    mgr_b = LeaseManager(store, "p1", ttl_s=10.0, clock=lambda: clk[0])
+    mgr_c = LeaseManager(store, "p2", ttl_s=10.0, clock=lambda: clk[0])
+    assert mgr_a.try_acquire(0) and mgr_a.try_acquire(1)
+    eng_a = _FakeEngine(owned={0, 1})
+    eng_b = _FakeEngine()
+    eng_c = _FakeEngine()
+    epoch0 = mgr_a.epoch_of(0)
+    store.create(obj.ShardMove(metadata=obj.ObjectMeta(name=move_name(0)),
+                               shard=0, donor="p0", recipient="p1",
+                               state="nominated",
+                               nominated_at=time.time(), ttl_s=60.0))
+
+    # Donor pass: stop serving, clear the holder, flip to released.
+    assert handle_move_directives(store, "p0", mgr_a, eng_a) \
+        == ["donated:0"]
+    lease = store.get("Lease", lease_name(0))
+    assert lease.holder == "" and lease.epoch == epoch0
+    assert not mgr_a.holds(0) and mgr_a.holds(1)
+    assert eng_a.calls[0][0] == "release" and eng_a.calls[0][1] == [0]
+    assert "p1" in eng_a.calls[0][3]
+    assert store.get("ShardMove", move_name(0)).state == "released"
+
+    # Bystander pass: the released shard is reserved for the recipient —
+    # p2's claim scan must skip it (and p1's held lease on shard 1).
+    assert _reserved_shards(store, "p2") == {0}
+    replica_tick(store, "p2", mgr_c, eng_c, 2, clock=lambda: clk[0])
+    assert mgr_c.held() == {}
+
+    # Recipient pass: epoch-bump claim, adopt, delete the directive.
+    assert handle_move_directives(store, "p1", mgr_b, eng_b) \
+        == ["adopted:0"]
+    lease = store.get("Lease", lease_name(0))
+    assert lease.holder == "p1" and lease.epoch == epoch0 + 1
+    assert eng_b.calls[0][0] == "adopt" and "p0" in eng_b.calls[0][3]
+    assert list(store.list("ShardMove")) == []
+
+
+def test_stale_directive_is_ignored_by_both_sides():
+    store = ClusterStore()
+    clk = [0.0]
+    mgr_a = LeaseManager(store, "p0", ttl_s=10.0, clock=lambda: clk[0])
+    assert mgr_a.try_acquire(0)
+    eng_a = _FakeEngine(owned={0})
+    store.create(obj.ShardMove(metadata=obj.ObjectMeta(name=move_name(0)),
+                               shard=0, donor="p0", recipient="p1",
+                               state="nominated",
+                               nominated_at=time.time() - 120.0,
+                               ttl_s=5.0))
+    assert handle_move_directives(store, "p0", mgr_a, eng_a) == []
+    assert mgr_a.holds(0) and eng_a.calls == []
+    # ...and it reserves nothing: the reap path owns its deletion.
+    assert _reserved_shards(store, "p2") == set()
+
+
+def test_replica_tick_prefer_limits_boot_claims():
+    """The boot-time round-robin deal: with ``prefer`` set, a replica
+    claims only its preferred shards even when others are free."""
+    store = ClusterStore()
+    clk = [0.0]
+    mgr = LeaseManager(store, "p1", ttl_s=10.0, clock=lambda: clk[0])
+    eng = _FakeEngine(n_shards=4)
+    replica_tick(store, "p1", mgr, eng, 4, clock=lambda: clk[0],
+                 prefer={1, 3})
+    assert sorted(mgr.held()) == [1, 3]
+    replica_tick(store, "p1", mgr, eng, 4, clock=lambda: clk[0])
+    assert sorted(mgr.held()) == [0, 1, 2, 3]  # widened: claims the rest
+
+
+# ---- fleet x device-loop composition (in-process, tier-1) ----------------
+
+
+def test_fleet_crash_with_staged_ring_tranche_drains_bit_identical(
+        monkeypatch):
+    """Crash (abandon) the replica that owns every pod while depth-8
+    ring tranches are staged: staged-unresolved slots must never commit,
+    the adopter re-derives the dead replica's backlog from store truth,
+    and the final placements are BIT-IDENTICAL to a fault-free fleet run
+    — zero pods lost, zero doubly bound, crash changes nothing about
+    WHAT is decided."""
+    monkeypatch.setenv("MINISCHED_LEASE_TTL", "0.4")
+    names = [f"d{i}" for i in range(800)
+             if shard_of(f"default/d{i}", 2) == 0][:40]
+    assert len(names) == 40
+    cfg = dict(device_loop=True, loop_depth=8, max_batch_size=8,
+               batch_window_s=0.3, batch_idle_s=0.1,
+               backoff_initial_s=0.05, backoff_max_s=0.2)
+    profile = Profile(name="loop",
+                      plugins=["NodeUnschedulable", "NodeResourcesFit"],
+                      plugin_args={"NodeResourcesFit":
+                                   {"score_strategy": None}})
+
+    def run(crash):
+        c = Cluster()
+        try:
+            for i, cpu in enumerate((64000, 48000, 32000)):
+                c.create_node(f"n{i}", cpu=cpu)
+            c.start(profile=profile, config=SchedulerConfig(**cfg),
+                    with_pv_controller=False, fleet=2)
+            fleet = c.service.fleet
+            assert fleet.wait_converged(10.0)
+            victim = fleet.owner_of(0)
+            c.create_objects([_pod(n, cpu=100 + 13 * i,
+                                   priority=1000 - i)
+                              for i, n in enumerate(names)])
+            if crash:
+                time.sleep(0.1)  # mid-burst: tranches staged/in flight
+                assert fleet.kill(victim, crash=True)
+            deadline = time.monotonic() + 120
+            placed = {}
+            while time.monotonic() < deadline:
+                placed = {p.metadata.name: p.spec.node_name
+                          for p in c.list_pods() if p.spec.node_name}
+                if len(placed) == len(names):
+                    break
+                time.sleep(0.05)
+            assert len(placed) == len(names), \
+                f"only {len(placed)}/{len(names)} bound"
+            # exactly-once: one store object per pod, each bound once
+            assert sorted(p.metadata.name for p in c.list_pods()) \
+                == sorted(names)
+            return placed
+        finally:
+            c.shutdown()
+
+    baseline = run(crash=False)
+    crashed = run(crash=True)
+    assert crashed == baseline
+
+
+# ---- real replica processes (slow; `make fleet-proc-smoke`) --------------
+
+
+def _wait(pred, timeout, step=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+@pytest.fixture(scope="module")
+def proc_fleet():
+    from minisched_tpu.apiserver.server import APIServer
+
+    journal_mod.configure("1")
+    store = ClusterStore()
+    for i, cpu in enumerate((64000, 64000, 48000, 48000)):
+        store.create(obj.Node(
+            metadata=obj.ObjectMeta(name=f"n{i}"),
+            status=obj.NodeStatus(allocatable={"cpu": cpu,
+                                               "memory": 64 << 30,
+                                               "pods": 500})))
+    api = APIServer(store).start()
+    sup = ProcFleetSupervisor(
+        store, api.address, replicas=2, lease_ttl_s=1.0,
+        prewarm=False, respawn=True, backoff0_s=0.1, backoff_cap_s=1.0,
+        stable_s=5.0, config_overrides=dict(PROC_CONFIG),
+        profile=PROFILE)
+    sup.start()
+    try:
+        assert sup.wait_ready(timeout=180), "replicas never came ready"
+        assert sup.wait_converged(timeout=60), "shards never claimed"
+        yield store, sup
+    finally:
+        sup.shutdown()
+        api.shutdown()
+        journal_mod.configure("")
+
+
+@pytest.mark.slow
+def test_proc_fleet_partitions_and_binds(proc_fleet):
+    """Boot census + clean partition: both processes heartbeat ready,
+    the round-robin deal gives each replica its own shard, and a pod
+    burst binds exactly once across the partition."""
+    store, sup = proc_fleet
+    census = sup.census()
+    assert sorted(census) == ["p0", "p1"]
+    assert all(st.pid > 0 and st.ready for st in census.values())
+    holders = sup.lease_holders()
+    assert len(holders) == 2 and set(holders.values()) == {"p0", "p1"}
+    for i in range(24):
+        store.create(_pod(f"a{i}"))
+    assert _wait(lambda: sum(1 for p in store.list("Pod")
+                             if p.spec.node_name) == 24, 60)
+    pods = list(store.list("Pod"))
+    assert sorted(p.metadata.name for p in pods) \
+        == sorted(f"a{i}" for i in range(24))  # no loss, no resurrection
+    m = sup.metrics()
+    assert m["proc_spawns"] >= 2 and m["fleet_replicas_live"] == 2
+
+
+@pytest.mark.slow
+def test_proc_sigkill_failover_exactly_once_and_journaled(proc_fleet):
+    """The tentpole's failover claim over REAL processes: SIGKILL one
+    replica mid-burst, every pod still lands exactly once, the survivor
+    claims the dead shard through the epoch fence within ~one TTL past
+    expiry, the takeover is journaled in the MERGED cross-process stream
+    (postmortem's monotone-seq contract holds), and the supervisor's
+    exit-code census reads exactly one -9."""
+    from tools.postmortem import validate_journal
+
+    store, sup = proc_fleet
+    before = {p.metadata.name for p in store.list("Pod")}
+    for i in range(40):
+        store.create(_pod(f"k{i}", cpu=100 + i))
+    time.sleep(0.1)  # mid-burst: the victim has work queued/in flight
+    kill_unix = time.time()
+    assert sup.kill("p1")
+    assert _wait(lambda: sum(1 for p in store.list("Pod")
+                             if p.spec.node_name) == len(before) + 40,
+                 90)
+    pods = list(store.list("Pod"))
+    assert len(pods) == len({p.metadata.name for p in pods}) \
+        == len(before) + 40  # exactly once each
+    # Census: one SIGKILL death, mourned with its exit code.
+    assert _wait(lambda: sup.exit_codes.get("-9", 0) >= 1, 30)
+    assert sup.counters["kills"] == 1
+    # Takeover journaled in the merged stream, with source attribution.
+    doc = sup.journal()
+    assert set(doc["sources"]) >= {"p0", "supervisor"}
+    validate_journal(doc["entries"])  # fresh seqs stay monotone
+    takes = [e for e in doc["entries"]
+             if e["kind"] == "lease.takeover" and e.get("frm") == "p1"]
+    assert takes, "survivor never journaled the takeover"
+    assert takes[0]["source"] == "p0"
+    deaths = [e for e in doc["entries"] if e["kind"] == "proc.death"]
+    assert deaths and deaths[0]["source"] == "supervisor"
+    assert deaths[0]["exit_code"] == -9
+    # Claim latency: expiry horizon is one TTL past the last heartbeat;
+    # the scan must land within ~one more TTL (+ slack for a 1-core
+    # host's process scheduling).
+    assert takes[0]["unix"] - kill_unix < 1.0 * 2 + 3.0
+    # The survivor owns everything until the respawn re-earns its shard.
+    assert _wait(lambda: set(sup.lease_holders().values()) == {"p0"}, 30)
+    # Respawn: a fresh incarnation comes back under the capped backoff
+    # and heartbeats ready again.
+    assert _wait(lambda: "p1" in sup.census()
+                 and sup.census()["p1"].incarnation >= 1, 120)
+    assert sup.counters["respawns"] >= 1
+
+
+@pytest.mark.slow
+def test_proc_elastic_handoff_executes_across_processes(proc_fleet):
+    """A nominated directive executes across two LIVE processes: the
+    donor voluntarily releases, the recipient claims with an epoch bump
+    and deletes the directive — no TTL wait, both sides journaled."""
+    store, sup = proc_fleet
+    assert sup.wait_converged(60)
+    holders = sup.lease_holders()
+    # Move shard 0 off whoever holds it.
+    donor = holders[0]
+    recipient = ({"p0", "p1"} - {donor}).pop()
+    epoch0 = store.get("Lease", lease_name(0)).epoch
+    store.create(obj.ShardMove(metadata=obj.ObjectMeta(name=move_name(0)),
+                               shard=0, donor=donor, recipient=recipient,
+                               state="nominated",
+                               nominated_at=time.time(), ttl_s=60.0))
+    assert _wait(lambda: sup.lease_holders().get(0) == recipient, 30), \
+        "handoff never completed"
+    assert store.get("Lease", lease_name(0)).epoch == epoch0 + 1
+    assert _wait(lambda: not list(store.list("ShardMove")), 15)
+    doc = sup.journal()
+    rel = [e for e in doc["entries"]
+           if e["kind"] == "proc.rebalance_release"]
+    ado = [e for e in doc["entries"]
+           if e["kind"] == "proc.rebalance_adopt"]
+    assert rel and rel[0]["source"] == donor
+    assert ado and ado[0]["source"] == recipient
+
+
+@pytest.mark.slow
+def test_proc_provenance_fans_out_with_attribution(proc_fleet):
+    store, sup = proc_fleet
+    store.create(_pod("prov-probe"))
+    assert _wait(lambda: store.get("Pod", "default/prov-probe")
+                 .spec.node_name, 60)
+    rec = sup.provenance("default/prov-probe")
+    assert rec is not None and rec.get("replica")
+    assert rec["served_by"] in ("p0", "p1")
+    assert rec["served_by"] == rec["replica"]

@@ -50,7 +50,7 @@ _RECOVERY_KINDS = ("supervisor.recover", "overload.recover",
 _CHAIN_PREFIXES = ("supervisor.", "overload.", "index.", "shortlist.",
                    "residency.", "loop.", "watchdog.", "slo.",
                    "queue.", "bundle.", "invariant.", "lease.",
-                   "fleet.")
+                   "fleet.", "proc.", "engine.")
 
 
 def validate_journal(events: List[dict]) -> None:
@@ -178,16 +178,23 @@ def _fmt_event(ev: dict) -> str:
     kind = ev.get("kind", "?")
     detail = ev.get("to") or ev.get("outcome") or ev.get("reason") \
         or ev.get("slo") or ev.get("gate") or ev.get("cause") or ""
-    if kind.startswith(("lease.", "fleet.")):
+    if kind.startswith(("lease.", "fleet.", "proc.")):
         # Fleet events read as WHO did WHAT: takeover names the dead
         # peer and the claiming epoch; others name the acting replica.
         who = ev.get("replica", "")
         frm = ev.get("frm", "")
         if kind == "lease.takeover" and frm:
             detail = f"{who}<-{frm}@e{ev.get('epoch', '?')}"
+        elif kind == "proc.death":
+            detail = (f"{who} exit={ev.get('exit_code', '?')}"
+                      f" up={ev.get('uptime_s', '?')}s")
         elif who:
             detail = f"{who}" + (f": {detail}" if detail else "")
-    return f"{kind}({detail})" if detail else kind
+    # A merged cross-process journal tags each record with the replica
+    # process it came from; keep that attribution in the narrative.
+    src = ev.get("source", "")
+    line = f"{kind}({detail})" if detail else kind
+    return f"{src}|{line}" if src else line
 
 
 def narrative(events: List[dict]) -> List[str]:
