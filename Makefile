@@ -14,8 +14,8 @@ SHELL := /bin/bash
 
 .PHONY: test tier1 fault-smoke shortlist-smoke trace-smoke slo-smoke \
         churn-smoke overload-smoke loop-smoke index-smoke journal-smoke \
-        fleet-smoke fleet-proc-smoke tenant-smoke auction-smoke \
-        profile-smoke start \
+        fleet-smoke fleet-proc-smoke election-smoke tenant-smoke \
+        auction-smoke profile-smoke start \
         start-remote \
         start-client-engine \
         demo docs \
@@ -175,6 +175,21 @@ fleet-proc-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet_proc.py -x -q \
 	  -p no:cacheprovider -p no:randomly
 
+# Self-governing fleet suite (~50 s): supervisor-less steward election
+# over the shared store — CAS crown races admit exactly one winner,
+# expiry succession epoch-fences stale directives, steward duties
+# (census/mourn/respawn) hand off exactly-once across a SIGKILL'd
+# steward, burn-signal rebalance migrates under sustained skew and
+# holds still under oscillation, and the counted store.reattach arc
+# rides out a full apiserver restart. Includes the slow-marked
+# detached-fleet E2Es tier-1's `-m 'not slow'` deselects. A tier-1
+# prerequisite after fleet-proc-smoke: the elected steward replaces the
+# parent supervisor that fleet-proc pins, so that layer must already
+# hold.
+election-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_election.py -x -q \
+	  -p no:cacheprovider -p no:randomly
+
 # The EXACT ROADMAP tier-1 verify command (dots count + exit code
 # preserved) — what the driver runs after every PR; run it locally
 # before shipping. shortlist-smoke runs first: the arbitration
@@ -194,10 +209,12 @@ fleet-proc-smoke:
 # shares the carry/ring/shortlist seams and must stay bit-identical
 # across them); fleet-proc-smoke after auction-smoke (process
 # supervision is the outermost layer — replicas run the full engine
-# stack, so every seam below must already hold).
+# stack, so every seam below must already hold); election-smoke after
+# fleet-proc-smoke (the elected steward replaces the parent supervisor,
+# so the supervised fleet layer must already hold).
 tier1: shortlist-smoke trace-smoke slo-smoke overload-smoke loop-smoke \
        index-smoke journal-smoke fleet-smoke tenant-smoke auction-smoke \
-       fleet-proc-smoke churn-smoke
+       fleet-proc-smoke election-smoke churn-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -340,6 +357,7 @@ bench-check:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_journal.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_fleet.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_fleet_proc.py --check
+	JAX_PLATFORMS=cpu $(PY) tools/bench_election.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_tenants.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_auction.py --check
 

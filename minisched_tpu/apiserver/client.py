@@ -23,15 +23,17 @@ from __future__ import annotations
 import http.client
 import json
 import logging
+import os
 import socket
 import threading
 import time
 import urllib.parse
-from typing import Any, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..errors import (AlreadyExistsError, ConflictError, NotFoundError,
                       UnauthorizedError, WatchFellBehindError)
 from ..faults import FAULTS, FaultInjected
+from ..obs.journal import note as jnote
 from ..state import objects as obj
 from ..utils.breaker import BreakerOpenError, CircuitBreaker
 from ..utils.retry import jittered_delays
@@ -112,6 +114,22 @@ class RemoteStore:
         self.retry_deadline_s = retry_deadline_s
         self.breaker = (CircuitBreaker(breaker_threshold, breaker_reset_s)
                         if breaker_threshold > 0 else None)
+        # Apiserver-outage ride-through (fleet/election.py): after
+        # ``outage_after`` CONSECUTIVE wire-class failures the client
+        # declares the store down (journaled ``store.outage``); the
+        # first successful exchange afterwards closes the arc
+        # (``store.reattach``, duration counted) and fires every
+        # ``on_reattach`` callback — the seam where a replica re-lists
+        # state, re-claims shards through a fresh epoch, and reconciles
+        # staged binds against store truth. Callbacks run on the calling
+        # thread with no client lock held (they may re-enter the store).
+        self.outage_after = 3
+        self._reattach_lock = threading.Lock()
+        self._consec_failures = 0
+        self._down_since: Optional[float] = None
+        self._reattach_cbs: List[Any] = []
+        self._reattach_counters: Dict[str, float] = {
+            "outages": 0, "reattaches": 0, "last_outage_s": 0.0}
         u = urllib.parse.urlparse(self.address)
         if u.scheme not in ("http", "https"):
             raise ValueError(f"unsupported scheme in {address!r}; "
@@ -253,6 +271,7 @@ class RemoteStore:
                                       timeout=timeout, _retries=_retries)
                 if self.breaker is not None:
                     self.breaker.record_success()
+                self._note_wire_success()
                 return out
             except (NotFoundError, UnauthorizedError, AlreadyExistsError,
                     ConflictError, WatchFellBehindError):
@@ -260,6 +279,7 @@ class RemoteStore:
                 # wire is healthy, the breaker heals on them
                 if self.breaker is not None:
                     self.breaker.record_success()
+                self._note_wire_success()
                 raise
             except Exception as e:
                 # Remaining failures are wire-shaped (refused/reset/
@@ -269,12 +289,17 @@ class RemoteStore:
                 # unhealthy; the ambiguity stays the caller's). A
                 # non-5xx _ServerError is an ANSWER (the server is up,
                 # the request was bad) and heals the breaker instead.
+                answered = (isinstance(e, _ServerError)
+                            and not 500 <= e.status < 600)
                 if self.breaker is not None:
-                    if (isinstance(e, _ServerError)
-                            and not 500 <= e.status < 600):
+                    if answered:
                         self.breaker.record_success()
                     else:
                         self.breaker.record_failure()
+                if answered:
+                    self._note_wire_success()
+                else:
+                    self._note_wire_failure()
                 last_err = e
                 now = time.monotonic()
                 if (deadline is None or now >= deadline
@@ -492,6 +517,67 @@ class RemoteStore:
         (Scheduler.metrics() prefixes these ``store_``). Empty when the
         breaker is disabled."""
         return self.breaker.stats() if self.breaker is not None else {}
+
+    # ---- apiserver-outage ride-through ----------------------------------
+
+    def on_reattach(self, cb) -> None:
+        """Register ``cb(outage_s: float)`` to fire on the first
+        successful exchange after a detected outage — the replica-side
+        reconciliation hook (re-list, re-claim, reconcile). Callbacks
+        run on whichever thread's call ended the outage, with no client
+        lock held; exceptions are swallowed (a broken hook must never
+        poison the call that just succeeded)."""
+        with self._reattach_lock:
+            self._reattach_cbs.append(cb)
+
+    def reattach_stats(self) -> Dict[str, float]:
+        """Outage/reattach counters for the /metrics surface
+        (Scheduler.metrics() prefixes these ``store_``)."""
+        with self._reattach_lock:
+            out = dict(self._reattach_counters)
+            out["down"] = 1.0 if self._down_since is not None else 0.0
+            return out
+
+    def _note_wire_failure(self) -> None:
+        """One wire-class failure observed. Crossing ``outage_after``
+        consecutive failures declares the outage (journaled once)."""
+        with self._reattach_lock:
+            self._consec_failures += 1
+            if (self._down_since is not None
+                    or self._consec_failures < self.outage_after):
+                return
+            self._down_since = time.monotonic()
+            self._reattach_counters["outages"] += 1
+        jnote("store.outage", address=self.address,
+              replica=os.environ.get("MINISCHED_PROC_REPLICA", ""),
+              after_failures=self.outage_after)
+        log.warning("apiserver outage declared (%s): %d consecutive "
+                    "wire failures", self.address, self.outage_after)
+
+    def _note_wire_success(self) -> None:
+        """One successful exchange. If an outage was open this closes
+        the arc: journaled with its duration, counted, and every
+        ``on_reattach`` callback fires (outside the lock — callbacks
+        re-enter the store to re-list/reconcile)."""
+        with self._reattach_lock:
+            self._consec_failures = 0
+            if self._down_since is None:
+                return
+            outage_s = time.monotonic() - self._down_since
+            self._down_since = None
+            self._reattach_counters["reattaches"] += 1
+            self._reattach_counters["last_outage_s"] = round(outage_s, 3)
+            cbs = list(self._reattach_cbs)
+        jnote("store.reattach", address=self.address,
+              replica=os.environ.get("MINISCHED_PROC_REPLICA", ""),
+              outage_s=round(outage_s, 3))
+        log.warning("apiserver reattached (%s) after %.2fs outage",
+                    self.address, outage_s)
+        for cb in cbs:
+            try:
+                cb(outage_s)
+            except Exception:
+                log.exception("reattach callback failed; continuing")
 
 
 class RemoteWatcher:

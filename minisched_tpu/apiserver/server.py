@@ -72,6 +72,7 @@ from __future__ import annotations
 
 import json
 import logging
+import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -81,6 +82,53 @@ from ..state import objects as obj
 from ..state.store import ClusterStore
 
 log = logging.getLogger(__name__)
+
+
+class _TrackingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that remembers open connection sockets so
+    shutdown() can SEVER established keep-alive clients. Without this a
+    "restarted" apiserver only closes its front door: handler threads on
+    existing connections keep serving the old sessions, which no real
+    process restart ever does — and the client-side outage detection
+    (RemoteStore's ride-through arc) would never see the outage."""
+
+    def __init__(self, *args, **kwargs):
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        super().__init__(*args, **kwargs)
+
+    def process_request(self, request, client_address):
+        with self._conns_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conns_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def handle_error(self, request, client_address):
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionError, TimeoutError)):
+            return  # severed at shutdown / client vanished: expected
+        super().handle_error(request, client_address)
+
+    def close_all_connections(self) -> int:
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        return len(conns)
 
 
 class APIServer:
@@ -172,7 +220,7 @@ class APIServer:
                                 self.admission_providers,
                                 self.journal_providers,
                                 self.provenance_providers)
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd = _TrackingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: threading.Thread | None = None
@@ -200,21 +248,28 @@ class APIServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
-        if self.checkpointer is not None:
-            # The accept loop is stopped but in-flight handler threads
-            # are daemons socketserver never joins — drain the mutating
-            # ones (bounded) so every write a client saw acknowledged is
-            # inside the final snapshot.
-            import time as _time
+        # The accept loop is stopped but in-flight handler threads are
+        # daemons socketserver never joins — drain the mutating ones
+        # (bounded) so every write a client saw acknowledged lands:
+        # inside the final snapshot when a checkpoint is due, and before
+        # the socket under it is severed either way.
+        import time as _time
 
-            deadline = _time.monotonic() + 5.0
-            with self._mutating_cv:
-                while self._mutating and _time.monotonic() < deadline:
-                    self._mutating_cv.wait(0.1)
-                if self._mutating:
-                    log.warning(
-                        "shutdown checkpoint proceeding with %d mutating "
-                        "request(s) still in flight", self._mutating)
+        deadline = _time.monotonic() + 5.0
+        with self._mutating_cv:
+            while self._mutating and _time.monotonic() < deadline:
+                self._mutating_cv.wait(0.1)
+            if self._mutating:
+                log.warning(
+                    "shutdown proceeding with %d mutating request(s) "
+                    "still in flight", self._mutating)
+        # Sever established keep-alive connections: a stopped apiserver
+        # must look like a stopped PROCESS — no old session keeps
+        # serving out of the dead accept loop. This is what makes a
+        # restart visible to clients as an outage (the RemoteStore
+        # ride-through arc) instead of a silent store swap.
+        self._httpd.close_all_connections()
+        if self.checkpointer is not None:
             self.checkpointer.close()
             self.checkpointer = None
 
@@ -311,6 +366,11 @@ def _make_handler(store: ClusterStore, token: str | None = None,
                 self._error(409, str(e), reason="Conflict")
             except (KeyError, TypeError, ValueError) as e:
                 self._error(400, f"{type(e).__name__}: {e}")
+            except ConnectionError:
+                # The client died mid-exchange (a SIGKILL'd replica's
+                # long-poll, a severed shutdown socket): nothing to
+                # answer and nothing wrong server-side.
+                self.close_connection = True
             except Exception as e:  # pragma: no cover - server must answer
                 log.exception("apiserver internal error")
                 self._error(500, f"{type(e).__name__}: {e}")

@@ -349,6 +349,12 @@ class OverloadController:
         self._since_change = 10 ** 9  # a fresh engine may act at once
         self._sl_since = 10 ** 9      # the tuner's own hysteresis clock
         self._clean = 0
+        # Last window's burning SYMPTOM objectives — the burn signal a
+        # fleet replica publishes on its lease heartbeat (the steward's
+        # rebalance trigger reads it; fleet/election.py). Written only
+        # by the scheduling thread, read cross-thread as an immutable
+        # frozenset (worst case one stale window, never torn).
+        self.last_burning: frozenset = frozenset()
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {
             "overload_escalations": 0, "overload_recoveries": 0,
@@ -388,6 +394,7 @@ class OverloadController:
             return False
         cfg = OVERLOAD
         self._last_window_t = time.monotonic()
+        self.last_burning = frozenset(burning)
         self._since_change += 1
         self._sl_since += 1
         prev_level = self.level

@@ -1437,6 +1437,10 @@ class Scheduler:
             # lost the pod's shard lease between decision and commit
             # (the pod is handed back; the new owner re-gathers it).
             "stale_owner_binds": 0,
+            # Apiserver-outage ride-through: post-reattach reconciles of
+            # the queue against store truth (fleet/election.py drives
+            # reconcile_store after RemoteStore.reattach fires).
+            "store_reconciles": 0,
             "encode_s_total": 0.0, "step_s_total": 0.0,
             "step_dispatch_s_total": 0.0, "commit_s_total": 0.0,
             "gap_s_total": 0.0,
@@ -2191,6 +2195,39 @@ class Scheduler:
               shards=",".join(str(s) for s in sorted(shards)),
               epoch=epoch, pods=len(released), reason=reason)
         return len(released)
+
+    def burn_signal(self) -> tuple:
+        """The per-replica burn signal a fleet replica publishes on its
+        heartbeats (fleet/election.py): ``(overload_level,
+        "obj1,obj2")`` — the overload-ladder rung plus the last window's
+        burning SYMPTOM objectives. Cross-thread safe (immutable int +
+        frozenset reads)."""
+        return (int(self._overload.level),
+                ",".join(sorted(self._overload.last_burning)))
+
+    def reconcile_store(self, *, reason: str = "") -> Dict[str, int]:
+        """Post-outage reconciliation against store truth (the
+        apiserver-outage ride-through, fleet/election.py): drop every
+        QUEUED pod the store already shows bound (a bind that committed
+        before the outage must not be re-attempted — the store CAS would
+        reject it anyway, but the queue should not carry zombies), then
+        re-gather every unbound owned pod the outage may have orphaned
+        (the queue's keyed dedupe skips pods already queued/in-flight).
+        Nothing lost, nothing doubly bound — both halves re-derived from
+        the store, never from this replica's pre-outage memory."""
+        pods = self.store.list("Pod")
+        bound = {p.key for p in pods if p.spec.node_name}
+        dropped = self.queue.release_unwanted(
+            lambda p: p.key not in bound and self.wants_pod(p))
+        requeue = [p for p in pods
+                   if not p.spec.node_name and self.wants_pod(p)]
+        if requeue:
+            self.queue.add_many(requeue)
+        self._metrics["store_reconciles"] += 1
+        jnote("engine.reconcile", profile=self.profile,
+              replica=self.replica, dropped=len(dropped),
+              requeued=len(requeue), reason=reason)
+        return {"dropped": len(dropped), "requeued": len(requeue)}
 
     # ---- lifecycle ------------------------------------------------------
 
@@ -5391,6 +5428,12 @@ class Scheduler:
         breaker_stats = getattr(self.store, "breaker_stats", None)
         if callable(breaker_stats):
             for k, v in breaker_stats().items():
+                out[f"store_{k}"] = v
+        # Apiserver-outage ride-through counters (RemoteStore.reattach):
+        # outages detected, reattach arcs completed, last outage length.
+        reattach_stats = getattr(self.store, "reattach_stats", None)
+        if callable(reattach_stats):
+            for k, v in reattach_stats().items():
                 out[f"store_{k}"] = v
         # Temporal telemetry: snapshot/drop counts for the timeline
         # ring and the per-objective burning gauges (1 while an SLO's

@@ -67,6 +67,18 @@ production scheduler's failure domain spans:
                 ReplicaStatus heartbeat payload with a REWOUND
                 resource_version before the CAS so the store must
                 reject it (counted; supervisor census stays truthful).
+    election    steward-election seam (fleet/election.py) — ``err``
+                DROPS the CAS election call (the claim/renew attempt
+                is skipped and counted; miss enough and the steward
+                lease expires, handing stewardship to a peer), ``die``
+                kills the would-be steward AT CLAIM TIME (inside a
+                replica process it is a real SIGKILL, outside it raises
+                like any worker death — a peer then claims through the
+                TTL, never a double steward), ``corrupt`` scribbles the
+                PUBLISHED BURN SIGNAL on a heartbeat (an absurd
+                overload level; the rebalancer's plausibility clamp +
+                the no-flap hysteresis detect and discard it — counted,
+                zero moves minted from a scribble).
 
 Configured once per process from ``MINISCHED_FAULTS`` (tests reconfigure
 via :func:`configure`), a comma-separated list of ``gate:action@trigger``
@@ -136,10 +148,12 @@ log = logging.getLogger(__name__)
 # cross-check can catch it. proc sits on the process-fleet lifecycle
 # seams (fleet/procfleet.py): spawn, replica heartbeat, and the
 # replica-side batch seam where ``die`` becomes a real SIGKILL.
+# election sits on the steward-election seams (fleet/election.py):
+# the CAS claim/renew call and the burn-signal heartbeat publication.
 GATES = ("step", "fetch", "residency", "shortlist_repair", "commit",
          "bind", "informer", "http", "checkpoint", "lifecycle",
          "admission", "index", "journal", "lease", "auction_mirror",
-         "proc")
+         "proc", "election")
 
 _ACTIONS = ("err", "die", "corrupt", "stall")
 

@@ -52,12 +52,21 @@ class LeaseManager:
 
     def __init__(self, store, replica: str, *,
                  ttl_s: Optional[float] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 lease_name_fn: Callable[[int], str] = lease_name,
+                 burn_provider: Optional[Callable[[], tuple]] = None):
         self.store = store
         self.replica = replica
         self.ttl_s = float(ttl_s) if ttl_s is not None \
             else lease_ttl_from_env()
         self._clock = clock
+        #: Injectable name map — the steward election (fleet/election.py)
+        #: reuses this manager verbatim against its ONE named lease.
+        self._lease_name = lease_name_fn
+        #: Burn publication (self-governing fleet): ``() -> (level,
+        #: "obj1,obj2")`` stamped onto every renewal heartbeat so the
+        #: steward's rebalance trigger reads load off the lease records.
+        self._burn_provider = burn_provider
         self._lock = threading.Lock()
         self._held: Dict[int, int] = {}  # shard -> epoch this replica won
         #: Counters surfaced through FleetSupervisor.metrics(): renewals,
@@ -87,7 +96,7 @@ class LeaseManager:
         """Claim the shard if its lease is unheld or expired: epoch bump
         through the store CAS. Exactly one concurrent claimant wins; the
         rest count a ``claim_conflict`` and return False."""
-        name = lease_name(shard)
+        name = self._lease_name(shard)
         now = self._clock()
         try:
             lease = self.store.get("Lease", name)
@@ -153,7 +162,7 @@ class LeaseManager:
             jnote("lease.heartbeat_dropped", replica=self.replica,
                   shard=shard, epoch=my_epoch)
             return False
-        name = lease_name(shard)
+        name = self._lease_name(shard)
         try:
             lease = self.store.get("Lease", name)
         except NotFoundError:
@@ -164,6 +173,16 @@ class LeaseManager:
                        f"superseded by {lease.holder}@{lease.epoch}")
             return False
         lease.renewed_at = self._clock()
+        if self._burn_provider is not None:
+            # Burn signal rides the heartbeat it already pays for: the
+            # overload rung + burning objectives land on the lease
+            # record, where the steward's rebalance scan reads them.
+            try:
+                level, names = self._burn_provider()
+                lease.burn_level = int(level)
+                lease.burning = str(names)
+            except Exception:
+                pass  # a failed probe never blocks the renewal
         if act == "corrupt":
             # Zombie heartbeat: write with a rewound resource_version.
             # The CAS below rejects it BY CONSTRUCTION — the containment
@@ -211,7 +230,7 @@ class LeaseManager:
         my_epoch = self._held.get(shard)
         if my_epoch is None:
             return False
-        name = lease_name(shard)
+        name = self._lease_name(shard)
         try:
             lease = self.store.get("Lease", name)
         except NotFoundError:

@@ -74,8 +74,9 @@ from ..faults import FAULTS, FaultInjected, FaultWorkerDeath
 from ..obs.journal import JOURNAL, note as jnote
 from ..state import objects as obj
 from .lease import LeaseManager
-from .shardmap import (FLEET_PROC_ENV, LEASE_TTL_ENV, REBALANCE_ENV,
-                       SHARDS_ENV, lease_name, lease_ttl_from_env,
+from .shardmap import (FLEET_ELECT_ENV, FLEET_PROC_ENV, LEASE_TTL_ENV,
+                       REBALANCE_ENV, SHARDS_ENV, fleet_elect_from_env,
+                       incarnation_name, lease_name, lease_ttl_from_env,
                        move_name, shard_of, shards_from_env, status_name)
 
 import logging
@@ -92,6 +93,14 @@ _INCARNATION_ENV = "MINISCHED_PROC_INCARNATION"
 _PREWARM_ENV = "MINISCHED_PROC_PREWARM"
 _TICK_ENV = "MINISCHED_PROC_TICK_S"
 _FLEET_N_ENV = "MINISCHED_PROC_FLEET_N"
+#: Detached replica (fleet/election.py launcher): no supervisor stdin
+#: tether — the process answers only to SIGTERM and the store.
+_DETACHED_ENV = "MINISCHED_PROC_DETACHED"
+
+#: A published overload level above this is implausible (the real
+#: ladder is 4 rungs deep): the rebalancer discards it as an
+#: ``election:corrupt`` scribble instead of minting load from it.
+MAX_PLAUSIBLE_BURN = 8
 
 
 def proc_gate() -> Optional[str]:
@@ -259,9 +268,16 @@ class ShardRebalancer:
         self._streak = 0
         self._last_donor = ""
         self._cooldown_left = 0
+        #: Fencing token stamped onto every nominated directive: the
+        #: steward's lease epoch under the self-governing fleet (0 =
+        #: the unfenced supervised path). Replicas reject directives
+        #: below the current steward epoch — a dead steward's leftover
+        #: nominations cannot move shards.
+        self.steward_epoch = 0
         self.counters: Dict[str, int] = {
             "windows": 0, "moves_nominated": 0, "moves_reaped": 0,
-            "streak_resets": 0,
+            "streak_resets": 0, "burn_nominations": 0,
+            "burn_scribbles_ignored": 0,
         }
 
     def load_of(self, st) -> float:
@@ -285,11 +301,37 @@ class ShardRebalancer:
         if len(statuses) < 2:
             self._reset_streak()
             return None
-        loads = {rid: self.load_of(st) for rid, st in statuses.items()}
+        # Plausibility clamp: an ``election:corrupt`` scribble publishes
+        # an absurd burn level; discarding it (counted) means a scribble
+        # can only HIDE load, never mint a move — and the hysteresis
+        # below already covers a signal that flickers.
+        levels: Dict[str, int] = {}
+        burning: Dict[str, str] = {}
+        for rid, st in statuses.items():
+            lvl = int(getattr(st, "overload_level", 0))
+            names = str(getattr(st, "burning", "") or "")
+            if lvl < 0 or lvl > MAX_PLAUSIBLE_BURN:
+                self.counters["burn_scribbles_ignored"] += 1
+                jnote("proc.rebalance_scribble", replica=rid, level=lvl)
+                lvl, names = 0, ""
+            levels[rid] = lvl
+            burning[rid] = names
+        loads = {rid: (float(st.queue_depth)
+                       + self.spec.burn_weight * levels[rid])
+                 for rid, st in statuses.items()}
         donor = max(sorted(loads), key=lambda r: loads[r])
         recipient = min(sorted(loads), key=lambda r: loads[r])
-        if donor == recipient or \
-                loads[donor] - loads[recipient] < self.spec.skew:
+        skew_ok = (donor != recipient
+                   and loads[donor] - loads[recipient] >= self.spec.skew)
+        # Burn trigger (self-governing fleet): one replica burning SLOs
+        # while every peer sits idle is actionable even before the queue
+        # skew crosses the threshold — the same streak/cooldown
+        # hysteresis applies, so oscillating burn still moves nothing.
+        burn_ok = (donor != recipient
+                   and (levels[donor] > 0 or bool(burning[donor]))
+                   and all(levels[r] == 0 and not burning[r]
+                           for r in loads if r != donor))
+        if not (skew_ok or burn_ok):
             self._reset_streak()
             return None
         if donor != self._last_donor:
@@ -318,7 +360,8 @@ class ShardRebalancer:
             move = obj.ShardMove(
                 metadata=obj.ObjectMeta(name=name), shard=shard,
                 donor=donor, recipient=recipient, state="nominated",
-                nominated_at=self._clock(), ttl_s=self.spec.stale_s)
+                nominated_at=self._clock(), ttl_s=self.spec.stale_s,
+                steward_epoch=self.steward_epoch)
             try:
                 self.store.create(move)
             except AlreadyExistsError:
@@ -331,11 +374,21 @@ class ShardRebalancer:
         self._streak = 0
         self._last_donor = ""
         self._cooldown_left = self.spec.cooldown
+        # Burn takes the label when both hold: a burning donor with idle
+        # peers is the SPECIFIC condition (the weighted load usually
+        # crosses the skew bar too, but the burn signal is why).
+        trigger = "burn" if burn_ok else "skew"
+        if trigger == "burn":
+            self.counters["burn_nominations"] += 1
+            jnote("rebalance.burn_nominate", shard=move.shard,
+                  donor=donor, recipient=recipient,
+                  level=levels[donor], burning=burning[donor][:80],
+                  epoch=self.steward_epoch)
         jnote("proc.rebalance_nominate", shard=move.shard, donor=donor,
-              recipient=recipient,
+              recipient=recipient, trigger=trigger,
               skew=round(loads[donor] - loads[recipient], 3))
-        log.info("rebalance: nominated shard %d %s -> %s (skew %.1f)",
-                 move.shard, donor, recipient,
+        log.info("rebalance: nominated shard %d %s -> %s (%s, skew %.1f)",
+                 move.shard, donor, recipient, trigger,
                  loads[donor] - loads[recipient])
         return move.key
 
@@ -367,8 +420,8 @@ class ShardRebalancer:
 
 
 def handle_move_directives(store, rid: str, mgr: LeaseManager, engine,
-                           *, clock: Callable[[], float] = time.time
-                           ) -> List[str]:
+                           *, clock: Callable[[], float] = time.time,
+                           steward_epoch_floor: int = 0) -> List[str]:
     """Replica-side half of the elastic handoff — one pass over the
     ShardMove directives that name this replica. Factored out of the
     replica tick so tests can drive the protocol synchronously against
@@ -386,6 +439,17 @@ def handle_move_directives(store, rid: str, mgr: LeaseManager, engine,
     for mv in list(store.list("ShardMove")):
         if clock() - mv.nominated_at > mv.ttl_s:
             continue  # stale: the supervisor's reap owns it
+        if 0 < mv.steward_epoch < steward_epoch_floor:
+            # Epoch fence (self-governing fleet): a directive stamped by
+            # a steward whose lease epoch has since moved on is a dead
+            # steward's leftover — it must never move a shard. The
+            # current steward's reap deletes it; until then every
+            # replica refuses it. (epoch 0 = the unfenced supervised
+            # path — the parent never dies without taking the fleet.)
+            jnote("proc.rebalance_fenced", replica=rid, shard=mv.shard,
+                  directive_epoch=mv.steward_epoch,
+                  floor=steward_epoch_floor)
+            continue
         if mv.state == "nominated" and mv.donor == rid \
                 and mgr.holds(mv.shard):
             epoch = mgr.epoch_of(mv.shard)
@@ -442,7 +506,8 @@ def _reserved_shards(store, rid: str,
 def replica_tick(store, rid: str, mgr: LeaseManager, engine,
                  n_shards: int, *,
                  clock: Callable[[], float] = time.monotonic,
-                 prefer: Optional[set] = None) -> None:
+                 prefer: Optional[set] = None,
+                 steward_epoch_floor: int = 0) -> None:
     """One pass of the replica-side lease protocol (the in-process
     supervisor's tick, re-homed into the replica because there is no
     shared-memory supervisor to run it): renew, sync lost shards,
@@ -459,7 +524,8 @@ def replica_tick(store, rid: str, mgr: LeaseManager, engine,
         engine.release_shards(
             lost, epoch=max(mgr.held().values(), default=0),
             reason="lease lost")
-    handle_move_directives(store, rid, mgr, engine)
+    handle_move_directives(store, rid, mgr, engine,
+                           steward_epoch_floor=steward_epoch_floor)
     reserved = _reserved_shards(store, rid)
     now = clock()
     for shard in range(n_shards):
@@ -567,7 +633,17 @@ def replica_main() -> int:
         profile = default_scheduler_profile()
     store = RemoteStore(main_addr, token=token)
     n_shards = shards_from_env(1)
-    mgr = LeaseManager(store, rid)
+    detached = (os.environ.get(_DETACHED_ENV, "") or "0") not in ("", "0")
+    elect = fleet_elect_from_env() > 0
+    # Burn publication rides the lease heartbeat; the provider lands in
+    # this cell once the engine exists (the manager must predate it —
+    # the bind guard closes over the manager).
+    burn_cell: Dict[str, Optional[Callable[[], tuple]]] = {"fn": None}
+    mgr = LeaseManager(
+        store, rid,
+        burn_provider=((lambda: burn_cell["fn"]()
+                        if burn_cell["fn"] else (0, ""))
+                       if elect else None))
     hb_counters: Dict[str, int] = {}
 
     ready = {"flag": False}
@@ -584,6 +660,49 @@ def replica_main() -> int:
     engine.set_bind_guard(
         lambda key, _m=mgr, _n=n_shards: _m.holds(shard_of(key, _n)))
     engine.start()
+
+    # Self-governing fleet (MINISCHED_FLEET_ELECT): this replica runs
+    # the election, and WHEN it holds the steward lease it also runs the
+    # parent's extracted duties — census, respawn, rebalance.
+    election = duties = None
+    if elect:
+        from .election import (StewardDuties, StewardElection,
+                               burn_fields, ensure_roster)
+
+        burn_cell["fn"] = engine.burn_signal
+        election = StewardElection(store, rid, ttl_s=mgr.ttl_s)
+        reb_spec = rebalance_from_env()
+        reb = (ShardRebalancer(store, reb_spec)
+               if reb_spec is not None else None)
+        duties = StewardDuties(store, rid, election, tick_s=tick_s,
+                               ttl_s=mgr.ttl_s, rebalancer=reb)
+        try:
+            # Idempotent: ensure our own census record exists, then CAS
+            # our liveness onto it (never the incarnation — only a
+            # steward's mourn bumps that).
+            ensure_roster(store, [rid])
+            rec = store.get("Incarnation", incarnation_name(rid))
+            rec.state = "alive"
+            rec.pid = os.getpid()
+            if incarnation >= rec.incarnation:
+                rec.incarnation = incarnation
+            rec.updated_at = time.time()
+            store.update(rec, check_version=True)
+        except Exception:
+            log.exception("replica %s: census boot write failed; "
+                          "the steward's scan will repair it", rid)
+
+    # Apiserver-outage ride-through: when the RemoteStore declares the
+    # wire back after an outage, the next tick re-earns EVERYTHING
+    # through fresh epochs — drop local lease claims, release the
+    # engine's shards, reconcile staged binds against store truth.
+    reattach_box = {"pending": False, "outage_s": 0.0}
+    if callable(getattr(store, "on_reattach", None)):
+        def _mark_reattached(outage_s: float) -> None:
+            reattach_box["outage_s"] = float(outage_s)
+            reattach_box["pending"] = True
+
+        store.on_reattach(_mark_reattached)
 
     # Sidecar apiserver: serves THIS process's journal / provenance /
     # metrics to the supervisor's aggregation poll. Its admission gate
@@ -602,6 +721,8 @@ def replica_main() -> int:
             out[f"proc_{k}"] = v
         out["proc_incarnation"] = incarnation
         out["proc_warm"] = 1.0 if warm_s >= 0 else 0.0
+        if duties is not None:
+            out.update(duties.metrics())
         return out
 
     side.metrics_providers.append(_metrics)
@@ -621,8 +742,9 @@ def replica_main() -> int:
             pass
         stop.set()
 
-    threading.Thread(target=_tether, daemon=True,
-                     name="supervisor-tether").start()
+    if not detached:
+        threading.Thread(target=_tether, daemon=True,
+                         name="supervisor-tether").start()
     try:
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
     except ValueError:
@@ -646,21 +768,53 @@ def replica_main() -> int:
 
     while not stop.wait(tick_s):
         try:
+            if reattach_box["pending"]:
+                # Ride-through recovery: everything this replica held
+                # before the outage is re-earned through a FRESH epoch.
+                # Release engine-side first (epochs still known), then
+                # forget the local claims; the claim scan below
+                # re-acquires expired leases with epoch+1, and the
+                # reconcile squares staged binds against store truth —
+                # nothing lost, nothing doubly bound.
+                reattach_box["pending"] = False
+                held_now = frozenset(mgr.held())
+                if held_now:
+                    engine.release_shards(
+                        held_now,
+                        epoch=max(mgr.held().values(), default=0),
+                        reason="store reattach")
+                mgr.drop_all()
+                if election is not None:
+                    election.drop()
+                engine.reconcile_store(
+                    reason="reattach after %.2fs outage"
+                           % reattach_box["outage_s"])
+                hb_counters["reattach_recoveries"] = \
+                    hb_counters.get("reattach_recoveries", 0) + 1
+            floor = 0
+            if election is not None:
+                election.tick()
+                duties.tick(n_shards)
+                floor = election.observed_epoch()
             use_prefer = (prefer if prefer is not None
                           and time.monotonic() < prefer_until else None)
             replica_tick(store, rid, mgr, engine, n_shards,
-                         prefer=use_prefer)
+                         prefer=use_prefer, steward_epoch_floor=floor)
             m = engine.metrics()
-            push_heartbeat(
-                store, rid,
-                {"pid": os.getpid(), "incarnation": incarnation,
-                 "ready": True, "warm": warm_s >= 0,
-                 "queue_depth": int(engine.queue.pending_count()),
-                 "overload_level": int(m.get("overload_level", 0)),
-                 "pods_bound": int(m.get("pods_bound", 0)),
-                 "renewed_at": time.time(),
-                 "address": side.address},
-                counters=hb_counters)
+            hb = {"pid": os.getpid(), "incarnation": incarnation,
+                  "ready": True, "warm": warm_s >= 0,
+                  "queue_depth": int(engine.queue.pending_count()),
+                  "overload_level": int(m.get("overload_level", 0)),
+                  "pods_bound": int(m.get("pods_bound", 0)),
+                  "renewed_at": time.time(),
+                  "address": side.address}
+            if elect:
+                # The published burn signal (election:corrupt scribbles
+                # it HERE — the rebalancer's clamp is the detection).
+                from .election import burn_fields
+
+                hb.update(burn_fields(engine, counters=hb_counters))
+            push_heartbeat(store, rid, hb, counters=hb_counters)
         except Exception:
             # A replica process is the unit of failure: a tick fault is
             # logged and retried, never fatal — only SIGKILL (or the
@@ -669,6 +823,11 @@ def replica_main() -> int:
 
     # Graceful exit (NOT the crash model — that is SIGKILL, which never
     # reaches here): drain the engine, tell the census we left.
+    if election is not None and election.is_steward:
+        try:
+            election.resign()  # a peer claims without a TTL wait
+        except Exception:
+            pass
     engine.shutdown()
     try:
         push_heartbeat(store, rid,
@@ -813,10 +972,14 @@ class ProcFleetSupervisor:
         env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
         if self.token:
             env[_TOKEN_ENV] = self.token
-        # The child must never recurse into fleet wiring of its own.
+        # The child must never recurse into fleet wiring of its own —
+        # and a PARENTED replica never runs the election (the modes are
+        # mutually exclusive: a supervisor IS the steward).
         env.pop(FLEET_PROC_ENV, None)
         env.pop("MINISCHED_FLEET", None)
         env.pop(REBALANCE_ENV, None)
+        env.pop(FLEET_ELECT_ENV, None)
+        env.pop(_DETACHED_ENV, None)
         return env
 
     def _spawn(self, rid: str) -> bool:

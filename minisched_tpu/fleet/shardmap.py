@@ -26,6 +26,10 @@ LEASE_TTL_ENV = "MINISCHED_LEASE_TTL"
 FLEET_PROC_ENV = "MINISCHED_FLEET_PROC"
 #: Elastic shard handoff spec (fleet/procfleet.ShardRebalancer).
 REBALANCE_ENV = "MINISCHED_REBALANCE"
+#: Self-governing fleet (fleet/election.py): replicas CAS-compete for
+#: an epoch-fenced steward lease instead of being parented by a
+#: supervisor process — the steward runs census/respawn/rebalance.
+FLEET_ELECT_ENV = "MINISCHED_FLEET_ELECT"
 
 
 def shard_of(pod_key: str, n_shards: int) -> int:
@@ -78,3 +82,24 @@ def move_name(shard: int) -> str:
     """The store key of a shard's elastic-handoff directive (at most one
     in-flight move per shard by construction — the name IS the lock)."""
     return f"move-{shard}"
+
+
+def fleet_elect_from_env(default: int = 0) -> int:
+    try:
+        return int(os.environ.get(FLEET_ELECT_ENV, "") or default)
+    except ValueError:
+        return default
+
+
+def steward_name() -> str:
+    """The store key of THE steward Lease (cluster-scoped, singular by
+    construction — the name IS the uniqueness guarantee; ownership moves
+    only through the same resource-version CAS as shard leases)."""
+    return "steward"
+
+
+def incarnation_name(replica: str) -> str:
+    """The store key of a replica's Incarnation ledger record (the
+    steward's store-visible census: expected incarnation, death/respawn
+    tallies, exit codes)."""
+    return f"incarnation-{replica}"

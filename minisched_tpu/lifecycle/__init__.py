@@ -10,20 +10,22 @@ from .driver import (AMPLITUDE_ENV, RATE_ENV, SEED_ENV, DisruptionBudget,
                      InvariantViolation, LifecycleDriver, LifecycleEvent,
                      LifecycleView, seed_from_env)
 from .generators import (AutoscalerLoop, Generator, KillScheduler,
-                         PoissonArrivals, ReclamationWave, RestartScheduler,
+                         KillSteward, PoissonArrivals, ReclamationWave,
+                         RestartApiserver, RestartScheduler,
                          RollingUpgrade, TenantMix)
 from .invariants import (LeaseIntegrity, MonotoneVersions, StableBindings,
-                         bound_on_live_nodes, budget_respected,
-                         default_invariants, no_overcommit, no_pod_lost)
+                         StewardUniqueness, bound_on_live_nodes,
+                         budget_respected, default_invariants,
+                         no_overcommit, no_pod_lost)
 
 __all__ = [
     "AMPLITUDE_ENV", "RATE_ENV", "SEED_ENV",
     "AutoscalerLoop", "DisruptionBudget", "Generator",
-    "InvariantViolation", "KillScheduler", "LeaseIntegrity",
-    "LifecycleDriver", "LifecycleEvent",
+    "InvariantViolation", "KillScheduler", "KillSteward",
+    "LeaseIntegrity", "LifecycleDriver", "LifecycleEvent",
     "LifecycleView", "MonotoneVersions", "PoissonArrivals",
-    "ReclamationWave", "RestartScheduler", "RollingUpgrade",
-    "StableBindings", "TenantMix",
+    "ReclamationWave", "RestartApiserver", "RestartScheduler",
+    "RollingUpgrade", "StableBindings", "StewardUniqueness", "TenantMix",
     "bound_on_live_nodes", "budget_respected", "default_invariants",
     "no_overcommit", "no_pod_lost", "seed_from_env",
 ]

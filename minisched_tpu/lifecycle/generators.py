@@ -37,6 +37,13 @@ Catalog (the trace-study staples):
     bring it back after a downtime window. The failover invariants
     (no_pod_lost, stable_bindings, lease_integrity) then certify the
     takeover end-to-end.
+  * :class:`KillSteward` / :class:`RestartApiserver` — the
+    self-governing fleet drills (``MINISCHED_FLEET_ELECT=1``,
+    fleet/election.py): decapitate whichever replica currently holds
+    the steward lease (a peer must claim the crown within one TTL and
+    adopt the census exactly-once), and kill/revive the apiserver on
+    the same port so every replica rides the outage out through the
+    reattach + fresh-epoch re-claim path.
 """
 from __future__ import annotations
 
@@ -337,6 +344,97 @@ class RestartScheduler(Generator):
         yield self.downtime
         if fleet.restart(self.replica):
             env.view.count("scheduler_restarts")
+
+
+class KillSteward(Generator):
+    """Decapitate the self-governing fleet: resolve the CURRENT steward
+    from the store's election lease (fleet/election.py) and SIGKILL that
+    replica mid-workload. No supervisor exists to notice — a surviving
+    peer must claim the steward lease within one TTL, adopt the census
+    ledger, and respawn the victim exactly once; the steward_uniqueness
+    / lease_integrity / no_pod_lost oracle certifies the succession.
+
+    Resolution is store-truth only (the generator holds no process
+    handles): the steward Lease names the victim, its ReplicaStatus
+    heartbeat carries the pid. Degrades to a no-op outside elected
+    process-fleet runs (no steward lease, or no live pid)."""
+
+    STEWARD_NAME = "steward"
+
+    def __init__(self, name: str = "kill-steward", *, after_s: float = 1.0):
+        self.name = name
+        self.after = float(after_s)
+
+    def run(self, env):
+        yield self.after
+        store = env.view.store
+        try:
+            lease = store.get("Lease", self.STEWARD_NAME)
+        except Exception:
+            return  # no election running: nothing to decapitate
+        rid = lease.holder
+        if not rid:
+            return
+        fleet = _fleet_of(env)
+        if fleet is not None and hasattr(fleet, "kill"):
+            if fleet.kill(rid):
+                env.view.count("steward_kills")
+            return
+        # Supervisor-less path: the heartbeat record is the only pid map.
+        try:
+            st = store.get("ReplicaStatus", f"replica-{rid}")
+        except Exception:
+            return
+        pid = int(getattr(st, "pid", 0) or 0)
+        if pid <= 1:
+            return
+        import os
+        import signal
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            return
+        env.view.count("steward_kills")
+
+
+class RestartApiserver(Generator):
+    """Kill the control plane out from under the fleet, then revive it
+    on the SAME port after an outage window — the ride-through drill.
+    Every replica's RemoteStore must declare the outage, reattach on
+    revival, and re-earn its shards through a fresh epoch; the
+    no_pod_lost / stable_bindings oracle certifies that the staged work
+    reconciled against store truth with nothing lost or doubly bound.
+
+    ``server`` is the live APIServer handle or a zero-arg getter for it
+    (the revived instance replaces it via ``on_restart`` so later
+    generators see the fresh handle). The store OBJECT survives — this
+    models an apiserver crash in front of durable etcd, not data loss.
+    Degrades to a no-op when no handle is supplied."""
+
+    def __init__(self, name: str = "restart-apiserver", *,
+                 server=None, after_s: float = 1.0,
+                 outage_s: float = 2.0, on_restart=None):
+        self.name = name
+        self.server = server
+        self.after = float(after_s)
+        self.outage = float(outage_s)
+        self.on_restart = on_restart
+
+    def run(self, env):
+        yield self.after
+        srv = self.server() if callable(self.server) else self.server
+        if srv is None:
+            return
+        port, backing = srv.port, srv.store
+        srv.shutdown()
+        env.view.count("apiserver_outages")
+        yield self.outage
+        from ..apiserver.server import APIServer
+
+        revived = APIServer(backing, port=port).start()
+        env.view.count("apiserver_revivals")
+        if self.on_restart is not None:
+            self.on_restart(revived)
 
 
 class TenantMix(Generator):

@@ -38,6 +38,10 @@ The default set:
     store: epochs never regress and the holder never changes without an
     epoch bump (two live owners of one shard would require exactly such
     a bumpless swap). Vacuously green outside fleet runs.
+  * **steward_uniqueness** — at most one steward lease exists, its
+    epoch is monotone, and the crown never changes hands without an
+    epoch bump (the self-governing fleet's election fence, re-derived
+    from the store). Vacuously green outside elected-fleet runs.
 """
 from __future__ import annotations
 
@@ -193,6 +197,53 @@ class LeaseIntegrity:
         return viols
 
 
+class StewardUniqueness:
+    """Stateful: the steward-election fencing contract (self-governing
+    fleet, fleet/election.py) re-derived from store truth. The steward
+    role lives in ONE named Lease (``shardmap.steward_name()``); this
+    invariant pins exactly what the election CAS must guarantee:
+
+      * no duplicate steward record ever appears (a second lease with
+        the steward's reserved shard sentinel would be two thrones);
+      * the steward epoch is monotone — a regression would un-fence
+        every directive the newer steward already stamped;
+      * the crown never changes hands without an epoch bump — a
+        bumpless swap is exactly the two-live-stewards write the CAS
+        exists to forbid.
+
+    Non-elected runs (no steward lease in the store) are vacuously
+    green, so the invariant is safe in every default soak."""
+
+    STEWARD_NAME = "steward"
+
+    def __init__(self):
+        self._last: Tuple[int, str] = (0, "")  # (epoch, holder)
+
+    def __call__(self, view) -> List[str]:
+        viols = []
+        crowns = [l for l in view.store.list("Lease")
+                  if l.key == self.STEWARD_NAME or l.shard < 0]
+        if not crowns:
+            return viols
+        if len(crowns) > 1:
+            viols.append(
+                "duplicate steward leases: "
+                + ", ".join(sorted(l.key for l in crowns)))
+        lease = next((l for l in crowns if l.key == self.STEWARD_NAME),
+                     crowns[0])
+        epoch0, holder0 = self._last
+        if lease.epoch < epoch0:
+            viols.append(f"steward epoch regressed "
+                         f"{lease.epoch} < {epoch0}")
+        elif (lease.holder and holder0 and lease.holder != holder0
+                and lease.epoch == epoch0):
+            viols.append(
+                f"steward changed {holder0!r} -> {lease.holder!r} "
+                f"without an epoch bump (two live stewards)")
+        self._last = (max(lease.epoch, epoch0), lease.holder or holder0)
+        return viols
+
+
 def default_invariants(driver):
     """(name, fn) pairs the driver installs by default — the standard
     oracle plus one budget invariant per registered pool budget."""
@@ -203,6 +254,7 @@ def default_invariants(driver):
         ("no_overcommit", no_overcommit),
         ("stable_bindings", StableBindings()),
         ("lease_integrity", LeaseIntegrity()),
+        ("steward_uniqueness", StewardUniqueness()),
     ]
     for pool, b in sorted(driver.budgets().items()):
         out.append((f"disruption_budget[{pool}]", budget_respected(b)))

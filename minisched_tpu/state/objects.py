@@ -462,6 +462,13 @@ class Lease:
     ttl_s: float = 3.0
     renewed_at: float = 0.0  # holder's time.monotonic() heartbeat stamp
     shard: int = 0
+    # Burn signal published on the lease heartbeat (fleet/election.py):
+    # the holder's overload-ladder rung + its burning SLO objectives, so
+    # the steward's rebalance trigger reads load straight off the lease
+    # records it already scans (scribbles are the election:corrupt gate;
+    # the rebalancer's plausibility clamp discards them).
+    burn_level: int = 0
+    burning: str = ""  # comma-joined burning objective names
 
     @property
     def key(self) -> str:
@@ -494,6 +501,7 @@ class ReplicaStatus:
     pods_bound: int = 0
     renewed_at: float = 0.0      # replica's time.time() heartbeat stamp
     address: str = ""            # replica's own journal/provenance server
+    burning: str = ""            # comma-joined burning SLO objectives
 
     @property
     def key(self) -> str:
@@ -519,6 +527,43 @@ class ShardMove:
     state: str = "nominated"     # nominated -> released -> (deleted)
     nominated_at: float = 0.0
     ttl_s: float = 10.0
+    # Epoch fence (fleet/election.py): the steward-lease epoch the
+    # nominator held when it wrote this directive. Replicas reject a
+    # directive fenced below the CURRENT steward epoch — a deposed
+    # steward's stale nominations can never move a shard. 0 = unfenced
+    # (the supervised procfleet path, where the parent is the only
+    # nominator by construction).
+    steward_epoch: int = 0
+
+    @property
+    def key(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class Incarnation:
+    """One replica's store-visible incarnation ledger
+    (fleet/election.py): the census record the STEWARD role reads and
+    CAS-advances instead of the parent supervisor's in-memory counters.
+    ``incarnation`` is the expected-current incarnation (bumped by the
+    mourn CAS — exactly one steward wins each bump, which is the
+    exactly-once respawn guarantee); ``state`` tracks the
+    alive → respawning → alive loop (a record stuck ``respawning``
+    past the grace window is an ORPHANED incarnation the successor
+    steward re-adopts); the tallies are the exit-code census."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    replica: str = ""
+    incarnation: int = 0
+    state: str = "alive"         # alive -> respawning -> alive
+    pid: int = 0
+    deaths: int = 0
+    respawns: int = 0
+    exit_codes: Dict[str, int] = field(default_factory=dict)
+    backoff_s: float = 0.0       # capped doubling, adopted by successors
+    updated_at: float = 0.0      # writer's time.time() stamp
+    steward: str = ""            # last steward to mourn/respawn this rid
+    steward_epoch: int = 0       # its fencing epoch at that write
 
     @property
     def key(self) -> str:
@@ -535,12 +580,14 @@ KIND_OF = {
     Lease: "Lease",
     ReplicaStatus: "ReplicaStatus",
     ShardMove: "ShardMove",
+    Incarnation: "Incarnation",
 }
 
 NAMESPACED = {"Pod": True, "Node": False, "PersistentVolume": False,
               "PersistentVolumeClaim": True, "Event": True,
               "PodDisruptionBudget": True, "Lease": False,
-              "ReplicaStatus": False, "ShardMove": False}
+              "ReplicaStatus": False, "ShardMove": False,
+              "Incarnation": False}
 
 
 def kind_of(obj: Any) -> str:

@@ -125,7 +125,7 @@ class ClusterStore:
 
     KINDS = ("Pod", "Node", "PersistentVolume", "PersistentVolumeClaim",
              "Event", "PodDisruptionBudget", "Lease", "ReplicaStatus",
-             "ShardMove")
+             "ShardMove", "Incarnation")
 
     def __init__(self, max_log: int = 100_000):
         self._cond = threading.Condition()

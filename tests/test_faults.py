@@ -936,6 +936,78 @@ def test_proc_gate_die_outside_replica_is_distinguishable(registry,
         proc_gate()
 
 
+def test_election_gate_err_drops_cas_election_call(registry):
+    """``election:err`` drops the would-be steward's CAS attempt: the
+    tick is counted and skipped, the store is never touched, and the
+    next clean tick claims normally — a flaky challenger can only delay
+    its own coronation, never corrupt the crown."""
+    from minisched_tpu.fleet.election import StewardElection
+
+    store = ClusterStore()
+    elect = StewardElection(store, "pe", ttl_s=5.0, clock=lambda: 100.0)
+    _configure("election:err@1")
+    assert elect.tick() is False
+    assert elect.counters["elections_dropped"] == 1
+    with pytest.raises(Exception):
+        store.get("Lease", "steward")  # no lease was ever written
+    _configure("")
+    assert elect.tick() is True  # clean tick: coronation proceeds
+
+
+def test_election_gate_die_outside_replica_is_distinguishable(
+        registry, monkeypatch):
+    """``election:die`` consulted OUTSIDE a replica process propagates
+    as FaultWorkerDeath (never a SIGKILL of the test runner). Inside a
+    real replica the same rule is a genuine SIGKILL of the would-be
+    steward at claim time — pinned by the process-level suite."""
+    from minisched_tpu.fleet.election import election_gate
+
+    monkeypatch.delenv("MINISCHED_PROC_REPLICA", raising=False)
+    _configure("election:die@once")
+    with pytest.raises(FaultWorkerDeath):
+        election_gate()
+
+
+def test_election_gate_corrupt_scribbles_burn_signal(registry):
+    """``election:corrupt`` scribbles the published burn signal with an
+    implausible level; the rebalancer's plausibility clamp discards it —
+    a corrupted signal can starve the burn trigger, never steer it."""
+    from minisched_tpu.fleet.election import burn_fields
+    from minisched_tpu.fleet.procfleet import (MAX_PLAUSIBLE_BURN,
+                                               RebalanceSpec,
+                                               ShardRebalancer)
+
+    class _Eng:
+        def burn_signal(self):
+            return 2, "slo-p99"
+
+    counters = {}
+    _configure("election:corrupt@1")
+    hb = burn_fields(_Eng(), counters=counters)
+    assert hb["overload_level"] > MAX_PLAUSIBLE_BURN
+    assert hb["burning"] == "scribbled"
+    assert counters["burn_scribbles"] == 1
+    _configure("")
+    assert burn_fields(_Eng()) == {"overload_level": 2,
+                                   "burning": "slo-p99"}
+    # Downstream containment: the scribble is clamped out of the load
+    # signal and can never nominate a move.
+    store = ClusterStore()
+    reb = ShardRebalancer(store, RebalanceSpec(skew=1e9, hold=1))
+    sts = {
+        "pa": obj.ReplicaStatus(
+            metadata=obj.ObjectMeta(name="replica-pa"),
+            ready=True, renewed_at=time.time(),
+            overload_level=hb["overload_level"],
+            burning=hb["burning"]),
+        "pb": obj.ReplicaStatus(
+            metadata=obj.ObjectMeta(name="replica-pb"),
+            ready=True, renewed_at=time.time()),
+    }
+    assert reb.observe(sts, {0: "pa", 1: "pb"}) is None
+    assert reb.counters["burn_scribbles_ignored"] == 1
+
+
 # ---- whole-suite coverage ------------------------------------------------
 
 

@@ -17,6 +17,10 @@ What this file pins:
   * The PDB-like disruption budget is provably never violated under an
     adversarial upgrade+reclamation overlap on one pool (pure mode:
     deterministic, and the invariant is re-derived from the store).
+  * The self-governing-fleet drills (fleet/election.py): KillSteward
+    decapitates the store-truth steward pid, RestartApiserver kills and
+    revives the control plane on the same port, and StewardUniqueness
+    trips on exactly the bumpless crown swap the election CAS forbids.
 
 ``make churn-smoke`` runs this file alone; ``make soak-churn`` repeats
 it reseeding MINISCHED_LIFECYCLE_SEED per iteration.
@@ -31,7 +35,8 @@ from minisched_tpu.config import SchedulerConfig
 from minisched_tpu.lifecycle import (AutoscalerLoop, InvariantViolation,
                                      LifecycleDriver, PoissonArrivals,
                                      ReclamationWave, RollingUpgrade,
-                                     TenantMix, seed_from_env)
+                                     StewardUniqueness, TenantMix,
+                                     seed_from_env)
 from minisched_tpu.scenario import Cluster
 from minisched_tpu.service.defaultconfig import Profile
 
@@ -420,3 +425,141 @@ def test_no_pod_lost_invariant_detects_silent_loss():
     c.store.delete("Pod", "default/will-vanish")
     with pytest.raises(InvariantViolation, match="no_pod_lost"):
         d.check_invariants()
+
+
+# ---- self-governing fleet drills (fleet/election.py) ---------------------
+
+
+def test_steward_uniqueness_invariant_detects_bumpless_swap():
+    """The crown never changes hands without an epoch bump and never
+    regresses — StewardUniqueness trips on exactly the writes the
+    election CAS forbids. (LeaseIntegrity flags the same swap for
+    ordinary shard leases; this one reads the crown specifically.)"""
+    from minisched_tpu.state import objects as obj
+
+    c = pure_cluster()
+    d = LifecycleDriver(c, seed=SEED)
+    inv = StewardUniqueness()
+    assert inv(d.view) == []  # no steward lease: vacuously green
+    c.store.create(obj.Lease(
+        metadata=obj.ObjectMeta(name="steward"), holder="pa",
+        epoch=3, ttl_s=30.0, renewed_at=time.monotonic(), shard=-1))
+    assert inv(d.view) == []
+    lease = c.store.get("Lease", "steward")
+    lease.holder = "pb"  # a second throne at the SAME epoch
+    c.store.update(lease)
+    viols = inv(d.view)
+    assert viols and "without an epoch bump" in viols[0]
+
+
+def test_steward_uniqueness_invariant_detects_epoch_regression():
+    from minisched_tpu.state import objects as obj
+
+    c = pure_cluster()
+    d = LifecycleDriver(c, seed=SEED)
+    inv = StewardUniqueness()
+    c.store.create(obj.Lease(
+        metadata=obj.ObjectMeta(name="steward"), holder="pa",
+        epoch=5, ttl_s=30.0, renewed_at=time.monotonic(), shard=-1))
+    assert inv(d.view) == []
+    lease = c.store.get("Lease", "steward")
+    lease.epoch = 2  # un-fences every directive epoch 3..5 stamped
+    c.store.update(lease)
+    viols = inv(d.view)
+    assert viols and "regressed" in viols[0]
+
+
+def test_steward_uniqueness_invariant_detects_duplicate_crowns():
+    """Two leases claiming stewardship (shard < 0) is the one split the
+    per-lease LeaseIntegrity check cannot see — the full-driver oracle
+    names steward_uniqueness when it happens."""
+    from minisched_tpu.state import objects as obj
+
+    c = pure_cluster()
+    d = LifecycleDriver(c, seed=SEED)
+    d.install_default_invariants()
+    c.store.create(obj.Lease(
+        metadata=obj.ObjectMeta(name="steward"), holder="pa",
+        epoch=3, ttl_s=30.0, renewed_at=time.monotonic(), shard=-1))
+    d.check_invariants()
+    c.store.create(obj.Lease(
+        metadata=obj.ObjectMeta(name="steward-shadow"), holder="pb",
+        epoch=1, ttl_s=30.0, renewed_at=time.monotonic(), shard=-1))
+    with pytest.raises(InvariantViolation, match="steward_uniqueness"):
+        d.check_invariants()
+
+
+def test_kill_steward_generator_kills_store_truth_steward():
+    """KillSteward resolves the victim from the store (steward Lease →
+    ReplicaStatus pid) and SIGKILLs it — no supervisor handle needed.
+    A sleeping subprocess stands in for the steward replica."""
+    import signal
+    import subprocess
+    import sys
+
+    from minisched_tpu.lifecycle import KillSteward
+    from minisched_tpu.state import objects as obj
+
+    proc = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(300)"])
+    try:
+        c = pure_cluster()
+        d = LifecycleDriver(c, seed=SEED)
+        c.store.create(obj.Lease(
+            metadata=obj.ObjectMeta(name="steward"), holder="px",
+            epoch=1, ttl_s=30.0, renewed_at=time.monotonic(), shard=-1))
+        c.store.create(obj.ReplicaStatus(
+            metadata=obj.ObjectMeta(name="replica-px"), pid=proc.pid,
+            ready=True, renewed_at=time.time()))
+        d.add(KillSteward(after_s=0.0))
+        d.run(until_s=0.5)
+        assert proc.wait(timeout=10) == -signal.SIGKILL
+        assert d.view.counters.get("steward_kills") == 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+
+
+def test_kill_steward_generator_noop_without_election():
+    """Outside elected-fleet runs (no steward lease) the drill degrades
+    to a no-op, so it is safe in every composed soak mix."""
+    from minisched_tpu.lifecycle import KillSteward
+
+    c = pure_cluster()
+    d = LifecycleDriver(c, seed=SEED)
+    d.add(KillSteward(after_s=0.0))
+    d.run(until_s=0.3)
+    assert "steward_kills" not in d.view.counters
+
+
+def test_restart_apiserver_generator_revives_same_port():
+    """RestartApiserver kills the control plane and revives it on the
+    SAME port over the SAME store (durable-etcd model): clients that
+    ride out the outage see identical state on the other side."""
+    from minisched_tpu.apiserver import APIServer, RemoteStore
+    from minisched_tpu.lifecycle import RestartApiserver
+    from minisched_tpu.state import objects as obj
+    from minisched_tpu.state.store import ClusterStore
+
+    backing = ClusterStore()
+    backing.create(obj.Node(metadata=obj.ObjectMeta(name="nx")))
+    srv = APIServer(backing).start()
+    port = srv.port
+    revived = []
+    try:
+        c = pure_cluster()
+        d = LifecycleDriver(c, seed=SEED)
+        d.add(RestartApiserver(server=srv, after_s=0.0, outage_s=0.2,
+                               on_restart=revived.append))
+        d.run(until_s=2.0)
+        assert d.view.counters.get("apiserver_outages") == 1
+        assert d.view.counters.get("apiserver_revivals") == 1
+        assert len(revived) == 1 and revived[0].port == port
+        rs = RemoteStore(revived[0].address, retry_deadline_s=0.5)
+        assert rs.get("Node", "nx").metadata.name == "nx"
+    finally:
+        for s in revived:
+            s.shutdown()
+        if not revived:
+            srv.shutdown()

@@ -44,13 +44,14 @@ REQUIRED_KEYS = ("seq", "t", "unix", "kind", "thread")
 #: Kinds that CLOSE a causal chain (the system returned to a calmer
 #: posture).
 _RECOVERY_KINDS = ("supervisor.recover", "overload.recover",
-                   "slo.clear")
+                   "slo.clear", "steward.respawn", "store.reattach")
 
 #: Kinds that belong to a chain between its fault root and recovery.
 _CHAIN_PREFIXES = ("supervisor.", "overload.", "index.", "shortlist.",
                    "residency.", "loop.", "watchdog.", "slo.",
                    "queue.", "bundle.", "invariant.", "lease.",
-                   "fleet.", "proc.", "engine.")
+                   "fleet.", "proc.", "engine.", "steward.",
+                   "store.", "rebalance.")
 
 
 def validate_journal(events: List[dict]) -> None:
@@ -178,7 +179,8 @@ def _fmt_event(ev: dict) -> str:
     kind = ev.get("kind", "?")
     detail = ev.get("to") or ev.get("outcome") or ev.get("reason") \
         or ev.get("slo") or ev.get("gate") or ev.get("cause") or ""
-    if kind.startswith(("lease.", "fleet.", "proc.")):
+    if kind.startswith(("lease.", "fleet.", "proc.", "steward.",
+                        "store.", "rebalance.")):
         # Fleet events read as WHO did WHAT: takeover names the dead
         # peer and the claiming epoch; others name the acting replica.
         who = ev.get("replica", "")
@@ -188,6 +190,22 @@ def _fmt_event(ev: dict) -> str:
         elif kind == "proc.death":
             detail = (f"{who} exit={ev.get('exit_code', '?')}"
                       f" up={ev.get('uptime_s', '?')}s")
+        elif kind in ("steward.claim", "steward.handoff") and frm:
+            # Succession reads crown-passing: new steward <- predecessor
+            # at the freshly fenced epoch.
+            detail = f"{who}<-{frm}@e{ev.get('epoch', '?')}"
+        elif kind in ("steward.mourn", "steward.respawn",
+                      "steward.orphan_adopt"):
+            detail = (f"{who} tends {ev.get('target', '?')}"
+                      f" inc={ev.get('incarnation', '?')}")
+        elif kind == "rebalance.burn_nominate":
+            detail = (f"shard {ev.get('shard', '?')}: "
+                      f"{ev.get('donor', '?')}->"
+                      f"{ev.get('recipient', '?')}"
+                      f" burn={ev.get('level', '?')}")
+        elif kind == "store.reattach":
+            detail = (f"{who} after {ev.get('outage_s', '?')}s"
+                      if who else f"after {ev.get('outage_s', '?')}s")
         elif who:
             detail = f"{who}" + (f": {detail}" if detail else "")
     # A merged cross-process journal tags each record with the replica
