@@ -15,14 +15,14 @@ SHELL := /bin/bash
 .PHONY: test tier1 fault-smoke shortlist-smoke trace-smoke slo-smoke \
         churn-smoke overload-smoke loop-smoke index-smoke journal-smoke \
         fleet-smoke fleet-proc-smoke election-smoke tenant-smoke \
-        auction-smoke profile-smoke start \
+        tenant-index-smoke auction-smoke profile-smoke start \
         start-remote \
         start-client-engine \
         demo docs \
         bench bench_sharded bench-cpu bench-pipeline bench-residency \
         bench-shortlist bench-trace bench-slo bench-churn bench-overload \
         bench-deviceloop bench-index bench-coldstart bench-journal \
-        bench-fleet bench-tenants bench-auction \
+        bench-fleet bench-tenants bench-tenant-index bench-auction \
         bench-check dryrun dryrun-dcn soak soak-faults soak-churn \
         soak-overload
 
@@ -190,6 +190,19 @@ election-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_election.py -x -q \
 	  -p no:cacheprovider -p no:randomly
 
+# Indexed fused-tenant arbitration (ISSUE 20): per-tenant (C,N) slabs
+# stacked and served through ONE vmapped gather+certified-scan dispatch
+# (ops/pipeline.build_tenant_index_step), bucket-major lane grouping,
+# slab repair routing, widening ejection, and the mid-tranche race
+# gate — all pinned bit-identical to sequential per-tenant stepping
+# AND to the fused-full path per engine mode. A tier-1 prerequisite
+# after election-smoke: it composes the maintained index (index-smoke)
+# with the fused-tenant mux (tenant-smoke), so both layers must
+# already hold.
+tenant-index-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tenant_index.py -x -q \
+	  -p no:cacheprovider -p no:randomly
+
 # The EXACT ROADMAP tier-1 verify command (dots count + exit code
 # preserved) — what the driver runs after every PR; run it locally
 # before shipping. shortlist-smoke runs first: the arbitration
@@ -211,10 +224,13 @@ election-smoke:
 # supervision is the outermost layer — replicas run the full engine
 # stack, so every seam below must already hold); election-smoke after
 # fleet-proc-smoke (the elected steward replaces the parent supervisor,
-# so the supervised fleet layer must already hold).
+# so the supervised fleet layer must already hold); tenant-index-smoke
+# after election-smoke (the indexed fused tranche composes the
+# maintained index with the tenant mux, so index-smoke and
+# tenant-smoke must both already hold).
 tier1: shortlist-smoke trace-smoke slo-smoke overload-smoke loop-smoke \
        index-smoke journal-smoke fleet-smoke tenant-smoke auction-smoke \
-       fleet-proc-smoke election-smoke churn-smoke
+       fleet-proc-smoke election-smoke tenant-index-smoke churn-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -359,6 +375,7 @@ bench-check:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_fleet_proc.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_election.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_tenants.py --check
+	JAX_PLATFORMS=cpu $(PY) tools/bench_tenant_index.py --check
 	JAX_PLATFORMS=cpu $(PY) tools/bench_auction.py --check
 
 # Persistent device-loop before/after (the committed
@@ -421,6 +438,20 @@ bench-fleet:
 # bench-tenants) so `make bench-check` gates them.
 bench-tenants:
 	JAX_PLATFORMS=cpu $(PY) tools/bench_tenants.py
+
+# Indexed fused-tenant before/after (the committed
+# BENCH_TENANT_INDEX.json): interleaved sequential-indexed /
+# fused-full / fused-indexed min-of-4 rounds at T=8 × 256 nodes —
+# steady-state scored rows per batch down ≥10× inside the fused
+# tranche (the slab serve scores zero rows; only the delta repair is
+# booked), the ≥5× dispatch fusion bar kept vs sequential stepping, a
+# wave-stepped replay proving every placement bit-identical PER TENANT
+# across all three modes, and a mixed-bucket round fusing ≥2 pad
+# groups with zero solo regressions. Stable keys append to
+# BENCH_LEDGER.json (source bench-tenant-index) so `make bench-check`
+# gates them.
+bench-tenant-index:
+	JAX_PLATFORMS=cpu $(PY) tools/bench_tenant_index.py
 
 # Auction-mode unification before/after (the committed
 # BENCH_AUCTION.json): interleaved split/unified min-of-4 rounds of the

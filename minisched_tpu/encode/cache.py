@@ -1429,10 +1429,13 @@ class _TenantLane:
     """One tenant engine's submitted batch inside a fusion round: the
     fully-staged step inputs (exactly what the solo dispatch would have
     consumed), the cache version recorded at submit (the race gate),
-    and the engine/_InflightBatch to hand the decision planes back to."""
+    and the engine/_InflightBatch to hand the decision planes back to.
+    An INDEXED lane additionally carries its engine's repaired (C,N)
+    score slab + this batch's class-gather rows (idx_slab/idx_cls/
+    idx_k) — the fused-indexed serve's per-tenant payload."""
 
     __slots__ = ("engine", "inf", "eb", "nf", "af", "key", "version",
-                 "w_vec", "group_key")
+                 "w_vec", "group_key", "idx_slab", "idx_cls", "idx_k")
 
 
 class TenantCacheMux:
@@ -1491,6 +1494,14 @@ class TenantCacheMux:
             "tenant_fetches": 0, "tenant_fetch_bytes": 0.0,
             "tenant_groups": 0, "tenant_lanes_fused": 0,
             "tenant_races": 0, "tenant_solo_fallbacks": 0,
+            # Indexed fused-tenant serving: fused tranches that went
+            # through build_tenant_index_step (a subset of
+            # tenant_dispatches) and the lanes they carried.
+            # tenant_groups_round_max is the widest single round by
+            # fused-group count — the bucket-major mixed-size claim
+            # ("a round fuses >=2 groups") reads it directly.
+            "tenant_index_dispatches": 0, "tenant_index_lanes": 0,
+            "tenant_groups_round_max": 0,
         }
         self._static_memo: Dict[tuple, str] = {}
         # Test seam: called at the top of dispatch() so a test can
@@ -1546,12 +1557,19 @@ class TenantCacheMux:
 
     # ---- the round ------------------------------------------------------
 
-    def submit(self, engine, inf, eb, nf, af, key) -> _TenantLane:
+    def submit(self, engine, inf, eb, nf, af, key,
+               index=None) -> _TenantLane:
         """Stage one tenant engine's prepared batch for the round's
         fused dispatch (called from Scheduler._prepare_batch at the
         dispatch seam). Returns the lane ticket the engine parks on
         ``inf.tenant_ticket``; ``dispatch()`` fills the decision planes
-        and clears it."""
+        and clears it. ``index`` is the engine's staged maintained-index
+        payload ``(score_slab, cls_pad, k_eff)`` (Scheduler.
+        _tenant_index_stage) — indexed lanes group separately from
+        full-step lanes (the group-key mode suffix: slab class-pad and
+        scan width join the compatibility contract), so a group is
+        homogeneous by construction and dispatches through
+        ops/pipeline.build_tenant_index_step."""
         pset = engine.plugin_set
         lane = _TenantLane()
         lane.engine, lane.inf = engine, inf
@@ -1560,7 +1578,13 @@ class TenantCacheMux:
         lane.w_vec = np.asarray(
             [pset.weight_of(p) for p in pset.score_plugins],
             dtype=np.float32)
-        lane.group_key = self._compat_key(engine, eb, nf, af)
+        if index is not None:
+            lane.idx_slab, lane.idx_cls, lane.idx_k = index
+            mode = ("idx", int(lane.idx_slab.shape[0]), int(lane.idx_k))
+        else:
+            lane.idx_slab = lane.idx_cls = lane.idx_k = None
+            mode = ("full",)
+        lane.group_key = self._compat_key(engine, eb, nf, af) + mode
         self.lanes.append(lane)
         return lane
 
@@ -1586,12 +1610,20 @@ class TenantCacheMux:
                 self._dispatch_solo(lane)
             else:
                 groups.setdefault(lane.group_key, []).append(lane)
+        fused_this_round = 0
         for group in groups.values():
             # MINISCHED_TENANTS_FUSE caps the tranche width: a group
             # wider than the cap splits into consecutive fused tranches.
             cap = self.max_lanes if self.max_lanes > 0 else len(group)
             for i in range(0, len(group), cap):
-                self._dispatch_group(group[i:i + cap])
+                tranche = group[i:i + cap]
+                if tranche[0].idx_slab is not None:
+                    self._dispatch_index_group(tranche)
+                else:
+                    self._dispatch_group(tranche)
+                fused_this_round += 1
+        self.counters["tenant_groups_round_max"] = max(
+            self.counters["tenant_groups_round_max"], fused_this_round)
 
     def _dispatch_solo(self, lane: _TenantLane) -> None:
         eng = lane.engine
@@ -1651,3 +1683,58 @@ class TenantCacheMux:
                                      * int(lane.nf.valid.shape[0]))
             lane.inf.tenant_ticket = None
             lane.engine._sup_count("tenant_fused_lanes")
+
+    def _dispatch_index_group(self, group: List[_TenantLane]) -> None:
+        """ONE fused INDEXED dispatch: stack the group's per-tenant
+        repaired (C,N) score slabs into a (T,C,N) device buffer and run
+        the vmapped class-row gather + certified K-compressed scan
+        (ops/pipeline.build_tenant_index_step) — zero plugin
+        evaluations, one (T,·) packed fetch. Each lane's row lands on
+        ``inf.index_packed_dev`` as a HOST slice: the engine's resolve
+        settles it through the same _settle_index ladder as the solo
+        indexed dispatch (serve = fused-hit; any unassigned live row
+        discards and re-dispatches the full step with the lane's own
+        PRNG draw — bit-identity is the settle contract, not a fused
+        special case)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..faults import FAULTS
+        from ..ops.index import corrupt_slab
+        from ..ops.pipeline import build_tenant_index_step
+
+        fused_fn = build_tenant_index_step(int(group[0].idx_k))
+        slab_stack = jnp.stack([ln.idx_slab for ln in group])
+        # Fault gate: fused-indexed dispatch seam. ``corrupt``
+        # scribbles ONE tenant's stacked slab slice pre-dispatch
+        # (ops/index.corrupt_slab — the solo index gate's scheme):
+        # range-sane, invisible to the in-scan certificate, caught only
+        # by that lane's MINISCHED_INDEX_CHECK_EVERY cross-check. The
+        # maintained slab itself is untouched — the scribble poisons
+        # this round's stacked COPY, exactly a transient device defect.
+        if FAULTS.hit("tenant_index") == "corrupt":
+            n_pad = int(group[0].nf.valid.shape[0])
+            slab_stack = slab_stack.at[0].set(
+                corrupt_slab(slab_stack[0], n_pad))
+        cls_stack = jnp.stack([jnp.asarray(ln.idx_cls) for ln in group])
+        valid_stack = jnp.stack([ln.eb.pf.valid for ln in group])
+        req_stack = jnp.stack([ln.eb.pf.requests for ln in group])
+        free_stack = jnp.stack([ln.nf.free for ln in group])
+        keys = jnp.stack([ln.key for ln in group])
+        packed_stack, free_after = fused_fn(
+            slab_stack, cls_stack, valid_stack, req_stack, free_stack,
+            keys)
+        self.counters["tenant_dispatches"] += 1
+        self.counters["tenant_groups"] += 1
+        self.counters["tenant_lanes_fused"] += len(group)
+        self.counters["tenant_index_dispatches"] += 1
+        self.counters["tenant_index_lanes"] += len(group)
+        buf = np.array(packed_stack)  # ONE (T, 4P+2ceil(P/8)) fetch
+        self.counters["tenant_fetches"] += 1
+        self.counters["tenant_fetch_bytes"] += buf.nbytes
+        for i, lane in enumerate(group):
+            lane.inf.index_packed_dev = buf[i]
+            lane.inf.index_free_after = free_after[i]
+            lane.inf.tenant_ticket = None
+            lane.engine._sup_count("tenant_fused_lanes")
+            lane.engine._sup_count("tenant_index_lanes")

@@ -29,7 +29,7 @@ import itertools
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..faults import FAULTS, FaultInjected
 from ..obs import span
@@ -120,6 +120,36 @@ def weighted_gather(demands: List[int], weights: List[float],
     while _round(zeroed):
         pass
     return alloc
+
+
+def bucket_major_quotas(demands: List[int], weights: List[float],
+                        capacity: int, buckets: List[int]
+                        ) -> List[Tuple[int, List[int], List[int]]]:
+    """Bucket-major slot apportionment (ISSUE 20's second prong): group
+    tenants by the pod pad bucket their pending demand would serve at
+    (``buckets[i]``, precomputed by the caller via encode.step_bucket)
+    and run :func:`weighted_gather` INSIDE each group over the full
+    round capacity — largest-remainder slots per group, so mixed-size
+    tenants still fuse within their bucket instead of one global pad
+    forcing every lane to the widest tenant's shape (or fragmenting the
+    round to solo dispatches).
+
+    Returns ``[(bucket, indices, quotas), ...]`` in ascending bucket
+    order — deterministic, so the fused and sequential coordinators pop
+    identical pods per round (the bit-identity precondition). Tenants
+    with zero demand are absent; a group's ``quotas`` aligns with its
+    ``indices``. All of weighted_gather's properties hold per group."""
+    groups: Dict[int, List[int]] = {}
+    for i, d in enumerate(demands):
+        if d > 0:
+            groups.setdefault(buckets[i], []).append(i)
+    out: List[Tuple[int, List[int], List[int]]] = []
+    for bucket in sorted(groups):
+        idxs = groups[bucket]
+        quotas = weighted_gather([demands[i] for i in idxs],
+                                 [weights[i] for i in idxs], capacity)
+        out.append((bucket, idxs, quotas))
+    return out
 
 
 @dataclass

@@ -40,7 +40,7 @@ from ..obs import slo as slo_mod
 from ..obs.journal import JOURNAL, ProvenanceStore
 from ..obs.journal import note as jnote
 from ..obs.timeseries import TIMELINE, TimelineTracker
-from ..ops.index import (build_index_ops, index_eligible,
+from ..ops.index import (build_index_ops, corrupt_slab, index_eligible,
                          unpack_index_decision)
 from ..ops.pipeline import (Decision, build_loop_step, build_step,
                             enable_compile_cache)
@@ -1799,56 +1799,16 @@ class Scheduler:
                   classes=len(idx.rows), n=n_pad, batch=self._batch_seq)
             inf.scored_rows += c_pad * n_pad
         else:
-            if idx.pending:
-                rows = np.fromiter(idx.pending, dtype=np.int64,
-                                   count=len(idx.pending))
-                rows.sort()
-                rows = rows[rows < n_pad]  # pad growth forces rebuild
-                idx.pending.clear()
-                if rows.size:
-                    rb = bucket_for(int(rows.size), 16)
-                    rows_pad = np.full((rb,), n_pad, dtype=np.int32)
-                    rows_pad[:rows.size] = rows
-                    with span("index.refresh", rows=int(rows.size)):
-                        idx.state = refresh_fn(idx.state, class_pf, nf,
-                                               af, rows_pad)
-                    self._sup_count("index_repair_rows", int(rows.size))
-                    jnote("index.repair", profile=self.profile, replica=self.replica,
-                          rows=int(rows.size), batch=self._batch_seq)
-                    inf.scored_rows += c_pad * rb
-            if idx.fresh_rows:
-                # Incremental per-class ADD (the ROADMAP's named cheap
-                # win): evaluate only the fresh class rows over the
-                # full node axis and scatter them in — the refresh
-                # above (if any) already brought every PRE-EXISTING
-                # row's changed columns to current truth, and a fresh
-                # row's full-axis evaluation against THIS snapshot
-                # matches what the rebuild would have computed for it.
-                n_fresh = len(idx.fresh_rows)
-                rb = bucket_for(n_fresh, 16)
-                rows_pad = np.full((rb,), c_pad, dtype=np.int32)
-                rows_pad[:n_fresh] = np.asarray(idx.fresh_rows,
-                                                dtype=np.int32)
-                idx.fresh_rows.clear()
-                with span("index.append", rows=n_fresh):
-                    idx.state = append_fn(idx.state, class_pf, nf, af,
-                                          rows_pad)
-                self._sup_count("index_appends", n_fresh)
-                jnote("index.append", profile=self.profile, replica=self.replica,
-                      rows=n_fresh, batch=self._batch_seq)
-                inf.scored_rows += rb * n_pad
+            self._index_repair_slab(idx, inf, class_pf, nf, af,
+                                    refresh_fn, append_fn, c_pad, n_pad)
         if act == "corrupt" and idx.state is not None:
-            # Scribbled index entries: one node column per class handed
-            # an unbeatable cached score (alternating columns 0/1 per
-            # class, so no uniform legitimate winner can shadow the
-            # corruption) — range-sane, a perfectly ordinary score to
-            # the scan's certificate, decision-wrong.
+            # Scribbled index entries (ops/index.corrupt_slab — the
+            # scheme the tenant_index gate shares): range-sane, a
+            # perfectly ordinary score to the scan's certificate,
+            # decision-wrong.
             st = idx.state
-            c = st.score.shape[0]
-            alt = np.minimum(np.arange(c) % 2,
-                             n_pad - 1).astype(np.int32)
             idx.state = st._replace(
-                score=st.score.at[np.arange(c), alt].set(1e6))
+                score=corrupt_slab(st.score, n_pad))
         cls_pad = np.zeros((int(eb.pf.valid.shape[0]),), dtype=np.int32)
         cls_pad[:len(batch)] = cls
         with span("index.assign", pods=len(batch), k=k_eff):
@@ -1859,6 +1819,117 @@ class Scheduler:
         inf.index_packed_dev = packed
         inf.index_free_after = free_after
         return True
+
+    def _index_repair_slab(self, idx: "_ArbIndex", inf: "_InflightBatch",
+                           class_pf, nf, af, refresh_fn, append_fn,
+                           c_pad: int, n_pad: int, *,
+                           fused: bool = False) -> None:
+        """Bring a live (C,N) slab to THIS snapshot's truth without a
+        rebuild: in-place rescore of exactly the drained changed node
+        columns (narrowing repairs), then scatter-in any fresh class
+        rows still inside the class-pad bucket. Shared by the solo
+        indexed dispatch and the fused-lane staging — the fused path
+        journals ``index.slab_repair`` so the repair's routing to the
+        owning tenant's slab slice stays attributable."""
+        if idx.pending:
+            rows = np.fromiter(idx.pending, dtype=np.int64,
+                               count=len(idx.pending))
+            rows.sort()
+            rows = rows[rows < n_pad]  # pad growth forces rebuild
+            idx.pending.clear()
+            if rows.size:
+                rb = bucket_for(int(rows.size), 16)
+                rows_pad = np.full((rb,), n_pad, dtype=np.int32)
+                rows_pad[:rows.size] = rows
+                with span("index.refresh", rows=int(rows.size)):
+                    idx.state = refresh_fn(idx.state, class_pf, nf,
+                                           af, rows_pad)
+                self._sup_count("index_repair_rows", int(rows.size))
+                jnote("index.slab_repair" if fused else "index.repair",
+                      profile=self.profile, replica=self.replica,
+                      rows=int(rows.size), batch=self._batch_seq)
+                inf.scored_rows += c_pad * rb
+        if idx.fresh_rows:
+            # Incremental per-class ADD (the ROADMAP's named cheap
+            # win): evaluate only the fresh class rows over the
+            # full node axis and scatter them in — the refresh
+            # above (if any) already brought every PRE-EXISTING
+            # row's changed columns to current truth, and a fresh
+            # row's full-axis evaluation against THIS snapshot
+            # matches what the rebuild would have computed for it.
+            n_fresh = len(idx.fresh_rows)
+            rb = bucket_for(n_fresh, 16)
+            rows_pad = np.full((rb,), c_pad, dtype=np.int32)
+            rows_pad[:n_fresh] = np.asarray(idx.fresh_rows,
+                                            dtype=np.int32)
+            idx.fresh_rows.clear()
+            with span("index.append", rows=n_fresh):
+                idx.state = append_fn(idx.state, class_pf, nf, af,
+                                      rows_pad)
+            self._sup_count("index_appends", n_fresh)
+            jnote("index.append", profile=self.profile, replica=self.replica,
+                  rows=n_fresh, batch=self._batch_seq)
+            inf.scored_rows += rb * n_pad
+
+    def _tenant_index_stage(self, inf: "_InflightBatch", batch, eb, nf,
+                            af):
+        """Stage this fused lane's maintained-index serve: bring the
+        engine's OWN (C,N) slab to current truth — narrowing repairs
+        column-patch the owning slab slice in place, in-bucket fresh
+        classes append — and hand the mux the slab plus this batch's
+        class-gather rows, so the lane rides ONE fused indexed dispatch
+        (ops/pipeline.build_tenant_index_step) instead of the vmapped
+        full O(P·N) pass. Three outcomes: a ``(score_slab, cls_pad,
+        k_eff)`` payload (serve fused-indexed); None (ride fused-FULL —
+        no live/cooling index, a counted delta-protocol race, or a full
+        class registry; never a stale serve); or ``"eject"`` — a repair
+        that cannot be expressed as a slab patch (widening
+        invalidation, cold/invalidated state, node-pad growth,
+        class-pad crossing) drops the lane from the fused group THIS
+        round, counted + journaled, and it rebuilds through its own
+        solo indexed dispatch below the tenant seam."""
+        idx = self._index
+        if idx is None or self._index_cooldown > 0:
+            return None
+        if (self.cache.version != idx.drain_version
+                or idx.listener.inval != idx.pending_inval):
+            self._sup_count("index_races")
+            return None
+        cls = idx.classify(eb.pf, len(batch))
+        if cls is None:
+            # Class registry full — counted fallback, never an error.
+            self._sup_count("index_fallbacks")
+            return None
+        n_pad = int(nf.valid.shape[0])
+        rebuild = (idx.state is None or idx.needs_rebuild
+                   or idx.pending_inval != idx.inval_seen
+                   or idx.n_built != n_pad)
+        _build_fn, refresh_fn, append_fn, _assign_fn = build_index_ops(
+            self.plugin_set, idx.k_eff, cfg=self.cache.cfg)
+        class_pf = idx.class_pf(eb.pf)
+        c_pad = int(class_pf.valid.shape[0])
+        if (not rebuild and idx.fresh_rows
+                and c_pad != int(idx.state.score.shape[0])):
+            rebuild = True
+        if rebuild:
+            # Same cause precedence as the solo dispatch; the rebuild
+            # itself happens there (this lane leaves the fused group).
+            cause = ("widening-invalidation"
+                     if idx.pending_inval != idx.inval_seen
+                     else "cold" if idx.n_built == -1
+                     else "invalidated" if idx.state is None
+                     else "node-pad" if idx.n_built != n_pad
+                     else "class-pad")
+            self._sup_count("index_lane_ejects")
+            jnote("index.lane_eject", profile=self.profile,
+                  replica=self.replica, cause=cause,
+                  batch=self._batch_seq)
+            return "eject"
+        self._index_repair_slab(idx, inf, class_pf, nf, af, refresh_fn,
+                                append_fn, c_pad, n_pad, fused=True)
+        cls_pad = np.zeros((int(eb.pf.valid.shape[0]),), dtype=np.int32)
+        cls_pad[:len(batch)] = cls
+        return (idx.state.score, cls_pad, idx.k_eff)
 
     def _settle_index(self, inf: "_InflightBatch") -> None:
         """Settle a speculatively index-dispatched batch (resolve phase,
@@ -1874,11 +1945,16 @@ class Scheduler:
         bit-identical to the index-off engine in every case (I3)."""
         idx = self._index
         p_pad = int(inf.eb.pf.valid.shape[0])
+        # A fused-indexed lane arrives with its row of the mux's ONE
+        # stacked (T,·) fetch already on the host (a numpy slice) — the
+        # group fetch was counted once at the mux, not per lane.
+        fused = isinstance(inf.index_packed_dev, np.ndarray)
         with span("fetch.index"):
             buf = np.array(inf.index_packed_dev)
         inf.index_packed_dev = None
-        self._count_fetch(buf.nbytes)
-        self._sup_count("decision_fetches")
+        if not fused:
+            self._count_fetch(buf.nbytes)
+            self._sup_count("decision_fetches")
         chosen, assigned, repaired = unpack_index_decision(buf, p_pad)
         L = len(inf.batch)
         if bool(assigned[:L].all()):
@@ -1897,10 +1973,14 @@ class Scheduler:
                 np.zeros((n_f, p_pad), dtype=np.int32),
                 repaired)
             inf.index_served = True
-            inf.index_mode = "hit"
+            inf.index_mode = "fused-hit" if fused else "hit"
             if idx is not None:
                 idx.rebuild_streak = 0
             self._sup_count("index_hits")
+            if fused:
+                self._sup_count("index_fused_hits")
+                jnote("index.fused_serve", profile=self.profile,
+                      replica=self.replica, pods=L, batch=inf.seq)
             self._sup_count("index_uncertified", int(repaired[:L].sum()))
             self._check_index(inf, chosen, assigned)
             return
@@ -2767,8 +2847,10 @@ class Scheduler:
         the index/loop posture: fast rung only (a degraded engine drops
         speculation first), no nominations (their debits modify the
         step's free input outside the fused staging), no explain
-        recorder, no armed shortlist/index cross-checks (their
-        attribution must stay per-batch), no fail-closed verdicts, no
+        recorder, no armed shortlist cross-check (its attribution must
+        stay per-batch; the INDEX cross-check is allowed when the
+        index is live — it certifies the fused-indexed serve exactly
+        as it certifies the solo one), no fail-closed verdicts, no
         hard-spread host arbitration, and the shared per-pod safety
         walk (no gangs / topology / volumes / ports / pod-affinity /
         owner groups — which also keeps spread_dev None, matching the
@@ -2779,7 +2861,8 @@ class Scheduler:
             return False
         if (self._sup.level != 0 or self._nominations or fail_closed
                 or hard_spread or self.config.shortlist_check_every
-                or self.config.index_check_every):
+                or (self.config.index_check_every
+                    and self._index is None)):
             return False
         return self._ring_safe_pods(batch)
 
@@ -3524,10 +3607,24 @@ class Scheduler:
         # (invariant I3), so decisions match the sequential engine in
         # index mode too — and the index listener keeps draining above,
         # so its protocol is untouched for batches that fall back.
-        if (self._tenant_mux is not None and sample_k is None
-                and self._tenant_fusable(batch, hard_spread, fail_closed)):
+        fuse_lane = (self._tenant_mux is not None and sample_k is None
+                     and self._tenant_fusable(batch, hard_spread,
+                                              fail_closed))
+        idx_payload = None
+        if fuse_lane and self._index is not None:
+            # Indexed fused-tenant arbitration: stage this lane's OWN
+            # repaired (C,N) slab for the mux's stacked (T,C,N) indexed
+            # dispatch. A rebuild-class repair ejects the lane from the
+            # fused group this round (counted) and routes it to its
+            # solo indexed dispatch below.
+            idx_payload = self._tenant_index_stage(inf, batch, eb, nf,
+                                                   af)
+            if idx_payload == "eject":
+                fuse_lane = False
+                idx_payload = None
+        if fuse_lane:
             inf.tenant_ticket = self._tenant_mux.submit(
-                self, inf, eb, nf, af, key)
+                self, inf, eb, nf, af, key, index=idx_payload)
             decision = None
             packed_dev = None
             spread_dev = None

@@ -79,6 +79,15 @@ production scheduler's failure domain spans:
                 overload level; the rebalancer's plausibility clamp +
                 the no-flap hysteresis detect and discard it — counted,
                 zero moves minted from a scribble).
+    tenant_index  fused-indexed tenant dispatch seam (encode/cache.
+                TenantCacheMux._dispatch_index_group) — ``corrupt``
+                scribbles ONE tenant's slice of the stacked (T,C,N)
+                score slab pre-dispatch (ops/index.corrupt_slab, the
+                solo ``index`` gate's scheme): range-sane, invisible
+                to the in-scan certificate, caught only by that lane's
+                MINISCHED_INDEX_CHECK_EVERY full-step cross-check —
+                which parks ONLY that tenant's index and replays the
+                batch bit-identically through the supervised ladder.
 
 Configured once per process from ``MINISCHED_FAULTS`` (tests reconfigure
 via :func:`configure`), a comma-separated list of ``gate:action@trigger``
@@ -150,10 +159,12 @@ log = logging.getLogger(__name__)
 # replica-side batch seam where ``die`` becomes a real SIGKILL.
 # election sits on the steward-election seams (fleet/election.py):
 # the CAS claim/renew call and the burn-signal heartbeat publication.
+# tenant_index sits on the fused-indexed tenant dispatch seam
+# (encode/cache.py): the stacked (T,C,N) slab, pre-dispatch.
 GATES = ("step", "fetch", "residency", "shortlist_repair", "commit",
          "bind", "informer", "http", "checkpoint", "lifecycle",
          "admission", "index", "journal", "lease", "auction_mirror",
-         "proc", "election")
+         "proc", "election", "tenant_index")
 
 _ACTIONS = ("err", "die", "corrupt", "stall")
 

@@ -275,6 +275,19 @@ def build_index_ops(plugin_set: PluginSet, k_eff: int, *,
     return ops
 
 
+def corrupt_slab(score: jnp.ndarray, n_live: int) -> jnp.ndarray:
+    """Deterministic test scribble for a (C, N) score slab — the shared
+    corruption scheme of the ``index`` and ``tenant_index`` fault gates:
+    one node column per class handed an unbeatable cached score
+    (alternating columns 0/1 per class, so no uniform legitimate winner
+    can shadow the corruption) — range-sane, a perfectly ordinary score
+    to the scan's certificate, decision-wrong. Only the
+    MINISCHED_INDEX_CHECK_EVERY full-step cross-check can catch it."""
+    c = score.shape[0]
+    alt = np.minimum(np.arange(c) % 2, max(n_live - 1, 0)).astype(np.int32)
+    return score.at[np.arange(c), alt].set(1e6)
+
+
 def unpack_index_decision(buf, p: int) -> Tuple:
     """Host-side inverse of the assign pack over the fetched (writable)
     u8 buffer → (chosen i32, assigned bool, repaired bool)."""

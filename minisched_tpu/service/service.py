@@ -22,7 +22,7 @@ from typing import Dict, List, Optional, Sequence, Union
 
 from ..config import SchedulerConfig
 from ..engine.clusterstate import SharedClusterState
-from ..engine.queue import weighted_gather
+from ..engine.queue import bucket_major_quotas, weighted_gather
 from ..engine.scheduler import Scheduler
 from ..explain.resultstore import ResultStore
 from ..faults import FaultWorkerDeath
@@ -484,22 +484,31 @@ class TenantFusionCoordinator:
 
     One round:
 
-      1. ``pending_count`` per tenant → ``weighted_gather`` splits the
-         config's ``max_batch_size`` batch slots by tenant weight (one
-         hot tenant cannot starve the fused slot).
-      2. Pop each tenant's quota; ``mux.round_pods`` is set to the
-         round's common pod bucket so ragged tenant batches harmonize
-         by masked-row padding (the pinned pad invariant: pad rows are
-         invalid and change no real row's decision).
+      1. ``pending_count`` per tenant → tenants group by the pod pad
+         bucket their demand serves at, and ``weighted_gather`` splits
+         the config's ``max_batch_size`` batch slots by tenant weight
+         INSIDE each bucket group (engine/queue.bucket_major_quotas —
+         one hot tenant cannot starve its group's fused slot, and a
+         small tenant never pads to a huge one's bucket).
+      2. Pop each tenant's quota; ``mux.round_pods`` is set per bucket
+         group to that GROUP's common pod bucket so its ragged tenant
+         batches harmonize by masked-row padding (the pinned pad
+         invariant: pad rows are invalid and change no real row's
+         decision).
       3. Each engine's prepare runs — a fusable batch SUBMITS its
-         staged step inputs to the mux; anything gated out (gangs,
-         nominations, degraded rungs, sampling, explain, mesh, spread)
-         dispatches solo inside prepare exactly as before.
+         staged step inputs to the mux (an index-armed engine stages
+         its repaired (C,N) slab alongside — the fused-INDEXED lane);
+         anything gated out (gangs, nominations, degraded rungs,
+         sampling, explain, mesh, spread) dispatches solo inside
+         prepare exactly as before.
       4. ``mux.dispatch()`` fires one vmapped step per compatibility
-         group and hands every lane its decision planes.
+         group — the full vmapped pass for full lanes, the stacked
+         (T,C,N) indexed gather+scan for indexed lanes — and hands
+         every lane its decision planes.
       5. Resolve + commit per tenant, in tenant order — each engine's
          own settlement machinery, journal/provenance attribution
-         riding the engine's profile label as always.
+         riding the engine's profile label as always; a lane's resolve
+         fault engages only THAT engine's supervised replay.
 
     With ``fuse < 2`` (``MINISCHED_TENANTS_FUSE`` unset) no mux is
     installed and the same loop steps each tenant's batch through its
@@ -592,40 +601,70 @@ class TenantFusionCoordinator:
         """Drive one coordinated round across every tenant. Returns
         False when no tenant had poppable work (the serve thread then
         idles briefly). Public so tests can single-step rounds without
-        the thread."""
+        the thread.
+
+        BUCKET-MAJOR grouping (ISSUE 20): tenants are grouped by the
+        pod pad bucket their pending demand would serve at, and slots
+        apportion per group (engine/queue.bucket_major_quotas), not
+        over one global bucket — mixed-size tenants fuse WITHIN their
+        bucket instead of every lane padding to the widest tenant's
+        shape. Each group's prepares run at that group's common pad
+        (mux.round_pods), then ONE mux.dispatch() fires every group's
+        fused tranche — a mixed round issues one vmapped dispatch PER
+        bucket group, never a solo regression. The sequential
+        (``fuse < 2``) coordinator walks the identical group order and
+        quotas, so both modes pop identical pods per round — the
+        bit-identity precondition."""
         from ..encode.cache import step_bucket
 
         engines = [self._engines[t.name] for t in self._tenants]
         demands = [eng.queue.pending_count() for eng in engines]
         if not any(demands):
             return False
-        quotas = weighted_gather(demands, self._weights,
-                                 self._config.max_batch_size)
-        work = []
-        for eng, quota in zip(engines, quotas):
-            if quota <= 0:
-                continue
-            batch = eng.queue.pop_batch(quota, timeout=0.05)
-            if batch:
-                work.append((eng, batch))
-        if not work:
-            return False
-        if self.mux is not None:
-            # The round's common pod pad: every fused lane encodes at
-            # the widest tenant's bucket so the stacked (T, P, ...)
-            # batch is rectangular. Solo-dispatched lanes harmonize
-            # too — harmless (the pad invariant) and keeps their pad
-            # buckets from fragmenting the compile cache.
-            self.mux.round_pods = step_bucket(
-                max(len(b) for _eng, b in work),
-                self._config.pod_bucket_min)
+        cap = self._config.max_batch_size
+        buckets = [step_bucket(min(d, cap), self._config.pod_bucket_min)
+                   if d else 0 for d in demands]
         lanes = []
-        for eng, batch in work:
-            lanes.append((eng, eng._prepare_batch(batch)))
+        for _bucket, idxs, quotas in bucket_major_quotas(
+                demands, self._weights, cap, buckets):
+            work = []
+            for i, quota in zip(idxs, quotas):
+                if quota <= 0:
+                    continue
+                batch = engines[i].queue.pop_batch(quota, timeout=0.05)
+                if batch:
+                    work.append((engines[i], batch))
+            if not work:
+                continue
+            if self.mux is not None:
+                # The GROUP's common pod pad: every lane in this bucket
+                # group encodes at the group's widest batch so its
+                # stacked (T, P, ...) tranche is rectangular; a
+                # different group's pad differs — its lanes land in a
+                # different compat group at the mux by shape signature.
+                self.mux.round_pods = step_bucket(
+                    max(len(b) for _eng, b in work),
+                    self._config.pod_bucket_min)
+            for eng, batch in work:
+                lanes.append((eng, eng._prepare_batch(batch)))
+        if not lanes:
+            return False
         if self.mux is not None:
             self.mux.dispatch()
         for eng, inf in lanes:
-            eng._resolve_batch(inf)
+            try:
+                eng._resolve_batch(inf)
+            except Exception:
+                # Per-lane containment, the engine's own resolve-guard
+                # idiom: a resolve fault (e.g. the index cross-check's
+                # EngineDesync on a scribbled fused slab) engages THAT
+                # engine's supervised replay — rewound, escalated,
+                # re-run bit-identically on its degraded solo rung —
+                # while the round's other tenants settle undisturbed.
+                log.exception("tenant lane resolve failed; engaging "
+                              "that engine's supervisor")
+                eng._supervised_retry(inf.batch, inf)
+                continue
             try:
                 eng._commit_batch(inf)
             except FaultWorkerDeath:

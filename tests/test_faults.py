@@ -426,6 +426,97 @@ def test_index_corrupt_caught_by_certification_cross_check():
     assert placed == ref_placed
 
 
+def test_tenant_index_corrupt_caught_parks_only_that_lane():
+    """Fused-indexed detector (ISSUE 20): ``tenant_index:corrupt``
+    scribbles ONE tenant's slice of the stacked (T,C,N) score slab
+    pre-dispatch (encode/cache.TenantCacheMux._dispatch_index_group) —
+    a range-sane score the vmapped scan's certificate consumes as
+    truth, so that lane serves a WRONG node and certifies it. With the
+    index cross-check armed (index_check_every=1) THAT lane's full-step
+    comparison must catch it, count exactly ONE desync across the
+    fleet, park only that tenant's index (index_width -> 0; the other
+    lanes keep their indexes), and the coordinator's per-lane
+    supervised replay must land every pod on the fault-free run's
+    node."""
+    from minisched_tpu.service.service import (Tenant,
+                                               TenantFusionCoordinator)
+
+    names = ["ta", "tb", "tc"]
+    waves, per_wave = 3, 6
+
+    def run(spec):
+        _configure(spec, seed=0)
+        cfg = SchedulerConfig(max_batch_size=24, batch_window_s=0.3,
+                              backoff_initial_s=0.05, backoff_max_s=0.3,
+                              probation_batches=1, pipeline=False,
+                              index=True, index_k=8, index_classes=32,
+                              index_check_every=1)
+        stores = {}
+        for nm in names:
+            s = ClusterStore()
+            for i, cpu in enumerate((64000, 48000, 40000, 36000)):
+                s.create(obj.Node(
+                    metadata=obj.ObjectMeta(name=f"vn-n{i}"),
+                    spec=obj.NodeSpec(),
+                    status=obj.NodeStatus(allocatable={
+                        "cpu": float(cpu), "memory": float(64 << 30),
+                        "pods": 110.0})))
+            stores[nm] = s
+        coord = TenantFusionCoordinator(
+            [Tenant(name=nm, store=stores[nm]) for nm in names],
+            cfg, fuse=8)
+        try:
+            coord.start()
+            want = 0
+            for w in range(waves):
+                for nm in names:
+                    stores[nm].create_many([obj.Pod(
+                        metadata=obj.ObjectMeta(
+                            name=f"{nm}-w{w}-p{i}", namespace="default"),
+                        spec=obj.PodSpec(
+                            requests={"cpu": float(100 + 17 * (i % 8))},
+                            priority=1000 - i))
+                        for i in range(per_wave)])
+                    want += per_wave
+                deadline = time.monotonic() + 120
+                bound = 0
+                while time.monotonic() < deadline:
+                    bound = sum(
+                        1 for nm in names
+                        for p in stores[nm].list("Pod")
+                        if p.spec.node_name)
+                    if bound == want:
+                        break
+                    time.sleep(0.05)
+                assert bound == want, (bound, want)
+            placements = {
+                nm: {p.metadata.name: p.spec.node_name
+                     for p in stores[nm].list("Pod") if p.spec.node_name}
+                for nm in names}
+            return placements, coord.metrics()
+        finally:
+            _configure("")
+            coord.shutdown()
+
+    ref_placed, ref_m = run("")
+    assert ref_m["tenant_index_dispatches"] >= 2   # the gate's seam ran
+    assert sum(ref_m[f"{nm}_index_checks"] for nm in names) >= 1
+    assert all(ref_m[f"{nm}_index_desyncs"] == 0 for nm in names)
+
+    placed, m = run("tenant_index:corrupt@2")
+    assert m["ta_fault_fires_tenant_index"] == 1   # process-wide count
+    desyncs = {nm: m[f"{nm}_index_desyncs"] for nm in names}
+    assert sum(desyncs.values()) == 1, desyncs     # exactly one lane
+    hit = max(desyncs, key=desyncs.get)
+    assert m[f"{hit}_index_width"] == 0            # only ITS index parked
+    for nm in names:
+        if nm != hit:
+            assert m[f"{nm}_index_width"] > 0, (nm, desyncs)
+    assert m[f"{hit}_batch_faults"] >= 1
+    assert m[f"{hit}_supervisor_escalations"] >= 1
+    assert placed == ref_placed
+
+
 def test_bind_gate_reconciles_without_losing_or_double_binding():
     """An aborted bulk bind task reconciles per pod against store truth:
     unbound pods are unassumed + requeued (never lost), already-bound

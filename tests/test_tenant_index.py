@@ -1,0 +1,318 @@
+"""Indexed fused-tenant arbitration (ISSUE 20).
+
+The acceptance bar this file pins: per-tenant maintained (C,N) index
+slabs served THROUGH the fused multi-tenant dispatch — TenantCacheMux
+stacks every index-eligible lane's slab into one (T,C,N) device buffer
+and issues ONE jitted gather+certified-scan
+(ops/pipeline.build_tenant_index_step) instead of the vmapped full
+O(P·N) pass — make decisions BIT-IDENTICAL to sequential per-tenant
+stepping AND to the fused-full path, in every engine config. Repairs
+route to the owning tenant's slab slice; a widening invalidation ejects
+only that lane (counted, solo rebuild); a mid-tranche race falls back
+solo (counted, never a stale serve). The second prong, bucket-major
+lane grouping, lets mixed-size tenants fuse within their pod-pad bucket
+(engine/queue.bucket_major_quotas) instead of one global bucket forcing
+a common pad.
+"""
+import time
+
+import pytest
+
+from minisched_tpu.config import SchedulerConfig
+from minisched_tpu.encode.cache import step_bucket
+from minisched_tpu.engine.queue import bucket_major_quotas, weighted_gather
+from minisched_tpu.service.service import Tenant, TenantFusionCoordinator
+from minisched_tpu.state import objects as obj
+from minisched_tpu.state.store import ClusterStore
+
+
+def _mk_store(node_cpus=(64000, 48000, 40000, 36000)):
+    """One tenant's virtual cluster; node NAMES are identical across
+    tenants so lanes share one compatibility group (static-token
+    equality — see tests/test_tenants.py module docstring)."""
+    s = ClusterStore()
+    for i, cpu in enumerate(node_cpus):
+        s.create(obj.Node(
+            metadata=obj.ObjectMeta(name=f"vn-n{i}"),
+            spec=obj.NodeSpec(),
+            status=obj.NodeStatus(allocatable={
+                "cpu": float(cpu), "memory": float(64 << 30),
+                "pods": 110.0})))
+    return s
+
+
+def _pods(n, tag, *, cpu0=100, prio=None):
+    """Deterministic per-tenant pods cycling a SMALL class set (8 CPU
+    shapes) so the index registry warms quickly — the steady state the
+    fused-indexed serve exists for."""
+    return [obj.Pod(
+        metadata=obj.ObjectMeta(name=f"{tag}-p{i}", namespace="default"),
+        spec=obj.PodSpec(requests={"cpu": float(cpu0 + 17 * (i % 8))},
+                         priority=(1000 - i if prio is None else prio)))
+        for i in range(n)]
+
+
+def _config(**kw):
+    kw.setdefault("max_batch_size", 24)
+    kw.setdefault("batch_window_s", 0.3)
+    kw.setdefault("backoff_initial_s", 0.05)
+    kw.setdefault("backoff_max_s", 0.2)
+    kw.setdefault("index", True)
+    kw.setdefault("index_k", 8)
+    kw.setdefault("index_classes", 32)
+    return SchedulerConfig(**kw)
+
+
+def _wait_bound(coord, names, want, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    placements = {}
+    while time.monotonic() < deadline:
+        placements = {
+            nm: {p.metadata.name: p.spec.node_name
+                 for p in coord.store(nm).list("Pod") if p.spec.node_name}
+            for nm in names}
+        if sum(len(v) for v in placements.values()) == want:
+            return placements
+        time.sleep(0.05)
+    raise AssertionError(f"bound {placements}, wanted {want}")
+
+
+def _run(fuse, config, waves, *, n_tenants=3, hook=None):
+    """Run ``waves`` successive pod waves (each wave fully binds before
+    the next is created — wave 2+ serves from a WARM index) and return
+    (placements, metrics)."""
+    names = [f"t{i}" for i in range(n_tenants)]
+    tenants = [Tenant(name=nm, store=_mk_store()) for nm in names]
+    coord = TenantFusionCoordinator(tenants, config, fuse=fuse)
+    if hook is not None:
+        hook(coord)
+    try:
+        coord.start()
+        want = 0
+        for w, counts in enumerate(waves):
+            for nm, n in zip(names, counts):
+                coord.store(nm).create_many(_pods(n, f"{nm}-w{w}"))
+                want += n
+            _wait_bound(coord, names, want)
+        return _wait_bound(coord, names, want), coord.metrics()
+    finally:
+        coord.shutdown()
+
+
+# ---- bucket-major slot apportionment (engine/queue.bucket_major_quotas) ---
+
+
+def test_bucket_major_quotas_groups_and_apportions():
+    """Tenants group by their pod-pad bucket in ascending-bucket order;
+    each group runs the full weighted_gather discipline over the round
+    capacity; zero-demand tenants are absent."""
+    demands = [5, 0, 40, 8, 30]
+    weights = [1.0, 1.0, 2.0, 1.0, 1.0]
+    buckets = [16, 0, 48, 16, 48]
+    out = bucket_major_quotas(demands, weights, 24, buckets)
+    assert [b for b, _i, _q in out] == [16, 48]
+    b16, b48 = out
+    assert b16[1] == [0, 3] and b16[2] == [5, 8]      # demand-capped
+    assert b48[1] == [2, 4]
+    assert b48[2] == weighted_gather([40, 30], [2.0, 1.0], 24)
+    assert sum(b48[2]) == 24                           # work-conserving
+    for _b, idxs, quotas in out:
+        assert all(q <= demands[i] for i, q in zip(idxs, quotas))
+
+
+def test_bucket_major_quotas_single_bucket_matches_global_gather():
+    """Homogeneous demand degenerates to the ISSUE 16 global gather —
+    the backward-compatibility property the bit-identity tests lean
+    on."""
+    demands, weights = [10, 10, 10], [1.0, 1.0, 1.0]
+    out = bucket_major_quotas(demands, weights, 12, [16, 16, 16])
+    assert out == [(16, [0, 1, 2], weighted_gather(demands, weights, 12))]
+
+
+# ---- fused-indexed vs sequential vs fused-full bit-identity ---------------
+
+
+@pytest.mark.parametrize("mode,kw", [
+    ("sync", dict(pipeline=False)),
+    ("pipelined", dict(pipeline=True)),
+    ("upload", dict(device_resident=False)),
+    ("device-loop", dict(device_loop=True, loop_depth=4)),
+])
+def test_fused_indexed_matches_sequential_and_fused_full(mode, kw):
+    """The tentpole claim, per engine mode: with the maintained index
+    armed, the fused coordinator's placements equal BOTH the sequential
+    indexed coordinator's and the fused-FULL coordinator's — and the
+    indexed fused path genuinely engaged (stacked-slab dispatches with
+    fused index hits, not a silent fall-through to fused-full)."""
+    waves = [(8, 8, 8), (8, 8, 8)]
+    seq, _m_seq = _run(0, _config(**kw), waves)
+    full, _m_full = _run(8, _config(index=False, **kw), waves)
+    fused, m_f = _run(8, _config(**kw), waves)
+    assert fused == seq, mode
+    assert fused == full, mode
+    assert m_f["tenant_index_dispatches"] >= 1, m_f
+    assert m_f["tenant_index_lanes"] >= 2, m_f
+    assert sum(m_f.get(f"t{i}_index_fused_hits", 0)
+               for i in range(3)) >= 1, m_f
+
+
+def test_fused_indexed_scored_rows_match_sequential_indexed():
+    """The perf ledger is shared with the solo index: a fused-indexed
+    serve pays ZERO plugin-evaluation rows (the stacked scan reads the
+    maintained slabs), and repair/rebuild costs book identically to the
+    sequential indexed engine — so scored_rows_total agrees per tenant
+    across fuse on/off."""
+    waves = [(8, 8, 8), (8, 8, 8), (8, 8, 8)]
+    _seq, m_s = _run(0, _config(), waves)
+    _fused, m_f = _run(8, _config(), waves)
+    for i in range(3):
+        assert (m_f[f"t{i}_scored_rows_total"]
+                == m_s[f"t{i}_scored_rows_total"]), (i, m_f, m_s)
+    assert m_f["steps_dispatched_total"] < m_s["steps_dispatched_total"]
+
+
+def test_mid_tranche_race_on_indexed_lane_falls_back_solo():
+    """A delta landing between an indexed lane's submit and the fused
+    dispatch (cache version moved) must not be served from the stale
+    stacked slab: the lane re-dispatches its FULL step solo against its
+    own live cache (the mux race posture — stronger than needed, never
+    wrong), the race is counted, and placements still equal the
+    sequential indexed run's."""
+    waves = [(6, 6, 6), (6, 6, 6)]
+    seq, _ = _run(0, _config(), waves)
+    fired = []
+
+    def hook(coord):
+        def pre_dispatch():
+            if not fired:
+                fired.append(1)
+                coord.engine("t0").cache.version += 1
+        coord.mux._pre_dispatch_hook = pre_dispatch
+
+    fused, m = _run(8, _config(), waves, hook=hook)
+    assert fused == seq
+    assert fired
+    assert m["tenant_races"] >= 1, m
+    assert m["tenant_solo_fallbacks"] >= 1, m
+
+
+def test_widening_invalidation_ejects_only_that_lane():
+    """A STATIC widening mutation (a node's allocatable grown — a
+    widened node may rise anywhere, the inval-epoch rung of the repair
+    ladder) cannot be expressed as a slab patch: THAT lane falls out of
+    the fused group (counted index_lane_ejects) and rebuilds through
+    its own solo indexed dispatch; the other tenants keep fusing, and
+    placements still equal the sequential run's. Note every lane pays
+    ONE startup ejection too — the initial node sync is itself a
+    widening — so the probe compares against the other tenants'
+    counts."""
+    names = ["t0", "t1", "t2"]
+
+    def scenario(fuse):
+        tenants = [Tenant(name=nm, store=_mk_store()) for nm in names]
+        coord = TenantFusionCoordinator(tenants, _config(), fuse=fuse)
+        try:
+            coord.start()
+            for nm in names:
+                coord.store(nm).create_many(_pods(8, f"{nm}-w0"))
+            _wait_bound(coord, names, 24)
+            # Widening on t1 ONLY: grow one node's allocatable.
+            node = coord.store("t1").get("Node", "vn-n3")
+            node.status.allocatable["cpu"] += 8000.0
+            coord.store("t1").update(node)
+            for nm in names:
+                coord.store(nm).create_many(_pods(8, f"{nm}-w1"))
+            return (_wait_bound(coord, names, 48), coord.metrics())
+        finally:
+            coord.shutdown()
+
+    seq, _m_seq = scenario(0)
+    fused, m = scenario(8)
+    assert fused == seq
+    # t1 ejected once more than the others (the widening), and its
+    # eject rebuilt through the SOLO indexed path (its own dispatch),
+    # while the round's other lanes stayed fused.
+    assert m["t1_index_lane_ejects"] >= m["t0_index_lane_ejects"] + 1, m
+    assert m["t1_index_rebuilds"] >= m["t0_index_rebuilds"] + 1, m
+    assert m["tenant_index_dispatches"] >= 1, m
+
+
+# ---- bucket-major grouping: mixed-size tenants fuse per bucket ------------
+
+
+def _wait_pending(coord, names, counts, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = [coord.engine(nm).queue.pending_count() for nm in names]
+        if got == list(counts):
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"pending {got}, wanted {counts}")
+
+
+def _flat_pods(n, tag, *, cpu0=100):
+    """Pods whose class rows all land in ONE warm 8-row set: constant
+    priority and a non-digit name tail (name_suffix stays -1), so only
+    the 8 cycled request shapes distinguish them — the registry warms
+    on the first wave and never crosses its class-pad bucket."""
+    return [obj.Pod(
+        metadata=obj.ObjectMeta(name=f"{tag}-{i}x", namespace="default"),
+        spec=obj.PodSpec(requests={"cpu": float(cpu0 + 17 * (i % 8))},
+                         priority=0))
+        for i in range(n)]
+
+
+def _drain_rounds(coord):
+    while any(eng.queue.pending_count()
+              for eng in coord.engines.values()):
+        if not coord.serve_round():
+            time.sleep(0.02)
+
+
+def test_mixed_bucket_round_fuses_two_groups():
+    """Heterogeneous tenant sizes (two tenants at a small pod bucket,
+    two at a large one) no longer pad to one global bucket: one serve
+    round issues one fused dispatch PER bucket group — >=2 groups, zero
+    solo regressions — and placements equal the sequential
+    coordinator's. A warm-up wave runs first: every lane's first serve
+    ejects once by design (fresh-sync invalidation, solo rebuild), so
+    the mixed round itself stages warm INDEXED lanes in both buckets."""
+    names = [f"t{i}" for i in range(4)]
+    counts = (3, 3, 20, 20)   # buckets: step_bucket(3)=16, step_bucket(20)=24
+    warm = 8                  # one pod per class row
+    assert step_bucket(3, 16) != step_bucket(20, 16)
+
+    def scenario(fuse):
+        tenants = [Tenant(name=nm, store=_mk_store()) for nm in names]
+        # Capacity >= the widest bucket group's total demand (20+20), so
+        # the large tenants pop their FULL backlog in the mixed round
+        # and genuinely pad to the 24-bucket while the small tenants pad
+        # to 16 — two shape groups in one round.
+        coord = TenantFusionCoordinator(
+            tenants, _config(max_batch_size=48), fuse=fuse)
+        try:
+            for eng in coord.engines.values():
+                eng._shared.ensure_started()
+            for nm in names:
+                coord.store(nm).create_many(_flat_pods(warm, f"{nm}-warm"))
+            _wait_pending(coord, names, (warm,) * len(names))
+            _drain_rounds(coord)
+            _wait_bound(coord, names, warm * len(names))
+            for nm, n in zip(names, counts):
+                coord.store(nm).create_many(_flat_pods(n, nm))
+            _wait_pending(coord, names, counts)
+            assert coord.serve_round()
+            _drain_rounds(coord)
+            return (_wait_bound(coord, names,
+                                warm * len(names) + sum(counts)),
+                    coord.metrics())
+        finally:
+            coord.shutdown()
+
+    seq, _ = scenario(0)
+    fused, m = scenario(8)
+    assert fused == seq
+    assert m["tenant_groups_round_max"] >= 2, m
+    assert m["tenant_solo_fallbacks"] == 0, m
+    assert m["tenant_lanes_fused"] >= 4, m
+    assert m["tenant_index_lanes"] >= 4, m

@@ -257,6 +257,59 @@ def test_provenance_and_journal_attribution_stay_per_tenant():
         journal_mod.configure("")
 
 
+def test_fused_indexed_provenance_and_journal_stay_per_tenant():
+    """ISSUE 20 leakage probe: with the maintained index armed, warm
+    batches serve fused-INDEXED — provenance records carry index
+    posture ``fused-hit`` under the OWNING tenant's profile only, and
+    the new index.fused_serve / index.slab_repair / index.lane_eject
+    journal events are tagged with the owning tenant's profile, never a
+    peer's."""
+    from minisched_tpu.obs import journal as journal_mod
+
+    journal_mod.configure("1")
+    names = ["t0", "t1"]
+    tenants = [Tenant(name=nm, store=_mk_store()) for nm in names]
+    coord = TenantFusionCoordinator(
+        tenants, _config(index=True, index_classes=32), fuse=8)
+    try:
+        coord.start()
+        # Wave 1 pays each lane's cold rebuild (a counted lane
+        # ejection to the solo indexed path); wave 2 serves from the
+        # warm stacked slabs.
+        for nm in names:
+            coord.store(nm).create_many(_pods(5, nm))
+        _wait_bound(coord, names, 10)
+        for nm in names:
+            coord.store(nm).create_many(_pods(5, f"{nm}-w2"))
+        _wait_bound(coord, names, 20)
+        m = coord.metrics()
+        assert m["tenant_index_dispatches"] >= 1, m
+        fused_hits = 0
+        for nm, other in (("t0", "t1"), ("t1", "t0")):
+            assert m[f"{nm}_index_fused_hits"] >= 1, m
+            for i in range(5):
+                key = f"default/{nm}-w2-p{i}"
+                rec = coord.engine(nm).provenance(key)
+                assert rec is not None, key
+                assert rec["profile"] == nm, rec
+                assert rec["index"] in ("fused-hit", "hit", None), rec
+                fused_hits += rec["index"] == "fused-hit"
+                assert coord.engine(other).provenance(key) is None, key
+        assert fused_hits >= 1
+        entries = journal_mod.JOURNAL.entries()
+        for kind, required in (("index.fused_serve", True),
+                               ("index.lane_eject", True),
+                               ("index.slab_repair", False)):
+            profs = {e.get("profile") for e in entries
+                     if e["kind"] == kind}
+            if required:
+                assert profs, kind
+            assert profs <= set(names), (kind, profs)
+    finally:
+        coord.shutdown()
+        journal_mod.configure("")
+
+
 # ---- per-tenant shed budgets (MINISCHED_OVERLOAD profile overrides) -------
 
 
